@@ -1,0 +1,37 @@
+"""tpu-bft: a TPU-native BFT state-machine-replication framework.
+
+A from-scratch re-design of CometBFT's capabilities (Tendermint consensus,
+ABCI 2.0, mempool, block sync, state sync, light client, evidence, p2p, RPC,
+WAL-backed crash recovery) built idiomatically on JAX/XLA.  The defining
+feature is a TPU execution backend for the signature-verification hot path:
+a ``jax.vmap``'d Ed25519 (SHA-512 + Curve25519) batch-verify kernel behind
+the ``crypto.BatchVerifier`` seam (reference: ``crypto/crypto.go:44-52``,
+``crypto/batch/batch.go``), used by ``VerifyCommit``/``VerifyCommitLight``
+(``types/validation.go``), the light-client verifier (``light/verifier.go``)
+and cross-block-batched blocksync replay (``internal/blocksync/reactor.go:495``).
+
+Layout (bottom-up, mirroring SURVEY.md §1's layer map):
+
+- ``ops``        JAX/TPU kernels: fe25519 limb arithmetic, SHA-512, Edwards
+                 point ops, the Ed25519 ZIP-215 batch-verify kernel.
+- ``parallel``   device meshes and sharded (multi-chip) batch verification.
+- ``crypto``     key/signature interfaces, batch-verifier dispatch, merkle.
+- ``libs``       service lifecycle, logging, pubsub, events, metrics, bits.
+- ``types``      Block/Header/Vote/Commit/ValidatorSet/... + commit verification.
+- ``storage``    KV abstraction, block store, state store.
+- ``abci``       ABCI 2.0 application interface, clients/servers, kvstore app.
+- ``proxy``      multiplexed app connections (consensus/mempool/query/snapshot).
+- ``mempool``    CList mempool + cache.
+- ``consensus``  Tendermint state machine, WAL, replay/handshake.
+- ``blocksync``  fast sync with cross-block signature batching.
+- ``statesync``  snapshot sync.
+- ``light``      light client (sequential + skipping verification, detector).
+- ``evidence``   evidence pool and verification.
+- ``p2p``        transport, secret connection, multiplexed channels, switch, pex.
+- ``privval``    file/remote private validators with double-sign protection.
+- ``rpc``        JSON-RPC/WebSocket server and client.
+- ``node``       full-node assembly.
+- ``cmd``        CLI.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,8 @@
+"""JAX/TPU compute kernels.
+
+The compute hot path of the framework: GF(2^255-19) field arithmetic,
+SHA-512, Edwards-curve point operations and the batched Ed25519 ZIP-215
+verification kernel.  Everything here is pure-functional JAX over int32/uint32
+arrays (no 64-bit integer multiplies — TPU vector units are 32-bit), shape
+polymorphic over leading batch axes, and jit/vmap/shard_map-compatible.
+"""

@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU mesh *before* jax is imported anywhere:
+multi-chip sharding (parallel/) is exercised on host CPU exactly the way the
+driver's dryrun does, and tests never contend for the real TPU.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

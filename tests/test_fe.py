@@ -1,0 +1,197 @@
+"""Field-arithmetic tests: JAX limb ops vs Python big-int ground truth.
+
+All device code goes through jit (the only way it's used in production);
+inputs are batched so each op compiles once.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cometbft_tpu.ops import fe
+
+P = fe.P_INT
+rng = np.random.default_rng(1234)
+
+EDGE = [0, 1, 2, 19, P - 1, P - 2, P, P + 1, 2**255 - 1, 2**255 - 20,
+        fe.SQRT_M1_INT, fe.D_INT, (P - 1) // 2, 2**254]
+
+j_add = jax.jit(lambda a, b: fe.freeze(fe.add(a, b)))
+j_sub = jax.jit(lambda a, b: fe.freeze(fe.sub(a, b)))
+j_mul = jax.jit(lambda a, b: fe.freeze(fe.mul(a, b)))
+j_square = jax.jit(lambda a: fe.freeze(fe.square(a)))
+j_invert = jax.jit(lambda a: fe.freeze(fe.invert(a)))
+j_pow22523 = jax.jit(lambda a: fe.freeze(fe.pow22523(a)))
+j_freeze = jax.jit(fe.freeze)
+j_is_zero = jax.jit(fe.is_zero)
+j_to_bytes = jax.jit(fe.to_bytes32)
+j_from_bytes = jax.jit(fe.from_bytes32)
+j_sqrt_ratio = jax.jit(lambda u, v: fe.sqrt_ratio(u, v))
+
+
+def rand_ints(n):
+    return [int.from_bytes(rng.bytes(32), "little") % P for _ in range(n)]
+
+
+def to_limbs_batch(xs):
+    return np.stack([fe.limbs_from_int(x) for x in xs])
+
+
+def pad64(xs):
+    """Pad a python list to length 64 so every jit call shares one shape."""
+    xs = list(xs)
+    assert len(xs) <= 64
+    return xs + [0] * (64 - len(xs)), len(xs)
+
+
+def test_roundtrip_int_limbs():
+    for x in EDGE + rand_ints(20):
+        assert fe.int_from_limbs(fe.limbs_from_int(x)) == x
+
+
+@pytest.mark.parametrize("op,pyop", [
+    (j_add, lambda a, b: (a + b) % P),
+    (j_sub, lambda a, b: (a - b) % P),
+    (j_mul, lambda a, b: (a * b) % P),
+])
+def test_binary_ops(op, pyop):
+    xs, n = pad64(EDGE + rand_ints(40))
+    ys, _ = pad64(list(reversed(EDGE)) + rand_ints(40))
+    out = np.asarray(op(to_limbs_batch(xs), to_limbs_batch(ys)))
+    for i in range(n):
+        assert fe.int_from_limbs(out[i]) == pyop(xs[i], ys[i]) % P, (i, xs[i], ys[i])
+
+
+def test_square_and_chains():
+    xs, n = pad64(EDGE + rand_ints(30))
+    # avoid 0 for inversion ground truth (0^-1 is 0 by the chain; pow(0,p-2)=0 too)
+    a = to_limbs_batch(xs)
+    sq = np.asarray(j_square(a))
+    inv = np.asarray(j_invert(a))
+    p2523 = np.asarray(j_pow22523(a))
+    for i in range(n):
+        x = xs[i]
+        assert fe.int_from_limbs(sq[i]) == x * x % P
+        assert fe.int_from_limbs(inv[i]) == pow(x, P - 2, P)
+        assert fe.int_from_limbs(p2523[i]) == pow(x, (P - 5) // 8, P)
+
+
+def test_loose_form_stacking():
+    # repeated adds stay within the loose bound and stay correct under jit
+    xs, n = pad64(rand_ints(8))
+    a = to_limbs_batch(xs)
+
+    def chain(a):
+        acc = a
+        for _ in range(50):
+            acc = fe.add(acc, a)
+        return fe.freeze(fe.mul(acc, acc))
+
+    out = np.asarray(jax.jit(chain)(a))
+    for i in range(n):
+        want = (xs[i] * 51) % P
+        assert fe.int_from_limbs(out[i]) == want * want % P
+
+
+def test_freeze_canonical():
+    vals, n = pad64([0, 1, P - 1, P, P + 1, 2 * P - 1, 2**255 - 1])
+    out = np.asarray(j_freeze(to_limbs_batch(vals)))
+    for i in range(n):
+        assert fe.int_from_limbs(out[i]) == vals[i] % P
+    z = np.asarray(j_is_zero(to_limbs_batch([P, 1] + [0] * 62)))
+    assert bool(z[0]) and not bool(z[1])
+
+
+def test_bytes_roundtrip():
+    raw, n = pad64([x % P for x in EDGE] + rand_ints(20))
+    a = to_limbs_batch(raw)
+    enc = np.asarray(j_to_bytes(a))
+    for i in range(n):
+        assert bytes(enc[i].astype(np.uint8)) == raw[i].to_bytes(32, "little")
+    dec = np.asarray(j_from_bytes(enc))
+    for i in range(n):
+        assert fe.int_from_limbs(dec[i]) == raw[i]
+    # sign-bit masking
+    top = np.frombuffer((2**255 + 12345).to_bytes(32, "little"), np.uint8)
+    arr = np.broadcast_to(top, (64, 32)).astype(np.int32)
+    assert fe.int_from_limbs(np.asarray(j_from_bytes(arr))[0]) == 12345
+
+
+def test_sqrt_ratio():
+    squares = [x * x % P for x in rand_ints(20)]
+    nonsq = [x for x in rand_ints(60) if pow(x, (P - 1) // 2, P) != 1][:20]
+    denom = rand_ints(20)
+    num = [(s * d) % P for s, d in zip(squares, denom)]
+
+    us, n = pad64(squares + nonsq + num)
+    vs, _ = pad64([1] * 40 + denom)
+    root, ok = j_sqrt_ratio(to_limbs_batch(us), to_limbs_batch(vs))
+    root, ok = np.asarray(fe.freeze(root)), np.asarray(ok)
+    for i in range(20):
+        assert ok[i]
+        r = fe.int_from_limbs(root[i])
+        assert r * r % P == squares[i]
+    for i in range(20, 40):
+        assert not ok[i]
+    for i in range(40, 60):
+        assert ok[i]
+        r = fe.int_from_limbs(root[i])
+        assert r * r % P == us[i] * pow(vs[i], P - 2, P) % P
+
+
+j_neg = jax.jit(lambda a: fe.freeze(fe.neg(a)))
+j_eq = jax.jit(fe.eq)
+j_parity = jax.jit(fe.parity)
+j_mul_small = jax.jit(lambda a: fe.freeze(fe.mul_small(a, 32767)))
+
+
+def rand_loose(n, lim=None):
+    """Adversarial loose-form limb arrays: any limbs up to LIMB_MAX."""
+    lim = lim or fe.LIMB_MAX
+    a = rng.integers(0, lim + 1, size=(n, fe.NLIMBS), dtype=np.int32)
+    # seed with crafted all-max / overflow-cascade rows
+    a[0] = fe.LIMB_MAX
+    a[1] = 0
+    a[2] = [7584, 8191, 8191] + [0] * 16 + [8192]  # freeze fold-cascade case
+    a[3] = [0] * 19 + [fe.LIMB_MAX]
+    a[4] = fe.MASK
+    return a
+
+
+def test_freeze_loose_adversarial():
+    a = rand_loose(64)
+    out = np.asarray(j_freeze(a))
+    for i in range(64):
+        want = fe.int_from_limbs(a[i]) % P
+        got = fe.int_from_limbs(out[i])
+        assert got == want, (i, list(a[i]))
+        assert got < P
+
+
+def test_ops_on_loose_inputs():
+    a, b = rand_loose(64), rand_loose(64)[::-1].copy()
+    m = np.asarray(j_mul(a, b))
+    s = np.asarray(j_sub(a, b))
+    ng = np.asarray(j_neg(a))
+    ms = np.asarray(j_mul_small(a))
+    par = np.asarray(j_parity(a))
+    for i in range(64):
+        av, bv = fe.int_from_limbs(a[i]), fe.int_from_limbs(b[i])
+        assert fe.int_from_limbs(m[i]) == av * bv % P
+        assert fe.int_from_limbs(s[i]) == (av - bv) % P
+        assert fe.int_from_limbs(ng[i]) == (-av) % P
+        assert fe.int_from_limbs(ms[i]) == av * 32767 % P
+        assert par[i] == (av % P) & 1
+
+
+def test_eq_loose():
+    xs = rand_ints(32)
+    a = to_limbs_batch(xs + xs)
+    # b: same values but in a different (loose) representation: add p
+    b = np.asarray(j_add(to_limbs_batch([x % P for x in xs] * 2),
+                          to_limbs_batch([P] * 64)))
+    b = to_limbs_batch([fe.int_from_limbs(b[i]) for i in range(64)])
+    eq1 = np.asarray(j_eq(a, b))
+    assert eq1.all()
+    c = to_limbs_batch([(x + 1) % P for x in xs] * 2)
+    assert not np.asarray(j_eq(a, c)).any()
