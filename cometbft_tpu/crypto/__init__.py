@@ -1,0 +1,6 @@
+"""Cryptographic primitives and key interfaces.
+
+Mirrors the reference's ``crypto/`` layer (``crypto/crypto.go:22-52``):
+PubKey/PrivKey interfaces, the BatchVerifier seam the TPU backend plugs
+into (``crypto/batch/batch.go``), merkle trees, and hashes.
+"""

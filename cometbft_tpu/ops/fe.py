@@ -205,22 +205,23 @@ def parity(a):
     return freeze(a)[..., 0] & 1
 
 
-def from_bytes32(b, mask_bit255: bool = True):
-    """(…,32) uint8/int32 little-endian bytes -> canonical-range limbs.
+def bytes_to_limbs(b, nlimbs: int, mask_top_bit: bool = False):
+    """(…,nbytes) uint8/int32 little-endian bytes -> canonical 13-bit limbs.
 
-    With ``mask_bit255`` the top bit (the Edwards sign bit) is dropped, giving
-    the raw 255-bit integer — NOT reduced mod p (ZIP-215 decoding reduces
-    lazily via field ops; the value is < 2^255 so loose-form bounds hold).
+    Shared unpack used for field elements (32 bytes -> 20 limbs), scalars
+    (32 -> 20) and 512-bit hashes (64 -> 40).  ``mask_top_bit`` drops the
+    highest bit of the last byte (the Edwards sign bit).
     """
+    nbytes = b.shape[-1]
     b = b.astype(jnp.int32)
     limbs = []
-    for i in range(NLIMBS):
+    for i in range(nlimbs):
         bit0 = RADIX * i
         acc = jnp.zeros_like(b[..., 0])
-        for j in range(bit0 // 8, min((bit0 + RADIX + 7) // 8, 32)):
+        for j in range(bit0 // 8, min((bit0 + RADIX + 7) // 8, nbytes)):
             shift = 8 * j - bit0
             byte = b[..., j]
-            if mask_bit255 and j == 31:
+            if mask_top_bit and j == nbytes - 1:
                 byte = byte & 127
             if shift >= 0:
                 acc = acc + (byte << shift)
@@ -228,6 +229,13 @@ def from_bytes32(b, mask_bit255: bool = True):
                 acc = acc + (byte >> (-shift))
         limbs.append(acc & MASK)
     return jnp.stack(limbs, axis=-1)
+
+
+def from_bytes32(b, mask_bit255: bool = True):
+    """(…,32) LE bytes -> limbs of the raw 255-bit integer (not reduced mod
+    p; the value is < 2^255 so loose-form bounds hold — ZIP-215 decoding
+    reduces lazily via field ops)."""
+    return bytes_to_limbs(b, NLIMBS, mask_top_bit=mask_bit255)
 
 
 def to_bytes32(a):

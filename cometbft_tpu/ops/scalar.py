@@ -1,0 +1,106 @@
+"""Arithmetic mod L = 2^252 + 27742...493 (the Ed25519 group order), on device.
+
+Used by the verify kernel for (a) the canonicity check ``S < L`` (ZIP-215
+rejects non-canonical S, reference: curve25519-voi verify options) and
+(b) reducing the 512-bit ``h = SHA-512(R||A||M)`` to a scalar.
+
+A trick keeps this all-positive int32 (no signed-limb sc_reduce): the final
+verification is *cofactored* (``[8](SB - hA - R) == 0``), so any h' ≡ h
+(mod L) with h' < 2^256 verifies identically — [h'-h]A is killed by the
+cofactor multiply even for mixed-order A.  We therefore reduce 512 → 256 bits
+(not all the way below L): one (20-high-limb × 20)-matmul fold at the 2^260
+boundary, three single-limb folds, then four folds at the 2^256 boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import fe
+
+L_INT = 2**252 + 27742317777372353535851937790883648493
+RADIX, MASK, NL = fe.RADIX, fe.MASK, fe.NLIMBS
+
+L_LIMBS = fe.limbs_from_int(L_INT)
+# TAB[j] = limbs of 2^(13*(20+j)) mod L
+TAB = np.stack([fe.limbs_from_int(pow(2, RADIX * (20 + j), L_INT))
+                for j in range(NL)]).astype(np.int32)
+# M260 = 2^260 mod L; R256 = 2^256 mod L
+M260 = fe.limbs_from_int(pow(2, 260, L_INT))
+R256 = fe.limbs_from_int(pow(2, 256, L_INT))
+
+
+def _carry_exact(cols, nout: int):
+    """Sequential exact carry; caller guarantees value < 2^(13*nout)."""
+    limbs = []
+    c = jnp.zeros_like(cols[..., 0])
+    for i in range(cols.shape[-1]):
+        t = cols[..., i] + c
+        limbs.append(t & MASK)
+        c = t >> RADIX
+    while len(limbs) < nout:
+        limbs.append(c & MASK)
+        c = c >> RADIX
+    return jnp.stack(limbs[:nout], axis=-1), c
+
+
+def bytes32_to_limbs(b):
+    """(…,32) bytes -> 20 canonical limbs of the full 256-bit value."""
+    return fe.bytes_to_limbs(b, NL)
+
+
+def bytes64_to_limbs40(b):
+    """(…,64) bytes -> 40 canonical limbs (little-endian 512-bit value)."""
+    return fe.bytes_to_limbs(b, 40)
+
+
+def _fold256(x20):
+    """One fold of bits >= 256 (limb 19 bits 9..12) via 2^256 ≡ R256."""
+    v = x20[..., 19] >> 9
+    lo = x20.at[..., 19].set(x20[..., 19] & 511)
+    cols = lo + v[..., None] * jnp.asarray(R256)
+    out, c = _carry_exact(cols, NL)
+    return out
+
+
+def reduce512(bytes64):
+    """512-bit LE bytes -> canonical 20 limbs of some h' ≡ h (mod L), < 2^256."""
+    x = bytes64_to_limbs40(bytes64)
+    lo, hi = x[..., :NL], x[..., NL:]
+    # matmul fold at 2^260: every high limb contributes via TAB
+    cols = lo + jnp.einsum("...j,jk->...k", hi, jnp.asarray(TAB),
+                           preferred_element_type=jnp.int32)
+    x20, c = _carry_exact(cols, NL)          # value < 2^271 -> c < 2^11
+    # single-limb folds at 2^260: carries shrink 2^11 -> 2^4 -> 1 -> 1 -> 0
+    # (the 4th fold starts from value < 2^260 + 2^253, so lo < 2^253 when
+    # c == 1 and the folded value < 2^254 — provably no 5th carry)
+    for _ in range(4):
+        cols = x20 + c[..., None] * jnp.asarray(M260)
+        x20, c = _carry_exact(cols, NL)
+    for _ in range(4):                        # endgame folds at 2^256
+        x20 = _fold256(x20)
+    return x20
+
+
+def lt_l(x20):
+    """(…,) bool: canonical-limb value < L (the S-canonicity check)."""
+    lt = jnp.zeros(x20.shape[:-1], bool)
+    for i in range(NL):
+        li = jnp.int32(int(L_LIMBS[i]))
+        lt = jnp.where(x20[..., i] < li, True,
+                       jnp.where(x20[..., i] > li, False, lt))
+    return lt
+
+
+def nibbles(x20):
+    """Canonical 20 limbs (< 2^256) -> (…,64) radix-16 digits, LSB first."""
+    digs = []
+    for n in range(64):
+        bit0 = 4 * n
+        j, s = divmod(bit0, RADIX)
+        d = x20[..., j] >> s
+        if s > RADIX - 4 and j + 1 < NL:
+            d = d | (x20[..., j + 1] << (RADIX - s))
+        digs.append(d & 15)
+    return jnp.stack(digs, axis=-1)

@@ -16,3 +16,19 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The image's sitecustomize imports jax (axon TPU plugin) at interpreter
+# start, so jax latched JAX_PLATFORMS=axon before this file ran — the env
+# vars above don't reach jax.config anymore.  Force CPU through the config
+# API and deregister the axon/tpu factories so backend discovery can never
+# dial the TPU relay (tests are CPU-only by design; a wedged relay would
+# otherwise hang the first jit forever).
+import jax  # noqa: E402  (registers factories, does not init backends)
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    _xb._backend_factories.pop("axon", None)
+    _xb._backend_factories.pop("tpu", None)
+except AttributeError:  # private symbol moved in a jax upgrade
+    pass

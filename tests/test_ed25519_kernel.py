@@ -1,0 +1,255 @@
+"""Ed25519 kernel tests: scalar mod-L, Edwards ops, and full ZIP-215 verify.
+
+Ground truth is the pure-Python oracle (RFC-8032-checked) plus signatures
+produced independently by the `cryptography` library.
+"""
+
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+from cometbft_tpu.crypto import _ed25519_py as ref
+from cometbft_tpu.ops import ed25519, edwards, fe, scalar, sha512
+
+rng = np.random.default_rng(42)
+L = scalar.L_INT
+P = fe.P_INT
+
+j_reduce512 = jax.jit(scalar.reduce512)
+j_lt_l = jax.jit(scalar.lt_l)
+j_nibbles = jax.jit(lambda b: scalar.nibbles(scalar.bytes32_to_limbs(b)))
+
+
+def bytes_arr(bs_list):
+    return np.stack([np.frombuffer(b, np.uint8) for b in bs_list]).astype(np.int32)
+
+
+# ---------------------------------------------------------------- scalar mod L
+
+def test_reduce512():
+    vals = [0, 1, L - 1, L, L + 1, 2**256 - 1, 2**512 - 1, 2**511, 13 * L**2 + 7]
+    vals += [int.from_bytes(rng.bytes(64), "little") for _ in range(55)]
+    arr = bytes_arr([v.to_bytes(64, "little") for v in vals])
+    out = np.asarray(j_reduce512(arr))
+    for i, v in enumerate(vals):
+        got = fe.int_from_limbs(out[i])
+        assert got < 2**256 and got % L == v % L, (i, v)
+
+
+def test_lt_l_and_nibbles():
+    vals = [0, 1, L - 1, L, L + 1, 2**252, 2**256 - 1]
+    vals += [int.from_bytes(rng.bytes(32), "little") for _ in range(57)]
+    arr = bytes_arr([v.to_bytes(32, "little") for v in vals])
+    lt = np.asarray(j_lt_l(scalar.bytes32_to_limbs(arr)))
+    nib = np.asarray(j_nibbles(arr))
+    for i, v in enumerate(vals):
+        assert bool(lt[i]) == (v < L), v
+        assert sum(int(nib[i, n]) << (4 * n) for n in range(64)) == v
+
+
+# ---------------------------------------------------------------- edwards ops
+
+def rand_points(n):
+    pts = []
+    while len(pts) < n:
+        enc = bytearray(rng.bytes(32))
+        pt = ref.pt_decompress_zip215(bytes(enc))
+        if pt is not None:
+            pts.append((bytes(enc), pt))
+    return pts
+
+
+def to_ext_batch(pts):
+    xs = np.stack([fe.limbs_from_int(p[0] * pow(p[2], P - 2, P) % P) for p in pts])
+    ys = np.stack([fe.limbs_from_int(p[1] * pow(p[2], P - 2, P) % P) for p in pts])
+    ts = np.stack([fe.limbs_from_int(
+        (p[0] * pow(p[2], P - 2, P) % P) * (p[1] * pow(p[2], P - 2, P) % P) % P)
+        for p in pts])
+    ones = np.stack([fe.limbs_from_int(1)] * len(pts))
+    return edwards.Ext(xs, ys, ones, ts)
+
+
+def test_decompress_add_dbl_compress():
+    pairs = rand_points(32)
+    encs = bytes_arr([e for e, _ in pairs])
+    pts = [p for _, p in pairs]
+
+    dev_pts, ok = jax.jit(edwards.decompress_zip215)(encs)
+    assert np.asarray(ok).all()
+    # compress(decompress(e)) == canonical encoding of the oracle point
+    enc2 = np.asarray(jax.jit(edwards.compress)(dev_pts))
+    for i in range(32):
+        assert bytes(enc2[i].astype(np.uint8)) == ref.pt_compress(pts[i])
+
+    # dbl and add against oracle
+    d = np.asarray(jax.jit(lambda p: edwards.compress(edwards.dbl(p)))(dev_pts))
+    q = to_ext_batch(pts[::-1])
+    s = np.asarray(jax.jit(
+        lambda p, q: edwards.compress(edwards.add_cached(p, edwards.cache(q))))(
+        dev_pts, q))
+    for i in range(32):
+        assert bytes(d[i].astype(np.uint8)) == ref.pt_compress(ref.pt_double(pts[i]))
+        assert bytes(s[i].astype(np.uint8)) == ref.pt_compress(
+            ref.pt_add(pts[i], pts[31 - i]))
+
+
+def test_noncanonical_decompress():
+    # y >= p encodings (ZIP-215 must accept): y_enc = y + p for y in {1, 2}
+    encs = []
+    for y in (1, 2, 0):
+        encs.append((y + P).to_bytes(32, "little"))
+    # x=0 with sign bit: -0 encoding of identity
+    encs.append((1 | (1 << 255)).to_bytes(32, "little"))
+    arr = bytes_arr(encs)
+    pts, ok = jax.jit(edwards.decompress_zip215)(arr)
+    okn = np.asarray(ok)
+    for i, e in enumerate(encs):
+        oracle_pt = ref.pt_decompress_zip215(e)
+        assert bool(okn[i]) == (oracle_pt is not None), e.hex()
+        if oracle_pt is not None:
+            got = bytes(np.asarray(jax.jit(edwards.compress)(pts))[i].astype(np.uint8))
+            assert got == ref.pt_compress(oracle_pt)
+
+
+# ------------------------------------------------------------------ full verify
+
+def kernel_verify(pubs, sigs, msgs):
+    """Host wrapper mirroring what the crypto layer will do."""
+    bsz = len(pubs)
+    nb = max(sha512.max_blocks_for_len(64 + len(m)) for m in msgs)
+    maxlen = max(64 + len(m) for m in msgs)
+    hin = np.zeros((bsz, maxlen), np.uint8)
+    lens = np.zeros(bsz, np.int64)
+    for i, (p, s, m) in enumerate(zip(pubs, sigs, msgs)):
+        full = s[:32] + p + m
+        hin[i, :len(full)] = np.frombuffer(full, np.uint8)
+        lens[i] = len(full)
+    blocks, active = sha512.host_pad(hin, lens, nb)
+    out = jax.jit(ed25519.verify_padded)(
+        bytes_arr(pubs), bytes_arr([s[:32] for s in sigs]),
+        bytes_arr([s[32:] for s in sigs]), blocks, active)
+    return np.asarray(out)
+
+
+def make_torsion8():
+    """Find a point of exact order 8 with the oracle."""
+    while True:
+        enc = rng.bytes(32)
+        pt = ref.pt_decompress_zip215(enc)
+        if pt is None:
+            continue
+        t = ref.pt_mul(ref.L, pt)
+        if not ref.pt_equal(t, ref.IDENTITY) and \
+           not ref.pt_equal(ref.pt_mul(4, t), ref.IDENTITY):
+            assert ref.pt_equal(ref.pt_mul(8, t), ref.IDENTITY)
+            return t
+
+
+def test_verify_batch_mixed():
+    """One batch covering every accept/reject class."""
+    pubs, sigs, msgs, expect = [], [], [], []
+
+    def case(p, s, m, want):
+        pubs.append(p); sigs.append(s); msgs.append(m); expect.append(want)
+
+    # RFC 8032 vector 2
+    seed = bytes.fromhex(
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb")
+    case(ref.public_key_from_seed(seed), ref.sign(seed, bytes.fromhex("72")),
+         bytes.fromhex("72"), True)
+
+    # valid signatures from the cryptography library, varied message sizes
+    for n in (0, 1, 31, 32, 100, 120, 180, 250):
+        sk = Ed25519PrivateKey.generate()
+        pk = sk.public_key().public_bytes_raw()
+        m = rng.bytes(n)
+        case(pk, sk.sign(m), m, True)
+
+    # corrupted signature / wrong message / wrong key
+    sk = Ed25519PrivateKey.generate()
+    pk = sk.public_key().public_bytes_raw()
+    m = rng.bytes(80)
+    good = sk.sign(m)
+    bad_sig = bytearray(good); bad_sig[5] ^= 1
+    case(pk, bytes(bad_sig), m, False)
+    case(pk, good, m + b"x", False)
+    pk2 = Ed25519PrivateKey.generate().public_key().public_bytes_raw()
+    case(pk2, good, m, False)
+
+    # S >= L (non-canonical S: reject), S = s + L of a valid sig
+    s_int = int.from_bytes(good[32:], "little")
+    if s_int + L < 2**256:
+        case(pk, good[:32] + (s_int + L).to_bytes(32, "little"), m, False)
+
+    # mixed-order pubkey: A' + T8 accepted under ZIP-215 cofactored verify.
+    # The signature must be crafted against the *mixed* encoding (the hash
+    # h = H(R || A || M) covers the encoded pubkey bytes).
+    t8 = make_torsion8()
+    seed2 = rng.bytes(32)
+    h0 = hashlib.sha512(seed2).digest()
+    a_sc = ref._clamp(h0[:32])
+    prefix = h0[32:]
+    a_prime = ref.pt_mul(a_sc, ref.BASE)
+    mixed = ref.pt_compress(ref.pt_add(a_prime, t8))
+    m3 = rng.bytes(50)
+    r_sc = ref.sc_reduce64(hashlib.sha512(prefix + m3).digest())
+    r_enc = ref.pt_compress(ref.pt_mul(r_sc, ref.BASE))
+    k_sc = ref.sc_reduce64(hashlib.sha512(r_enc + mixed + m3).digest())
+    sig3 = r_enc + ((r_sc + k_sc * a_sc) % L).to_bytes(32, "little")
+    assert ref.verify_zip215(mixed, m3, sig3)     # oracle agrees: cofactored
+    case(mixed, sig3, m3, True)
+
+    # non-canonical identity pubkey (y = 1 + p): [S]B == R makes it valid
+    r_scalar = int.from_bytes(rng.bytes(32), "little") % L
+    r_enc = ref.pt_compress(ref.pt_mul(r_scalar, ref.BASE))
+    ident_nc = (1 + P).to_bytes(32, "little")
+    sig_id = r_enc + r_scalar.to_bytes(32, "little")
+    assert ref.verify_zip215(ident_nc, b"whatever", sig_id)
+    case(ident_nc, sig_id, b"whatever", True)
+
+    # small-order R (torsion) with identity A: [S]B - R must be torsion: S=0, R=T8
+    sig_t = ref.pt_compress(t8) + (0).to_bytes(32, "little")
+    assert ref.verify_zip215(ident_nc, b"x", sig_t)
+    case(ident_nc, sig_t, b"x", True)
+
+    # undecodable A (non-square x^2): find one
+    while True:
+        cand = bytearray(rng.bytes(32)); cand[31] &= 127
+        if ref.pt_decompress_zip215(bytes(cand)) is None:
+            case(bytes(cand), good, m, False)
+            break
+
+    # pad batch to a fixed size with valid sigs so shapes bucket evenly
+    while len(pubs) < 24:
+        sk = Ed25519PrivateKey.generate()
+        mm = rng.bytes(33)
+        case(sk.public_key().public_bytes_raw(), sk.sign(mm), mm, True)
+
+    got = kernel_verify(pubs, sigs, msgs)
+    for i in range(len(pubs)):
+        # oracle cross-check on every lane
+        assert ref.verify_zip215(pubs[i], msgs[i], sigs[i]) == expect[i], i
+        assert bool(got[i]) == expect[i], f"lane {i}: kernel={got[i]} want={expect[i]}"
+
+
+def test_verify_random_roundtrip_larger():
+    bsz = 64
+    pubs, sigs, msgs = [], [], []
+    flip = rng.integers(0, 3, size=bsz)
+    for i in range(bsz):
+        sk = Ed25519PrivateKey.generate()
+        pk = sk.public_key().public_bytes_raw()
+        m = rng.bytes(int(rng.integers(0, 150)))
+        s = bytearray(sk.sign(m))
+        if flip[i] == 1:
+            s[int(rng.integers(0, 64))] ^= 1 << int(rng.integers(0, 8))
+        elif flip[i] == 2:
+            m = m + b"!"
+        pubs.append(pk); sigs.append(bytes(s)); msgs.append(m)
+    got = kernel_verify(pubs, sigs, msgs)
+    for i in range(bsz):
+        want = ref.verify_zip215(pubs[i], msgs[i], sigs[i])
+        assert bool(got[i]) == want, i

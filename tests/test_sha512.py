@@ -1,0 +1,54 @@
+"""SHA-512 kernel vs hashlib."""
+
+import hashlib
+
+import jax
+import numpy as np
+
+from cometbft_tpu.ops import sha512
+
+rng = np.random.default_rng(99)
+
+
+def run_batch(msgs):
+    nb = max(sha512.max_blocks_for_len(len(m)) for m in msgs)
+    maxlen = max((len(m) for m in msgs), default=0)
+    arr = np.zeros((len(msgs), max(maxlen, 1)), np.uint8)
+    lens = np.zeros(len(msgs), np.int64)
+    for i, m in enumerate(msgs):
+        arr[i, :len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+    blocks, active = sha512.host_pad(arr, lens, nb)
+    out = np.asarray(jax.jit(sha512.sha512_blocks)(blocks, active))
+    return [bytes(out[i].astype(np.uint8)) for i in range(len(msgs))]
+
+
+def test_vectors_and_hashlib():
+    msgs = [
+        b"",
+        b"abc",
+        b"a" * 111,   # exactly fills one block with padding
+        b"a" * 112,   # forces a second block
+        b"a" * 127,
+        b"a" * 128,
+        b"a" * 129,
+        bytes(range(256)),
+    ]
+    got = run_batch(msgs)
+    for m, g in zip(msgs, got):
+        assert g == hashlib.sha512(m).digest(), (len(m), g.hex())
+
+
+def test_random_lengths_mixed_batch():
+    msgs = [rng.bytes(int(n)) for n in rng.integers(0, 300, size=64)]
+    got = run_batch(msgs)
+    for m, g in zip(msgs, got):
+        assert g == hashlib.sha512(m).digest(), len(m)
+
+
+def test_ed25519_shape_hash():
+    # the shape the verify kernel uses: 64-byte prefix + ~150-byte message
+    msgs = [rng.bytes(64 + 150) for _ in range(16)]
+    got = run_batch(msgs)
+    for m, g in zip(msgs, got):
+        assert g == hashlib.sha512(m).digest()
