@@ -1,0 +1,71 @@
+"""Headline benchmark: 10k-validator ExtendedCommit-shaped signature batch.
+
+Mirrors BASELINE.json's metric ("ed25519 sig-verifies/sec/chip; p50
+Commit.VerifyCommit latency @10k vals") and the reference's bench harness
+(``crypto/ed25519/bench_test.go:31-67``, which benches BatchVerify at fixed
+sig counts): 10240 ed25519 signatures over ~120-byte vote-sign-bytes
+messages, verified on the accelerator via the ZIP-215 kernel.
+
+``vs_baseline`` is the measured speedup over the host CPU single-verify
+path (OpenSSL via the `cryptography` library on this machine's core — the
+stand-in for the reference's Go curve25519-voi verifier; voi's batch mode
+is ~2x the single path, so divide by ~2 for a conservative read).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from cometbft_tpu.crypto.keys import verify_ed25519_zip215
+    from cometbft_tpu.ops import ed25519
+    from cometbft_tpu.testing import dense_signature_batch
+
+    nsig = int(os.environ.get("BENCH_NSIG", "10240"))
+    batch_args, host_items = dense_signature_batch(nsig, msg_len=120, seed=2024)
+
+    dev = jax.devices()[0]
+    fn = jax.jit(ed25519.verify_padded)
+    args = jax.device_put(batch_args, dev)
+    out = np.asarray(fn(*args))          # compile + correctness
+    assert out.all(), "benchmark batch failed verification"
+
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        fn(*args)[0].block_until_ready()
+        times.append(time.perf_counter() - t0)
+    p50 = float(np.percentile(times, 50))
+    sigs_per_sec = nsig / p50
+
+    # CPU baseline: host single-verify over a 512-sig sample, extrapolated
+    sample = host_items[:512]
+    t0 = time.perf_counter()
+    for pk, msg, sig in sample:
+        assert verify_ed25519_zip215(pk, msg, sig)
+    cpu_per_sig = (time.perf_counter() - t0) / len(sample)
+    vs_baseline = (cpu_per_sig * nsig) / p50
+
+    print(json.dumps({
+        "metric": "ed25519 sig-verifies/sec/chip (10k-validator extended-commit batch)",
+        "value": round(sigs_per_sec, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(vs_baseline, 2),
+        "p50_batch_latency_ms": round(p50 * 1e3, 3),
+        "batch_size": nsig,
+        "device": str(dev),
+        "cpu_single_verify_us": round(cpu_per_sig * 1e6, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

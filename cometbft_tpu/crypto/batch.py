@@ -1,0 +1,231 @@
+"""Batch signature verification: the TPU execution backend's seam.
+
+Mirrors ``crypto.BatchVerifier`` (``crypto/crypto.go:44-52``) and the
+dispatch in ``crypto/batch/batch.go:10-32``, with the 'tpu' backend the
+reference lacks (the north-star of BASELINE.json): signatures accumulate
+into dense numpy arrays, pad into (batch, hash-block) *buckets* so XLA
+compiles a handful of shapes once, and verify on-device via the vmap'd
+ZIP-215 kernel (``ops/ed25519.py``).  Lanes padded to fill a bucket repeat
+lane 0 and are sliced away on return.
+
+Unlike the reference — whose batch path refuses mixed key types
+(``types/validation.go:18``) — the dispatcher here routes ed25519 lanes to
+the device and anything else to per-signature CPU verification, merging
+results positionally.
+
+Backend selection: ``create_batch_verifier(backend=...)`` with "auto"
+choosing the device backend iff an accelerator is present (the
+``config.Config``-driven selection point; falls back to CPU like the
+reference's pure-Go path).
+"""
+
+from __future__ import annotations
+
+import functools
+from abc import ABC, abstractmethod
+
+import numpy as np
+
+from .keys import ED25519_KEY_TYPE, PubKey, verify_ed25519_zip215
+
+# Batch-size buckets (lanes pad up to the next one; beyond the last, chunks).
+_LANE_BUCKETS = (16, 64, 256, 1024, 4096, 16384)
+# Hash-block buckets (a vote sign-bytes message is ~120 B -> 2 blocks).
+_BLOCK_BUCKETS = (2, 3, 4, 8, 16)
+
+
+class BatchVerifier(ABC):
+    """Accumulate (pubkey, msg, sig) triples; verify all at once.
+
+    ``verify()`` returns ``(all_ok, per_sig)`` like the reference's
+    ``BatchVerifier.Verify`` (crypto/crypto.go:50-51).
+    """
+
+    @abstractmethod
+    def add(self, pub: PubKey, msg: bytes, sig: bytes) -> None: ...
+
+    @abstractmethod
+    def verify(self) -> tuple[bool, list[bool]]: ...
+
+    def __len__(self) -> int:
+        return getattr(self, "_count", 0)
+
+
+class CpuBatchVerifier(BatchVerifier):
+    """Host fallback: per-signature native verification (OpenSSL fast path
+    with exact ZIP-215 recheck), used when no accelerator is present."""
+
+    def __init__(self):
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub, msg, sig):
+        self._items.append((pub, msg, sig))
+
+    @property
+    def _count(self):
+        return len(self._items)
+
+    def verify(self):
+        oks = [p.verify_signature(m, s) for p, m, s in self._items]
+        return all(oks) and len(oks) > 0, oks
+
+
+def _bucket(n: int, buckets) -> int:
+    """Next bucket >= n; beyond the largest, the exact size (a fresh compile
+    for the rare oversized case beats crashing or silent truncation)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+@functools.cache
+def _compiled_verify():
+    """The jitted kernel; jax.jit's own cache handles per-(batch, nb) shapes."""
+    import jax
+
+    from ..ops import ed25519 as _kernel
+
+    return jax.jit(_kernel.verify_padded)
+
+
+def device_verify_ed25519(pubs: np.ndarray, rs: np.ndarray, ss: np.ndarray,
+                          msgs: np.ndarray, msg_lens: np.ndarray,
+                          device=None) -> np.ndarray:
+    """Dense-array entry: verify B ed25519 signatures on device.
+
+    pubs (B,32) u8; rs/ss (B,32) u8 (signature halves); msgs (B,L) u8 padded;
+    msg_lens (B,).  Returns (B,) bool.  Pads lanes/blocks to bucket shapes.
+    """
+    b = pubs.shape[0]
+    if b == 0:
+        return np.zeros((0,), bool)
+    results = np.zeros((b,), bool)
+    # chunk anything beyond the largest bucket
+    cap = _LANE_BUCKETS[-1]
+    for start in range(0, b, cap):
+        end = min(start + cap, b)
+        results[start:end] = _device_verify_chunk(
+            pubs[start:end], rs[start:end], ss[start:end],
+            msgs[start:end], msg_lens[start:end], device)
+    return results
+
+
+def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
+    from ..ops import sha512 as _sha
+
+    b = pubs.shape[0]
+    bb = _bucket(b, _LANE_BUCKETS)
+    # hash input is R || A || M
+    hin = np.zeros((bb, 64 + msgs.shape[1]), np.uint8)
+    hin[:b, :32] = rs
+    hin[:b, 32:64] = pubs
+    hin[:b, 64:] = msgs
+    lens = np.full((bb,), 64, np.int64)
+    lens[:b] = 64 + np.asarray(msg_lens, np.int64)
+    nb = _bucket(int(_sha.max_blocks_for_len(int(lens.max()))), _BLOCK_BUCKETS)
+
+    def pad(a, width):
+        out = np.zeros((bb, width), np.int32)
+        out[:b] = a
+        out[b:] = a[0] if b else 0          # repeat lane 0 into padding
+        return out
+
+    hin[b:] = hin[0]
+    lens[b:] = lens[0]
+    blocks, active = _sha.host_pad(hin, lens, nb)
+    fn = _compiled_verify()
+    args = (pad(pubs, 32), pad(rs, 32), pad(ss, 32), blocks, active)
+    if device is not None:
+        import jax
+        args = jax.device_put(args, device)
+    return np.asarray(fn(*args))[:b]
+
+
+class TpuBatchVerifier(BatchVerifier):
+    """Device-backed batch verifier behind the ``crypto.BatchVerifier`` seam.
+
+    Ed25519 lanes go to the device kernel; other key types verify on CPU
+    (an improvement over the reference, which refuses mixed batches —
+    ``types/validation.go:13-19``).
+    """
+
+    def __init__(self, device=None):
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+        self._device = device
+
+    def add(self, pub, msg, sig):
+        if not isinstance(msg, (bytes, bytearray)):
+            raise TypeError("msg must be bytes")
+        self._items.append((pub, bytes(msg), bytes(sig)))
+
+    @property
+    def _count(self):
+        return len(self._items)
+
+    def verify(self):
+        n = len(self._items)
+        if n == 0:
+            return False, []
+        ed_idx = [i for i, (p, _, s) in enumerate(self._items)
+                  if p.type() == ED25519_KEY_TYPE and len(s) == 64]
+        ed_set = set(ed_idx)
+        oks = [False] * n
+        for i, (p, m, s) in enumerate(self._items):
+            if i not in ed_set:
+                oks[i] = p.verify_signature(m, s)
+        if ed_idx:
+            maxlen = max(len(self._items[i][1]) for i in ed_idx)
+            bsz = len(ed_idx)
+            pubs = np.zeros((bsz, 32), np.uint8)
+            rs = np.zeros((bsz, 32), np.uint8)
+            ss = np.zeros((bsz, 32), np.uint8)
+            msgs = np.zeros((bsz, max(maxlen, 1)), np.uint8)
+            lens = np.zeros((bsz,), np.int64)
+            for j, i in enumerate(ed_idx):
+                p, m, s = self._items[i]
+                pubs[j] = np.frombuffer(p.bytes(), np.uint8)
+                rs[j] = np.frombuffer(s[:32], np.uint8)
+                ss[j] = np.frombuffer(s[32:], np.uint8)
+                msgs[j, :len(m)] = np.frombuffer(m, np.uint8)
+                lens[j] = len(m)
+            dev = device_verify_ed25519(pubs, rs, ss, msgs, lens, self._device)
+            for j, i in enumerate(ed_idx):
+                oks[i] = bool(dev[j])
+        return all(oks), oks
+
+
+def _accelerator_device():
+    """First non-CPU jax device, or None (config-free auto-detection)."""
+    try:
+        import jax
+
+        for d in jax.devices():
+            if d.platform != "cpu":
+                return d
+        return jax.devices()[0]
+    except Exception:
+        return None
+
+
+def supports_batch_verifier(pub: PubKey) -> bool:
+    """Only ed25519 batches on device (crypto/batch/batch.go:21-31 analogue;
+    other key types still *work* in TpuBatchVerifier via the CPU route)."""
+    return pub.type() == ED25519_KEY_TYPE
+
+
+def create_batch_verifier(backend: str = "auto", device=None) -> BatchVerifier:
+    """Backend dispatch (the reference's config.Config selection point).
+
+    backend: "auto" | "tpu" | "jax" | "cpu".
+    """
+    if backend == "cpu":
+        return CpuBatchVerifier()
+    if backend in ("tpu", "jax"):
+        return TpuBatchVerifier(device)
+    if backend == "auto":
+        dev = device if device is not None else _accelerator_device()
+        if dev is not None and getattr(dev, "platform", "cpu") != "cpu":
+            return TpuBatchVerifier(dev)
+        return CpuBatchVerifier()
+    raise ValueError(f"unknown batch-verifier backend {backend!r}")

@@ -1,0 +1,146 @@
+"""Key interfaces and the Ed25519 implementation.
+
+Mirrors the reference's ``crypto.PubKey``/``crypto.PrivKey`` interfaces
+(``crypto/crypto.go:22-43``) and its ed25519 key semantics
+(``crypto/ed25519/ed25519.go``): 32-byte public keys, 64-byte private keys
+(seed || pubkey), addresses = first 20 bytes of SHA-256 of the pubkey,
+and ZIP-215 single-signature verification.
+
+Signing and the single-verify fast path use the ``cryptography`` library's
+native (OpenSSL) Ed25519 — the host-side analogue of the reference's
+curve25519-voi.  OpenSSL's strict verifier accepts a *subset* of ZIP-215
+(cofactorless equation + canonical-encoding checks), so an OpenSSL "reject"
+falls back to the exact pure-Python ZIP-215 check; an OpenSSL "accept" is
+always correct to accept.  Batch verification lives in ``crypto.batch``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from abc import ABC, abstractmethod
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl
+
+from . import _ed25519_py as _ref
+
+ED25519_KEY_TYPE = "ed25519"
+SECP256K1_KEY_TYPE = "secp256k1"
+BLS12381_KEY_TYPE = "bls12_381"
+
+ADDRESS_SIZE = 20
+
+
+def address_hash(b: bytes) -> bytes:
+    """Address = first 20 bytes of SHA-256 (crypto/crypto.go:18)."""
+    return hashlib.sha256(b).digest()[:ADDRESS_SIZE]
+
+
+class PubKey(ABC):
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    @abstractmethod
+    def type(self) -> str: ...
+
+    def address(self) -> bytes:
+        return address_hash(self.bytes())
+
+    def __eq__(self, other):
+        return (isinstance(other, PubKey) and self.type() == other.type()
+                and self.bytes() == other.bytes())
+
+    def __hash__(self):
+        return hash((self.type(), self.bytes()))
+
+    def __repr__(self):
+        return f"PubKey{{{self.type()}:{self.bytes().hex()[:16]}…}}"
+
+
+class PrivKey(ABC):
+    @abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+    @abstractmethod
+    def type(self) -> str: ...
+
+
+def verify_ed25519_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Single ZIP-215 verification on host.
+
+    OpenSSL fast path: its accepts are a subset of ZIP-215's, so a pass is
+    final; only its (rare, adversarial-input) rejects re-check with the exact
+    pure-Python ZIP-215 verifier.
+    """
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    try:
+        _ossl.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        return True
+    except (InvalidSignature, ValueError):
+        return _ref.verify_zip215(pub, msg, sig)
+
+
+class Ed25519PubKey(PubKey):
+    SIZE = 32
+
+    def __init__(self, raw: bytes):
+        if len(raw) != self.SIZE:
+            raise ValueError(f"ed25519 pubkey must be {self.SIZE} bytes")
+        self._raw = bytes(raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return ED25519_KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        return verify_ed25519_zip215(self._raw, msg, sig)
+
+
+class Ed25519PrivKey(PrivKey):
+    """64-byte private key: seed || pubkey (matching the reference layout)."""
+
+    SIZE = 64
+
+    def __init__(self, raw: bytes):
+        if len(raw) == 32:           # accept bare seeds
+            pub = (_ossl.Ed25519PrivateKey.from_private_bytes(raw)
+                   .public_key().public_bytes_raw())
+            raw = raw + pub
+        if len(raw) != self.SIZE:
+            raise ValueError(f"ed25519 privkey must be {self.SIZE} bytes")
+        self._raw = bytes(raw)
+        self._sk = _ossl.Ed25519PrivateKey.from_private_bytes(raw[:32])
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivKey":
+        return cls(os.urandom(32))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Ed25519PrivKey":
+        """Deterministic key from a secret (test helper, like GenPrivKeyFromSecret)."""
+        return cls(hashlib.sha256(secret).digest())
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return ED25519_KEY_TYPE
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._sk.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self._raw[32:])

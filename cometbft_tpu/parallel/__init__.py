@@ -1,0 +1,13 @@
+"""Device meshes and multi-chip sharded execution.
+
+The reference's "distributed compute" is its p2p stack (host networking,
+SURVEY.md §2.7) — the TPU-native analogue for the *compute* path is data
+parallelism over the signature batch axis: signature verification is
+embarrassingly parallel, so sharding the batch across a ``jax.sharding.Mesh``
+scales it across chips with zero collectives (host->device once, one bool
+per lane back).
+"""
+
+from .mesh import batch_mesh, sharded_verify_fn
+
+__all__ = ["batch_mesh", "sharded_verify_fn"]

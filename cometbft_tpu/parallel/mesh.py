@@ -1,0 +1,34 @@
+"""Mesh construction and the sharded batch-verify step.
+
+Scaling model (BASELINE.json: "sharded over chips with pjit"): one mesh
+axis ``batch`` over all chips; every per-lane input array shards on its
+leading axis; outputs shard the same way.  XLA inserts no collectives —
+lanes are independent — so the step scales linearly over ICI-connected
+chips and the driver's virtual CPU mesh alike.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def batch_mesh(devices=None) -> Mesh:
+    """1-D mesh over the given (default: all) devices, axis name 'batch'."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    return Mesh(devs, axis_names=("batch",))
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """jit of the ed25519 verify kernel with every arg sharded on the batch
+    axis of ``mesh``.  The mesh size must divide the batch size (each device
+    takes an equal contiguous slab of lanes)."""
+    from ..ops import ed25519 as _kernel
+
+    lane = NamedSharding(mesh, P("batch"))
+    return jax.jit(
+        _kernel.verify_padded,
+        in_shardings=(lane, lane, lane, lane, lane),
+        out_shardings=lane,
+    )

@@ -1,0 +1,40 @@
+"""Shared test/bench factories (role of the reference's ``internal/test``
+helpers, SURVEY.md §4): deterministic signature batches in the dense layout
+the device kernel consumes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def dense_signature_batch(bsz: int, msg_len: int = 120, seed: int = 7,
+                          n_keys: int | None = None):
+    """Build a valid-signature batch shaped like commit verification.
+
+    Returns ``(kernel_args, host_items)``: kernel_args =
+    (pubs, rs, ss, blocks, active) ready for ``ops.ed25519.verify_padded``;
+    host_items = [(pub_bytes, msg, sig)] for host-side baselines.
+    """
+    from .crypto import _ed25519_py as ref
+    from .ops import sha512
+
+    rng = np.random.default_rng(seed)
+    keys = [rng.bytes(32) for _ in range(min(n_keys or bsz, 256))]
+    keys = [(s, ref.public_key_from_seed(s)) for s in keys]
+    pubs = np.zeros((bsz, 32), np.int32)
+    rs = np.zeros((bsz, 32), np.int32)
+    ss = np.zeros((bsz, 32), np.int32)
+    hin = np.zeros((bsz, 64 + msg_len), np.uint8)
+    lens = np.full((bsz,), 64 + msg_len, np.int64)
+    host_items = []
+    for i in range(bsz):
+        sd, pk = keys[i % len(keys)]
+        msg = rng.bytes(msg_len)
+        sig = ref.sign(sd, msg)
+        pubs[i] = np.frombuffer(pk, np.uint8)
+        rs[i] = np.frombuffer(sig[:32], np.uint8)
+        ss[i] = np.frombuffer(sig[32:], np.uint8)
+        hin[i] = np.frombuffer(sig[:32] + pk + msg, np.uint8)
+        host_items.append((pk, msg, sig))
+    blocks, active = sha512.host_pad(hin, lens, 2)
+    return (pubs, rs, ss, blocks, active), host_items

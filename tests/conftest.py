@@ -27,6 +27,11 @@ import jax  # noqa: E402  (registers factories, does not init backends)
 from jax._src import xla_bridge as _xb  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: kernel compiles dominate suite time on 1 CPU core
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 try:
     _xb._backend_factories.pop("axon", None)
     _xb._backend_factories.pop("tpu", None)

@@ -1,0 +1,139 @@
+"""BatchVerifier seam tests: CPU + device backends, bucketing, mixed keys,
+and the multi-chip sharded path on the virtual 8-device mesh."""
+
+import numpy as np
+
+from cometbft_tpu.crypto import _ed25519_py as ref
+from cometbft_tpu.crypto.batch import (CpuBatchVerifier, TpuBatchVerifier,
+                                       create_batch_verifier,
+                                       device_verify_ed25519,
+                                       supports_batch_verifier)
+from cometbft_tpu.crypto.keys import (Ed25519PrivKey, Ed25519PubKey,
+                                      verify_ed25519_zip215)
+
+rng = np.random.default_rng(7)
+
+
+def make_sigs(n, bad=()):
+    items = []
+    for i in range(n):
+        sk = Ed25519PrivKey.from_secret(b"key%d" % i)
+        m = rng.bytes(int(rng.integers(0, 140)))
+        s = bytearray(sk.sign(m))
+        if i in bad:
+            s[10] ^= 4
+        items.append((sk.pub_key(), m, bytes(s)))
+    return items
+
+
+def test_single_verify_zip215_fallback():
+    # OpenSSL rejects mixed-order/non-canonical inputs; fallback must accept
+    # what the oracle accepts.  Reuse a non-canonical identity key case.
+    P = ref.P
+    r_scalar = 12345
+    r_enc = ref.pt_compress(ref.pt_mul(r_scalar, ref.BASE))
+    ident_nc = (1 + P).to_bytes(32, "little")
+    sig = r_enc + r_scalar.to_bytes(32, "little")
+    assert ref.verify_zip215(ident_nc, b"m", sig)
+    assert verify_ed25519_zip215(ident_nc, b"m", sig)
+    assert Ed25519PubKey(ident_nc).verify_signature(b"m", sig)
+    assert not verify_ed25519_zip215(ident_nc, b"m2", sig[:-1] + b"\x01")
+
+
+def test_cpu_batch_verifier():
+    items = make_sigs(7, bad={3})
+    bv = CpuBatchVerifier()
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, oks = bv.verify()
+    assert not ok and oks == [True, True, True, False, True, True, True]
+
+
+def test_device_batch_verifier_buckets():
+    # odd batch size forces lane padding; verify padding lanes don't leak
+    items = make_sigs(21, bad={0, 20})
+    bv = TpuBatchVerifier()
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, oks = bv.verify()
+    assert not ok
+    assert oks == [i not in (0, 20) for i in range(21)]
+
+    bv2 = TpuBatchVerifier()
+    for p, m, s in make_sigs(5):
+        bv2.add(p, m, s)
+    ok2, oks2 = bv2.verify()
+    assert ok2 and all(oks2)
+
+
+def test_mixed_key_types_route_to_cpu():
+    class FakeKey:
+        def type(self):
+            return "secp256k1"
+
+        def bytes(self):
+            return b"\x02" * 33
+
+        def verify_signature(self, msg, sig):
+            return sig == b"ok"
+
+    items = make_sigs(4)
+    bv = TpuBatchVerifier()
+    bv.add(items[0][0], items[0][1], items[0][2])
+    bv.add(FakeKey(), b"m", b"ok")
+    bv.add(items[1][0], items[1][1], items[1][2])
+    bv.add(FakeKey(), b"m", b"bad")
+    ok, oks = bv.verify()
+    assert oks == [True, True, True, False] and not ok
+    assert supports_batch_verifier(items[0][0])
+    assert not supports_batch_verifier(FakeKey())
+
+
+def test_create_dispatch():
+    assert isinstance(create_batch_verifier("cpu"), CpuBatchVerifier)
+    assert isinstance(create_batch_verifier("tpu"), TpuBatchVerifier)
+    assert isinstance(create_batch_verifier("auto"), CpuBatchVerifier)  # tests run CPU-only
+
+
+def test_dense_entry_empty_and_chunked(monkeypatch):
+    assert device_verify_ed25519(
+        np.zeros((0, 32), np.uint8), np.zeros((0, 32), np.uint8),
+        np.zeros((0, 32), np.uint8), np.zeros((0, 1), np.uint8),
+        np.zeros((0,), np.int64)).shape == (0,)
+
+    # exercise the lane-chunking path with tiny buckets
+    from cometbft_tpu.crypto import batch as batch_mod
+    monkeypatch.setattr(batch_mod, "_LANE_BUCKETS", (4, 8))
+    items = make_sigs(21, bad={0, 9, 20})
+    bv = TpuBatchVerifier()
+    for p, m, s in items:
+        bv.add(p, m, s)
+    ok, oks = bv.verify()
+    assert not ok and oks == [i not in (0, 9, 20) for i in range(21)]
+
+
+def test_oversized_message_exact_bucket():
+    # > 16 hash blocks (msg ~2KB) must verify, not crash on bucket overflow
+    sk = Ed25519PrivKey.from_secret(b"big")
+    m = bytes(rng.integers(0, 256, size=2100, dtype=np.uint8))
+    sig = sk.sign(m)
+    bad = sig[:10] + bytes([sig[10] ^ 1]) + sig[11:]
+    bv = TpuBatchVerifier()
+    bv.add(sk.pub_key(), m, sig)
+    bv.add(sk.pub_key(), m, bad)
+    ok, oks = bv.verify()
+    assert oks[0] is True and oks[1] is False
+
+
+def test_graft_entry_and_multichip():
+    import jax
+
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.shape == (16,) and out.all()
+
+    assert len(jax.devices()) == 8, "conftest should provide 8 virtual devices"
+    ge.dryrun_multichip(8)
+    ge.dryrun_multichip(4)
