@@ -1,0 +1,113 @@
+"""RFC-6962-style merkle trees and proofs.
+
+Reference: ``crypto/merkle/`` — leaf/inner domain separation (0x00/0x01
+prefixes), split at the largest power of two strictly less than n, empty
+tree hashes to SHA-256 of the empty string.  Used for block-part sets, tx
+hashes, header field hashing, validator-set hashing and evidence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _sha(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (n >= 2)."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    n = len(items)
+    if n == 0:
+        return _sha(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]),
+                      hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (crypto/merkle/proof.go semantics)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes] = field(default_factory=list)
+
+    def compute_root(self) -> bytes:
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash,
+                                   self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or self.index < 0 or self.index >= self.total:
+            return False
+        if leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = self.compute_root()
+        return computed is not None and computed == root
+
+
+def _compute_from_aunts(index: int, total: int, leaf: bytes,
+                        aunts: list[bytes]) -> bytes | None:
+    if total == 0 or index >= total:
+        return None
+    if total == 1:
+        return leaf if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, leaf, aunts[:-1])
+        return None if left is None else inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, leaf, aunts[:-1])
+    return None if right is None else inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root hash + one inclusion proof per item."""
+    total = len(items)
+    leaves = [leaf_hash(it) for it in items]
+
+    def build(lo: int, hi: int) -> tuple[bytes, dict[int, list[bytes]]]:
+        n = hi - lo
+        if n == 0:
+            return _sha(b""), {}
+        if n == 1:
+            return leaves[lo], {lo: []}
+        k = _split_point(n)
+        lroot, lpaths = build(lo, lo + k)
+        rroot, rpaths = build(lo + k, hi)
+        paths = {}
+        for i, p in lpaths.items():
+            paths[i] = p + [rroot]
+        for i, p in rpaths.items():
+            paths[i] = p + [lroot]
+        return inner_hash(lroot, rroot), paths
+
+    root, paths = build(0, total)
+    # paths accumulate bottom-up (deepest sibling first), which is exactly
+    # the order _compute_from_aunts consumes (aunts[-1] = topmost).
+    proofs = [Proof(total=total, index=i, leaf_hash=leaves[i],
+                    aunts=paths[i]) for i in range(total)]
+    return root, proofs

@@ -1,0 +1,16 @@
+"""SHA-256 hash helpers (reference: ``crypto/tmhash/hash.go``)."""
+
+from __future__ import annotations
+
+import hashlib
+
+SIZE = 32
+TRUNCATED_SIZE = 20
+
+
+def sum_sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def sum_truncated(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()[:TRUNCATED_SIZE]

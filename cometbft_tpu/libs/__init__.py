@@ -1,0 +1,2 @@
+"""Utility libraries (reference: ``libs/`` + small ``internal/`` packages):
+service lifecycle, logging, pubsub, events, bit arrays, metrics."""

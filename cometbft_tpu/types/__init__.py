@@ -1,0 +1,32 @@
+"""Core domain types: the shared vocabulary of every layer above crypto.
+
+Mirrors the reference's ``types/`` package (SURVEY.md §2.2): Block, Header,
+Vote, Commit/ExtendedCommit, ValidatorSet, PartSet, canonical sign bytes,
+params, evidence — with commit verification routed through the TPU-backed
+``crypto.batch.BatchVerifier`` seam.
+"""
+
+from .block_id import BlockID, PartSetHeader
+from .commit import (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT,
+                     BLOCK_ID_FLAG_NIL, Commit, CommitSig, ExtendedCommit,
+                     ExtendedCommitSig)
+from .header import Block, Data, Header
+from .params import ConsensusParams, default_consensus_params
+from .validator_set import Validator, ValidatorSet
+from .vote import (PRECOMMIT_TYPE, PREVOTE_TYPE, PROPOSAL_TYPE, Proposal,
+                   Vote)
+from .validation import (VerifyCommit, VerifyCommitLight,
+                         VerifyCommitLightAllSignatures,
+                         VerifyCommitLightTrusting,
+                         VerifyCommitLightTrustingAllSignatures)
+
+__all__ = [
+    "BlockID", "PartSetHeader", "Commit", "CommitSig", "ExtendedCommit",
+    "ExtendedCommitSig", "Block", "Data", "Header", "ConsensusParams",
+    "default_consensus_params", "Validator", "ValidatorSet", "Vote",
+    "Proposal", "PREVOTE_TYPE", "PRECOMMIT_TYPE", "PROPOSAL_TYPE",
+    "BLOCK_ID_FLAG_ABSENT", "BLOCK_ID_FLAG_COMMIT", "BLOCK_ID_FLAG_NIL",
+    "VerifyCommit", "VerifyCommitLight", "VerifyCommitLightTrusting",
+    "VerifyCommitLightAllSignatures",
+    "VerifyCommitLightTrustingAllSignatures",
+]

@@ -1,0 +1,186 @@
+"""Commit, CommitSig, ExtendedCommit (reference: ``types/block.go:607-1250``).
+
+A Commit is the aggregated +2/3 precommit for a block: one CommitSig per
+validator (by validator-set index), flagged absent / commit / nil.  The
+ExtendedCommit additionally carries each precommit's vote extension and
+extension signature (ABCI 2.0 vote extensions).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import merkle
+from . import canonical, wire
+from .block_id import BlockID
+from .vote import PRECOMMIT_TYPE, Vote
+
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+
+@dataclass
+class CommitSig:
+    block_id_flag: int = BLOCK_ID_FLAG_ABSENT
+    validator_address: bytes = b""
+    timestamp_ns: int = 0
+    signature: bytes = b""
+
+    @classmethod
+    def absent(cls) -> "CommitSig":
+        return cls()
+
+    def is_absent(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
+
+    def is_commit(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def for_block(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+
+    def block_id(self, commit_block_id: BlockID) -> BlockID:
+        """The BlockID this sig actually signed (commit -> the commit's,
+        nil -> nil, absent -> nil)  (types/block.go CommitSig.BlockID)."""
+        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+            return commit_block_id
+        return BlockID()
+
+    def validate_basic(self) -> str | None:
+        if self.block_id_flag not in (BLOCK_ID_FLAG_ABSENT,
+                                      BLOCK_ID_FLAG_COMMIT,
+                                      BLOCK_ID_FLAG_NIL):
+            return "unknown block ID flag"
+        if self.is_absent():
+            if self.validator_address or self.signature:
+                return "absent sig with address/signature"
+        else:
+            if len(self.validator_address) != 20:
+                return "invalid validator address size"
+            if not self.signature or len(self.signature) > 64:
+                return "signature absent or too big"
+        return None
+
+    def encode(self) -> bytes:
+        return (wire.field_varint(1, self.block_id_flag)
+                + wire.field_bytes(2, self.validator_address)
+                + wire.field_message(3, canonical.encode_timestamp(
+                    self.timestamp_ns), force=True)
+                + wire.field_bytes(4, self.signature))
+
+
+@dataclass
+class Commit:
+    height: int
+    round: int
+    block_id: BlockID
+    signatures: list[CommitSig] = field(default_factory=list)
+
+    def size(self) -> int:
+        return len(self.signatures)
+
+    def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
+        """Reconstructed canonical vote bytes for signature idx
+        (types/block.go:902 VoteSignBytes) — the message the TPU kernel
+        verifies."""
+        cs = self.signatures[idx]
+        return canonical.canonical_vote_sign_bytes(
+            chain_id, PRECOMMIT_TYPE, self.height, self.round,
+            cs.block_id(self.block_id), cs.timestamp_ns)
+
+    def to_vote(self, idx: int) -> Vote:
+        cs = self.signatures[idx]
+        return Vote(type=PRECOMMIT_TYPE, height=self.height, round=self.round,
+                    block_id=cs.block_id(self.block_id),
+                    timestamp_ns=cs.timestamp_ns,
+                    validator_address=cs.validator_address,
+                    validator_index=idx, signature=cs.signature)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [cs.encode() for cs in self.signatures])
+
+    def validate_basic(self) -> str | None:
+        if self.height < 0:
+            return "negative height"
+        if self.round < 0:
+            return "negative round"
+        if self.height >= 1:
+            if self.block_id.is_nil():
+                return "commit cannot be for nil block"
+            if not self.signatures:
+                return "no signatures in commit"
+            for i, cs in enumerate(self.signatures):
+                err = cs.validate_basic()
+                if err:
+                    return f"invalid signature {i}: {err}"
+        return None
+
+    def encode(self) -> bytes:
+        body = (wire.field_varint(1, self.height)
+                + wire.field_varint(2, self.round)
+                + wire.field_message(3, self.block_id.encode(), force=True))
+        for cs in self.signatures:
+            body += wire.field_message(4, cs.encode(), force=True)
+        return body
+
+
+@dataclass
+class ExtendedCommitSig:
+    commit_sig: CommitSig = field(default_factory=CommitSig)
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def validate_basic(self) -> str | None:
+        err = self.commit_sig.validate_basic()
+        if err:
+            return err
+        if self.commit_sig.is_commit():
+            if len(self.extension_signature) > 64:
+                return "extension signature too big"
+        elif self.extension or self.extension_signature:
+            return "extension on non-commit vote"
+        return None
+
+    def ensure_extension(self, ext_enabled: bool) -> bool:
+        """types/block.go EnsureExtensions element check."""
+        if not ext_enabled:
+            return not self.extension and not self.extension_signature
+        if self.commit_sig.is_commit():
+            return len(self.extension_signature) > 0
+        return True
+
+
+@dataclass
+class ExtendedCommit:
+    """Commit + vote extensions (types/block.go:1086)."""
+
+    height: int
+    round: int
+    block_id: BlockID
+    extended_signatures: list[ExtendedCommitSig] = field(default_factory=list)
+
+    def size(self) -> int:
+        return len(self.extended_signatures)
+
+    def to_commit(self) -> Commit:
+        """Strip extensions (types/block.go:1165 ToCommit)."""
+        return Commit(height=self.height, round=self.round,
+                      block_id=self.block_id,
+                      signatures=[e.commit_sig
+                                  for e in self.extended_signatures])
+
+    def ensure_extensions(self, ext_enabled: bool) -> bool:
+        """types/block.go:1154 EnsureExtensions."""
+        return all(e.ensure_extension(ext_enabled)
+                   for e in self.extended_signatures)
+
+    def to_extended_vote(self, idx: int) -> Vote:
+        e = self.extended_signatures[idx]
+        v = Commit(self.height, self.round, self.block_id,
+                   [x.commit_sig for x in self.extended_signatures]
+                   ).to_vote(idx)
+        v.extension = e.extension
+        v.extension_signature = e.extension_signature
+        return v

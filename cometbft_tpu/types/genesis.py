@@ -1,0 +1,144 @@
+"""GenesisDoc (reference: ``types/genesis.go``): chain bootstrap document."""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+
+from ..crypto.keys import Ed25519PubKey, PubKey
+from .params import ConsensusParams, default_consensus_params
+from .validator_set import Validator, ValidatorSet
+
+MAX_CHAIN_ID_LEN = 50
+
+
+class GenesisError(Exception):
+    pass
+
+
+@dataclass
+class GenesisValidator:
+    pub_key: PubKey
+    power: int
+    name: str = ""
+
+
+@dataclass
+class GenesisDoc:
+    chain_id: str
+    genesis_time_ns: int = 0
+    initial_height: int = 1
+    consensus_params: ConsensusParams = field(
+        default_factory=default_consensus_params)
+    validators: list[GenesisValidator] = field(default_factory=list)
+    app_hash: bytes = b""
+    app_state: bytes = b"{}"
+
+    def validate_and_complete(self) -> None:
+        """types/genesis.go ValidateAndComplete."""
+        if not self.chain_id:
+            raise GenesisError("genesis doc must include chain_id")
+        if len(self.chain_id) > MAX_CHAIN_ID_LEN:
+            raise GenesisError("chain_id too long")
+        if self.initial_height < 1:
+            raise GenesisError("initial_height must be >= 1")
+        err = self.consensus_params.validate()
+        if err:
+            raise GenesisError(err)
+        for v in self.validators:
+            if v.power < 0:
+                raise GenesisError("validator power cannot be negative")
+
+    def validator_set(self) -> ValidatorSet:
+        return ValidatorSet([Validator(v.pub_key, v.power)
+                             for v in self.validators])
+
+    # ------------------------------------------------------------- json io
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "chain_id": self.chain_id,
+            "genesis_time_ns": self.genesis_time_ns,
+            "initial_height": self.initial_height,
+            "validators": [{
+                "pub_key": {"type": v.pub_key.type(),
+                            "value": base64.b64encode(
+                                v.pub_key.bytes()).decode()},
+                "power": v.power,
+                "name": v.name,
+            } for v in self.validators],
+            "app_hash": self.app_hash.hex(),
+            "app_state": self.app_state.decode("utf-8", "replace"),
+            "consensus_params": {
+                "block": {"max_bytes": self.consensus_params.block.max_bytes,
+                          "max_gas": self.consensus_params.block.max_gas},
+                "evidence": {
+                    "max_age_num_blocks":
+                        self.consensus_params.evidence.max_age_num_blocks,
+                    "max_age_duration_ns":
+                        self.consensus_params.evidence.max_age_duration_ns,
+                    "max_bytes": self.consensus_params.evidence.max_bytes,
+                },
+                "validator": {
+                    "pub_key_types":
+                        self.consensus_params.validator.pub_key_types,
+                },
+                "version": {"app": self.consensus_params.version.app},
+                "feature": {
+                    "vote_extensions_enable_height":
+                        self.consensus_params.feature
+                            .vote_extensions_enable_height,
+                    "pbts_enable_height":
+                        self.consensus_params.feature.pbts_enable_height,
+                },
+                "synchrony": {
+                    "precision_ns":
+                        self.consensus_params.synchrony.precision_ns,
+                    "message_delay_ns":
+                        self.consensus_params.synchrony.message_delay_ns,
+                },
+            },
+        }, indent=2)
+
+    @classmethod
+    def from_json(cls, s: str) -> "GenesisDoc":
+        d = json.loads(s)
+        params = default_consensus_params()
+        cp = d.get("consensus_params", {})
+
+        def load_into(obj, section: str):
+            for k, v in cp.get(section, {}).items():
+                if hasattr(obj, k):
+                    setattr(obj, k, v)
+
+        load_into(params.block, "block")
+        load_into(params.evidence, "evidence")
+        load_into(params.validator, "validator")
+        load_into(params.version, "version")
+        load_into(params.feature, "feature")
+        load_into(params.synchrony, "synchrony")
+        vals = []
+        for v in d.get("validators", []):
+            if v["pub_key"]["type"] != "ed25519":
+                raise GenesisError("only ed25519 genesis validators supported")
+            vals.append(GenesisValidator(
+                Ed25519PubKey(base64.b64decode(v["pub_key"]["value"])),
+                int(v["power"]), v.get("name", "")))
+        doc = cls(chain_id=d["chain_id"],
+                  genesis_time_ns=d.get("genesis_time_ns", 0),
+                  initial_height=d.get("initial_height", 1),
+                  consensus_params=params, validators=vals,
+                  app_hash=bytes.fromhex(d.get("app_hash", "")),
+                  app_state=d.get("app_state", "{}").encode())
+        doc.validate_and_complete()
+        return doc
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "GenesisDoc":
+        with open(path) as f:
+            return cls.from_json(f.read())

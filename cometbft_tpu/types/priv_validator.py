@@ -1,0 +1,48 @@
+"""PrivValidator interface + in-memory MockPV (reference:
+``types/priv_validator.go``).  The production FilePV with double-sign
+protection lives in ``privval/``."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ..crypto.keys import Ed25519PrivKey, PubKey
+from .vote import Proposal, Vote
+
+
+class PrivValidator(ABC):
+    @abstractmethod
+    def get_pub_key(self) -> PubKey: ...
+
+    @abstractmethod
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool) -> None:
+        """Fills vote.signature (and extension_signature if requested)."""
+
+    @abstractmethod
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None: ...
+
+
+class MockPV(PrivValidator):
+    """Unprotected signer for tests (types/priv_validator.go MockPV)."""
+
+    def __init__(self, priv_key: Ed25519PrivKey | None = None):
+        self.priv_key = priv_key or Ed25519PrivKey.generate()
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "MockPV":
+        return cls(Ed25519PrivKey.from_secret(secret))
+
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote,
+                  sign_extension: bool) -> None:
+        vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
+        if sign_extension:
+            vote.extension_signature = self.priv_key.sign(
+                vote.extension_sign_bytes(chain_id))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        proposal.signature = self.priv_key.sign(
+            proposal.sign_bytes(chain_id))

@@ -1,0 +1,173 @@
+"""Commit verification: the exact seam where the TPU backend enters.
+
+Mirrors ``types/validation.go:13-360``:
+
+- ``VerifyCommit``            — checks every signature (commit AND nil votes),
+                                tallies only for-block power, needs > 2/3.
+- ``VerifyCommitLight``       — verifies commit-flag sigs only, stops once
+                                > 2/3 is tallied (blocksync/light hot path).
+- ``VerifyCommitLightTrusting`` — validators looked up BY ADDRESS in a
+                                (possibly different) trusted set, threshold =
+                                trust-level fraction of the trusted total.
+- ``...AllSignatures`` variants (evidence verification) — no early exit.
+
+All paths route signatures through ``crypto.batch.BatchVerifier``; the
+backend ("auto"/"tpu"/"cpu") comes from ``set_default_backend`` — the
+reference's config.Config-driven selection point.  Where the reference
+falls back to one-by-one verification for mixed key types
+(``shouldBatchVerify``), our device verifier routes non-ed25519 lanes to
+CPU inside the batch instead.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..crypto import batch as cryptobatch
+from .commit import Commit
+from .validator_set import ValidatorSet
+
+_DEFAULT_BACKEND = "auto"
+
+
+def set_default_backend(backend: str) -> None:
+    """Select the signature backend ("auto" | "tpu" | "jax" | "cpu")."""
+    global _DEFAULT_BACKEND
+    _DEFAULT_BACKEND = backend
+
+
+def get_default_backend() -> str:
+    return _DEFAULT_BACKEND
+
+
+class CommitVerificationError(Exception):
+    pass
+
+
+class ErrInvalidCommit(CommitVerificationError):
+    pass
+
+
+class ErrNotEnoughVotingPower(CommitVerificationError):
+    pass
+
+
+class ErrInvalidSignature(CommitVerificationError):
+    def __init__(self, idx: int, msg: str = ""):
+        self.idx = idx
+        super().__init__(msg or f"wrong signature (#{idx})")
+
+
+def _check_commit_basics(vals: ValidatorSet, commit: Commit, height: int,
+                         block_id) -> None:
+    if vals.size() != commit.size():
+        raise ErrInvalidCommit(
+            f"invalid commit: {commit.size()} sigs for {vals.size()} vals")
+    if height != commit.height:
+        raise ErrInvalidCommit(
+            f"invalid commit height {commit.height}, want {height}")
+    if block_id != commit.block_id:
+        raise ErrInvalidCommit("invalid commit: wrong block ID")
+
+
+def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
+            voting_power_needed: int, *, count_all: bool,
+            verify_nil_sigs: bool, lookup_by_address: bool,
+            backend: str | None) -> None:
+    """Shared tally+verify core (types/validation.go verifyCommitBatch).
+
+    count_all=False allows early exit once the tally clears the threshold
+    (remaining signatures are NOT verified — VerifyCommitLight semantics).
+    """
+    bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
+    lanes: list[int] = []          # commit-sig indices added to the batch
+    tally = 0
+    seen: set[bytes] = set()
+
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        if lookup_by_address:
+            vi, val = vals.get_by_address(cs.validator_address)
+            if vi < 0:
+                continue
+            if cs.validator_address in seen:
+                raise ErrInvalidCommit(
+                    f"duplicate validator {cs.validator_address.hex()} in commit")
+            seen.add(cs.validator_address)
+        else:
+            val = vals.get_by_index(idx)
+        if not cs.is_commit() and not verify_nil_sigs:
+            continue
+        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+               cs.signature)
+        lanes.append(idx)
+        if cs.is_commit():
+            tally += val.voting_power
+        if not count_all and tally > voting_power_needed:
+            break
+
+    if len(bv) > 0:
+        ok, oks = bv.verify()
+        if not ok:
+            first_bad = lanes[oks.index(False)]
+            raise ErrInvalidSignature(first_bad)
+    if tally <= voting_power_needed:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tally} <= needed {voting_power_needed}")
+
+
+def VerifyCommit(chain_id: str, vals: ValidatorSet, block_id, height: int,
+                 commit: Commit, backend: str | None = None) -> None:
+    """All signatures verified; > 2/3 of total power must be for block_id
+    (types/validation.go:28)."""
+    _check_commit_basics(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify(chain_id, vals, commit, needed, count_all=True,
+            verify_nil_sigs=True, lookup_by_address=False, backend=backend)
+
+
+def VerifyCommitLight(chain_id: str, vals: ValidatorSet, block_id,
+                      height: int, commit: Commit,
+                      backend: str | None = None) -> None:
+    """Commit-flag signatures only, early exit at > 2/3
+    (types/validation.go:63 — blocksync/light-client hot path)."""
+    _check_commit_basics(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify(chain_id, vals, commit, needed, count_all=False,
+            verify_nil_sigs=False, lookup_by_address=False, backend=backend)
+
+
+def VerifyCommitLightAllSignatures(chain_id: str, vals: ValidatorSet,
+                                   block_id, height: int, commit: Commit,
+                                   backend: str | None = None) -> None:
+    """types/validation.go:96 (evidence path: no early exit)."""
+    _check_commit_basics(vals, commit, height, block_id)
+    needed = vals.total_voting_power() * 2 // 3
+    _verify(chain_id, vals, commit, needed, count_all=True,
+            verify_nil_sigs=False, lookup_by_address=False, backend=backend)
+
+
+def VerifyCommitLightTrusting(chain_id: str, vals: ValidatorSet,
+                              commit: Commit,
+                              trust_level: Fraction = Fraction(1, 3),
+                              backend: str | None = None,
+                              count_all: bool = False) -> None:
+    """Trust-level verification against a possibly different validator set,
+    lookup by address (types/validation.go:127 — light-client skipping
+    verification)."""
+    if trust_level <= 0 or trust_level > 1:
+        raise ValueError("trust level must be in (0, 1]")
+    needed = (vals.total_voting_power() * trust_level.numerator
+              // trust_level.denominator)
+    _verify(chain_id, vals, commit, needed, count_all=count_all,
+            verify_nil_sigs=False, lookup_by_address=True, backend=backend)
+
+
+def VerifyCommitLightTrustingAllSignatures(chain_id: str, vals: ValidatorSet,
+                                           commit: Commit,
+                                           trust_level: Fraction = Fraction(1, 3),
+                                           backend: str | None = None) -> None:
+    """types/validation.go:182 (evidence path)."""
+    VerifyCommitLightTrusting(chain_id, vals, commit, trust_level,
+                              backend=backend, count_all=True)

@@ -1,0 +1,255 @@
+"""Validator and ValidatorSet (reference: ``types/validator.go``,
+``types/validator_set.go``).
+
+Proposer selection is the reference's weighted round-robin over
+*proposer priorities*: each increment adds every validator's voting power
+to its priority, picks the max (ties break to the lower address), and
+charges the winner the total voting power.  Priorities are centered on
+their average and rescaled so the spread stays within
+``2 * total_power`` — all with Go's truncated (toward-zero) integer
+division, which differs from Python's floor division on negatives and is
+consensus-critical (spec/consensus/proposer-selection.md).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..crypto import merkle
+from ..crypto.keys import PubKey
+from . import wire
+
+MAX_TOTAL_VOTING_POWER = (2**63 - 1) // 8
+PRIORITY_WINDOW_SIZE_FACTOR = 2
+
+
+def _go_div(a: int, b: int) -> int:
+    """Integer division truncating toward zero (Go semantics)."""
+    q = abs(a) // abs(b)
+    return -q if (a < 0) != (b < 0) else q
+
+
+def _pubkey_proto(pk: PubKey) -> bytes:
+    """cometbft.crypto.v1.PublicKey oneof: 1=ed25519, 2=secp256k1, 3=bls."""
+    fld = {"ed25519": 1, "secp256k1": 2, "bls12_381": 3}[pk.type()]
+    return wire.field_bytes(fld, pk.bytes(), force=True)
+
+
+@dataclass
+class Validator:
+    pub_key: PubKey
+    voting_power: int
+    proposer_priority: int = 0
+    _address: bytes = field(default=b"", repr=False)
+
+    @property
+    def address(self) -> bytes:
+        if not self._address:
+            self._address = self.pub_key.address()
+        return self._address
+
+    def copy(self) -> "Validator":
+        return replace(self)
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Higher priority wins; ties break to the smaller address."""
+        if self.proposer_priority > other.proposer_priority:
+            return self
+        if self.proposer_priority < other.proposer_priority:
+            return other
+        return self if self.address < other.address else other
+
+    def simple_encode(self) -> bytes:
+        """SimpleValidator proto for set hashing (types/validator.go)."""
+        return (wire.field_message(1, _pubkey_proto(self.pub_key), force=True)
+                + wire.field_varint(2, self.voting_power))
+
+
+class ValidatorSet:
+    """Sorted (by address) validator list + rotating proposer."""
+
+    def __init__(self, validators: list[Validator]):
+        vals = sorted((v.copy() for v in validators),
+                      key=lambda v: v.address)
+        if len({v.address for v in vals}) != len(vals):
+            raise ValueError("duplicate validator address")
+        for v in vals:
+            if v.voting_power < 0:
+                raise ValueError("negative voting power")
+        self.validators: list[Validator] = vals
+        self._total: int | None = None
+        self.proposer: Validator | None = None
+        if vals:
+            self.increment_proposer_priority(1)
+
+    # ----------------------------------------------------------- accessors
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    def total_voting_power(self) -> int:
+        if self._total is None:
+            t = sum(v.voting_power for v in self.validators)
+            if t > MAX_TOTAL_VOTING_POWER:
+                raise ValueError("total voting power exceeds cap")
+            self._total = t
+        return self._total
+
+    def get_by_address(self, addr: bytes) -> tuple[int, Validator | None]:
+        lo, hi = 0, len(self.validators)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self.validators[mid].address < addr:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < len(self.validators) and self.validators[lo].address == addr:
+            return lo, self.validators[lo]
+        return -1, None
+
+    def get_by_index(self, idx: int) -> Validator | None:
+        if 0 <= idx < len(self.validators):
+            return self.validators[idx]
+        return None
+
+    def has_address(self, addr: bytes) -> bool:
+        return self.get_by_address(addr)[0] >= 0
+
+    # ------------------------------------------------------------- hashing
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [v.simple_encode() for v in self.validators])
+
+    # ------------------------------------------------- proposer rotation
+
+    def increment_proposer_priority(self, times: int) -> None:
+        if not self.validators:
+            raise ValueError("empty validator set")
+        if times <= 0:
+            raise ValueError("times must be positive")
+        diff_max = PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power()
+        self._rescale_priorities(diff_max)
+        self._shift_by_avg_proposer_priority()
+        proposer = None
+        for _ in range(times):
+            proposer = self._increment_once()
+        self.proposer = proposer
+
+    def _increment_once(self) -> Validator:
+        for v in self.validators:
+            v.proposer_priority += v.voting_power
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        mostest.proposer_priority -= self.total_voting_power()
+        return mostest
+
+    def _rescale_priorities(self, diff_max: int) -> None:
+        if diff_max <= 0:
+            return
+        prios = [v.proposer_priority for v in self.validators]
+        diff = max(prios) - min(prios)
+        if diff > diff_max:
+            ratio = (diff + diff_max - 1) // diff_max
+            for v in self.validators:
+                v.proposer_priority = _go_div(v.proposer_priority, ratio)
+
+    def _shift_by_avg_proposer_priority(self) -> None:
+        avg = _go_div(sum(v.proposer_priority for v in self.validators),
+                      len(self.validators))
+        for v in self.validators:
+            v.proposer_priority -= avg
+
+    def get_proposer(self) -> Validator:
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer
+
+    def _find_proposer(self) -> Validator:
+        mostest = self.validators[0]
+        for v in self.validators[1:]:
+            mostest = mostest.compare_proposer_priority(v)
+        return mostest
+
+    def copy(self) -> "ValidatorSet":
+        new = ValidatorSet.__new__(ValidatorSet)
+        new.validators = [v.copy() for v in self.validators]
+        new._total = self._total
+        new.proposer = None
+        if self.proposer is not None:
+            idx, _ = self.get_by_address(self.proposer.address)
+            if idx >= 0:
+                new.proposer = new.validators[idx]
+        return new
+
+    def copy_increment_proposer_priority(self, times: int) -> "ValidatorSet":
+        c = self.copy()
+        c.increment_proposer_priority(times)
+        return c
+
+    # --------------------------------------------------------- updates
+
+    def update_with_change_set(self, changes: list[Validator]) -> None:
+        """Apply validator updates/removals (voting_power 0 = remove);
+        reference: types/validator_set.go UpdateWithChangeSet."""
+        if not changes:
+            return
+        by_addr = {}
+        for c in changes:
+            if c.address in by_addr:
+                raise ValueError("duplicate address in changes")
+            by_addr[c.address] = c
+        removals = [a for a, c in by_addr.items() if c.voting_power == 0]
+        updates = {a: c for a, c in by_addr.items() if c.voting_power > 0}
+        for c in by_addr.values():
+            if c.voting_power < 0:
+                raise ValueError("negative voting power in update")
+        for a in removals:
+            if not self.has_address(a):
+                raise ValueError("removing unknown validator")
+
+        cur = {v.address: v for v in self.validators}
+        # New-validator priorities use the total *after updates but before
+        # removals* (validator_set.go:470-501 tvpAfterUpdatesBeforeRemovals) —
+        # removed validators' power still counts at this stage.
+        projected = sum(
+            (updates[a].voting_power if a in updates else v.voting_power)
+            for a, v in cur.items())
+        projected += sum(c.voting_power for a, c in updates.items()
+                         if a not in cur)
+        if projected > MAX_TOTAL_VOTING_POWER:
+            raise ValueError("total voting power would exceed cap")
+
+        for a, c in updates.items():
+            if a in cur:
+                cur[a].voting_power = c.voting_power
+            else:
+                nv = c.copy()
+                # new validators start at -1.125 * projected total
+                nv.proposer_priority = -(projected + (projected >> 3))
+                cur[a] = nv
+        for a in removals:
+            del cur[a]
+        if not cur:
+            raise ValueError("validator set would be empty")
+
+        self.validators = sorted(cur.values(), key=lambda v: v.address)
+        self._total = None
+        self.total_voting_power()
+        self._rescale_priorities(
+            PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())
+        self._shift_by_avg_proposer_priority()
+        if self.proposer is not None:
+            idx, v = self.get_by_address(self.proposer.address)
+            self.proposer = v if idx >= 0 else None
+
+    def validate_basic(self) -> str | None:
+        if not self.validators:
+            return "validator set is empty"
+        if self.proposer is None:
+            return "proposer is not set"
+        return None

@@ -1,0 +1,142 @@
+"""Vote and Proposal (reference: ``types/vote.go``, ``types/proposal.go``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..crypto.keys import PubKey
+from . import canonical, wire
+from .block_id import BlockID
+
+PREVOTE_TYPE = canonical.SIGNED_MSG_TYPE_PREVOTE
+PRECOMMIT_TYPE = canonical.SIGNED_MSG_TYPE_PRECOMMIT
+PROPOSAL_TYPE = canonical.SIGNED_MSG_TYPE_PROPOSAL
+
+MAX_VOTE_EXTENSION_SIZE = 1024 * 1024
+
+
+@dataclass
+class Vote:
+    """A single prevote or precommit.
+
+    ``extension``/``extension_signature`` only appear on precommits when
+    vote extensions are enabled (types/vote.go VerifyVoteAndExtension).
+    """
+
+    type: int
+    height: int
+    round: int
+    block_id: BlockID
+    timestamp_ns: int
+    validator_address: bytes
+    validator_index: int
+    signature: bytes = b""
+    extension: bytes = b""
+    extension_signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.canonical_vote_sign_bytes(
+            chain_id, self.type, self.height, self.round, self.block_id,
+            self.timestamp_ns)
+
+    def extension_sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.canonical_vote_extension_sign_bytes(
+            chain_id, self.height, self.round, self.extension)
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_nil()
+
+    def validate_basic(self) -> str | None:
+        """Returns an error string or None (types/vote.go ValidateBasic)."""
+        if self.type not in (PREVOTE_TYPE, PRECOMMIT_TYPE):
+            return "invalid vote type"
+        if self.height < 1:
+            return "negative or zero height"
+        if self.round < 0:
+            return "negative round"
+        if not self.block_id.is_nil() and not self.block_id.is_complete():
+            return "blockID must be either empty or complete"
+        if len(self.validator_address) != 20:
+            return "invalid validator address size"
+        if self.validator_index < 0:
+            return "negative validator index"
+        if not self.signature:
+            return "signature is missing"
+        if len(self.signature) > 64:
+            return "signature too big"
+        if self.type != PRECOMMIT_TYPE and (self.extension or
+                                            self.extension_signature):
+            return "vote extension on non-precommit"
+        return None
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> bool:
+        """Single-signature verify — the per-gossiped-vote hot path
+        (types/vote.go:235; consensus addVote)."""
+        return pub_key.verify_signature(self.sign_bytes(chain_id),
+                                        self.signature)
+
+    def verify_vote_and_extension(self, chain_id: str, pub_key: PubKey,
+                                  require_extension: bool) -> bool:
+        """types/vote.go:244 VerifyVoteAndExtension."""
+        if not self.verify(chain_id, pub_key):
+            return False
+        if require_extension and self.type == PRECOMMIT_TYPE \
+                and not self.block_id.is_nil():
+            return self.verify_extension(chain_id, pub_key)
+        return True
+
+    def verify_extension(self, chain_id: str, pub_key: PubKey) -> bool:
+        """types/vote.go:265 VerifyExtension."""
+        return pub_key.verify_signature(self.extension_sign_bytes(chain_id),
+                                        self.extension_signature)
+
+    def encode(self) -> bytes:
+        """Wire proto (types.proto Vote) for gossip/WAL."""
+        return (wire.field_varint(1, self.type)
+                + wire.field_varint(2, self.height)
+                + wire.field_varint(3, self.round, force=False)
+                + wire.field_message(4, self.block_id.encode() or b"")
+                + wire.field_message(5, canonical.encode_timestamp(
+                    self.timestamp_ns), force=True)
+                + wire.field_bytes(6, self.validator_address)
+                + wire.field_varint(7, self.validator_index, force=False)
+                + wire.field_bytes(8, self.signature)
+                + wire.field_bytes(9, self.extension)
+                + wire.field_bytes(10, self.extension_signature))
+
+    def copy(self) -> "Vote":
+        return replace(self)
+
+
+@dataclass
+class Proposal:
+    """Block proposal (types/proposal.go)."""
+
+    height: int
+    round: int
+    pol_round: int          # -1 when no proof-of-lock
+    block_id: BlockID
+    timestamp_ns: int
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.canonical_proposal_sign_bytes(
+            chain_id, self.height, self.round, self.pol_round, self.block_id,
+            self.timestamp_ns)
+
+    def validate_basic(self) -> str | None:
+        if self.height < 1:
+            return "negative or zero height"
+        if self.round < 0:
+            return "negative round"
+        if self.pol_round < -1 or self.pol_round >= self.round:
+            return "pol_round must be -1 or in [0, round)"
+        if not self.block_id.is_complete():
+            return "blockID must be complete"
+        if not self.signature:
+            return "signature is missing"
+        return None
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> bool:
+        return pub_key.verify_signature(self.sign_bytes(chain_id),
+                                        self.signature)

@@ -1,0 +1,322 @@
+"""Types-layer tests: wire format, merkle, canonical sign bytes (byte-exact
+vs protoc), validator set rotation, and the VerifyCommit family on both
+backends."""
+
+import hashlib
+import subprocess
+import sys
+import tempfile
+from fractions import Fraction
+from pathlib import Path
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types import (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT,
+                                BLOCK_ID_FLAG_NIL, Block, BlockID, Commit,
+                                CommitSig, Data, Header, PartSetHeader,
+                                Validator, ValidatorSet, VerifyCommit,
+                                VerifyCommitLight, VerifyCommitLightTrusting,
+                                Vote, PRECOMMIT_TYPE)
+from cometbft_tpu.types import canonical, validation, wire
+from cometbft_tpu.types.validation import (ErrInvalidCommit,
+                                           ErrInvalidSignature,
+                                           ErrNotEnoughVotingPower)
+
+CHAIN_ID = "test-chain"
+
+
+# ----------------------------------------------------------------- wire/proto
+
+CANONICAL_PROTO = """
+syntax = "proto3";
+package ct;
+message Timestamp { int64 seconds = 1; int32 nanos = 2; }
+message CanonicalPartSetHeader { uint32 total = 1; bytes hash = 2; }
+message CanonicalBlockID {
+  bytes hash = 1;
+  CanonicalPartSetHeader part_set_header = 2;
+}
+message CanonicalVote {
+  int32 type = 1;
+  sfixed64 height = 2;
+  sfixed64 round = 3;
+  CanonicalBlockID block_id = 4;
+  Timestamp timestamp = 5;
+  string chain_id = 6;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def pb():
+    """Compile the canonical schema with protoc into a temp module."""
+    with tempfile.TemporaryDirectory() as td:
+        proto = Path(td) / "ct.proto"
+        proto.write_text(CANONICAL_PROTO)
+        subprocess.run(["protoc", f"-I{td}", f"--python_out={td}", "ct.proto"],
+                       check=True)
+        sys.path.insert(0, td)
+        try:
+            import ct_pb2  # noqa: F401
+            yield ct_pb2
+        finally:
+            sys.path.remove(td)
+            sys.modules.pop("ct_pb2", None)
+
+
+def test_canonical_vote_byte_exact(pb):
+    bid = BlockID(hash=b"\xaa" * 32,
+                  part_set_header=PartSetHeader(3, b"\xbb" * 32))
+    ts = 1_700_000_000_123_456_789
+    for block_id, h, r in [(bid, 5, 0), (bid, 1 << 40, 7), (BlockID(), 3, 2)]:
+        got = canonical.canonical_vote_sign_bytes(
+            CHAIN_ID, PRECOMMIT_TYPE, h, r, block_id, ts)
+        msg = pb.CanonicalVote()
+        msg.type = PRECOMMIT_TYPE
+        msg.height = h
+        msg.round = r
+        if not block_id.is_nil():
+            msg.block_id.hash = block_id.hash
+            msg.block_id.part_set_header.total = block_id.part_set_header.total
+            msg.block_id.part_set_header.hash = block_id.part_set_header.hash
+        msg.timestamp.seconds = ts // 10**9
+        msg.timestamp.nanos = ts % 10**9
+        msg.chain_id = CHAIN_ID
+        want = msg.SerializeToString()
+        # strip our varint length prefix, compare the body byte-for-byte
+        n = 0
+        shift = 0
+        i = 0
+        while True:
+            b = got[i]
+            n |= (b & 0x7F) << shift
+            shift += 7
+            i += 1
+            if not (b & 0x80):
+                break
+        assert got[i:] == want, (got.hex(), want.hex())
+        assert n == len(want)
+
+
+def test_wire_negative_varint(pb):
+    # negative sfixed64 height is invalid domain-wise, but negative varints
+    # (e.g. pol_round=-1, timestamp seconds pre-1970) must match protobuf
+    msg = pb.Timestamp()
+    msg.seconds = -5
+    assert wire.field_varint(1, -5) == msg.SerializeToString()
+
+
+# -------------------------------------------------------------------- merkle
+
+def test_merkle_rfc6962():
+    # independent expressions of the RFC6962 shape
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    one = merkle.hash_from_byte_slices([b"x"])
+    assert one == hashlib.sha256(b"\x00x").digest()
+    two = merkle.hash_from_byte_slices([b"a", b"b"])
+    assert two == hashlib.sha256(
+        b"\x01" + hashlib.sha256(b"\x00a").digest()
+        + hashlib.sha256(b"\x00b").digest()).digest()
+    # split point: 5 leaves -> left 4, right 1
+    five = merkle.hash_from_byte_slices([b"1", b"2", b"3", b"4", b"5"])
+    left = merkle.hash_from_byte_slices([b"1", b"2", b"3", b"4"])
+    right = merkle.hash_from_byte_slices([b"5"])
+    assert five == hashlib.sha256(b"\x01" + left + right).digest()
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+def test_merkle_proofs(n):
+    items = [bytes([i]) * (i + 1) for i in range(n)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, p in enumerate(proofs):
+        assert p.verify(root, items[i]), (n, i)
+        assert not p.verify(root, items[i] + b"!")
+        if n > 1:
+            assert not p.verify(hashlib.sha256(b"no").digest(), items[i])
+
+
+# ------------------------------------------------------------- validator set
+
+def make_vals(powers, secret_prefix=b"v"):
+    keys = [Ed25519PrivKey.from_secret(secret_prefix + bytes([i]))
+            for i in range(len(powers))]
+    vals = ValidatorSet([Validator(k.pub_key(), p)
+                         for k, p in zip(keys, powers)])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    return vals, by_addr
+
+
+def test_proposer_rotation_weighted():
+    vals, _ = make_vals([1, 2, 3])
+    counts = {}
+    for _ in range(600):
+        p = vals.get_proposer()
+        counts[p.voting_power] = counts.get(p.voting_power, 0) + 1
+        vals.increment_proposer_priority(1)
+    assert counts[1] == 100 and counts[2] == 200 and counts[3] == 300
+
+
+def test_proposer_determinism_and_copy():
+    a, _ = make_vals([5, 5, 5, 10])
+    b, _ = make_vals([5, 5, 5, 10])
+    seq_a = []
+    for _ in range(20):
+        seq_a.append(a.get_proposer().address)
+        a.increment_proposer_priority(1)
+    c = b.copy_increment_proposer_priority(5)
+    for _ in range(20):
+        assert seq_a.pop(0) == b.get_proposer().address
+        b.increment_proposer_priority(1)
+    # copy didn't disturb the original
+    assert c is not b
+
+
+def test_valset_hash_and_updates():
+    vals, _ = make_vals([10, 20, 30])
+    h1 = vals.hash()
+    vals2, _ = make_vals([10, 20, 31])
+    assert h1 != vals2.hash()
+
+    new_key = Ed25519PrivKey.from_secret(b"new").pub_key()
+    vals.update_with_change_set([Validator(new_key, 7)])
+    assert vals.size() == 4
+    idx, v = vals.get_by_address(new_key.address())
+    assert idx >= 0 and v.voting_power == 7
+    # removal
+    vals.update_with_change_set([Validator(new_key, 0)])
+    assert vals.size() == 3 and not vals.has_address(new_key.address())
+    with pytest.raises(ValueError):
+        vals.update_with_change_set([Validator(new_key, 0)])
+
+
+# ------------------------------------------------------------ commit verify
+
+def make_commit(vals, by_addr, height=10, round_=1, *, nil_at=(), absent_at=(),
+                bad_at=(), bid=None):
+    bid = bid or BlockID(b"\xcd" * 32, PartSetHeader(1, b"\xef" * 32))
+    sigs = []
+    for i, v in enumerate(vals.validators):
+        if i in absent_at:
+            sigs.append(CommitSig.absent())
+            continue
+        flag = BLOCK_ID_FLAG_NIL if i in nil_at else BLOCK_ID_FLAG_COMMIT
+        vote_bid = BlockID() if i in nil_at else bid
+        ts = 1_700_000_000_000_000_000 + i
+        sb = canonical.canonical_vote_sign_bytes(
+            CHAIN_ID, PRECOMMIT_TYPE, height, round_, vote_bid, ts)
+        sig = by_addr[v.address].sign(sb)
+        if i in bad_at:
+            sig = sig[:20] + bytes([sig[20] ^ 1]) + sig[21:]
+        sigs.append(CommitSig(flag, v.address, ts, sig))
+    return Commit(height, round_, bid, sigs)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "jax"])
+def test_verify_commit_ok(backend):
+    vals, by_addr = make_vals([10] * 7)
+    commit = make_commit(vals, by_addr, absent_at={0}, nil_at={1})
+    VerifyCommit(CHAIN_ID, vals, commit.block_id, 10, commit, backend=backend)
+    VerifyCommitLight(CHAIN_ID, vals, commit.block_id, 10, commit,
+                      backend=backend)
+
+
+@pytest.mark.parametrize("backend", ["cpu", "jax"])
+def test_verify_commit_bad_sig(backend):
+    vals, by_addr = make_vals([10] * 7)
+    commit = make_commit(vals, by_addr, bad_at={6})
+    with pytest.raises(ErrInvalidSignature) as ei:
+        VerifyCommit(CHAIN_ID, vals, commit.block_id, 10, commit,
+                     backend=backend)
+    assert ei.value.idx == 6
+    # a bad *nil* signature also fails VerifyCommit (verifies all sigs)...
+    commit2 = make_commit(vals, by_addr, nil_at={3}, bad_at={3})
+    with pytest.raises(ErrInvalidSignature):
+        VerifyCommit(CHAIN_ID, vals, commit2.block_id, 10, commit2,
+                     backend=backend)
+    # ...but not VerifyCommitLight (skips nil votes entirely)
+    VerifyCommitLight(CHAIN_ID, vals, commit2.block_id, 10, commit2,
+                      backend=backend)
+
+
+def test_verify_commit_not_enough_power():
+    vals, by_addr = make_vals([10] * 6)
+    # 4 of 6 at 10 power = 40 <= 2/3*60 -> fails (needs STRICTLY more)
+    commit = make_commit(vals, by_addr, nil_at={0}, absent_at={1})
+    with pytest.raises(ErrNotEnoughVotingPower):
+        VerifyCommit(CHAIN_ID, vals, commit.block_id, 10, commit,
+                     backend="cpu")
+    # 5 of 6 passes
+    commit = make_commit(vals, by_addr, nil_at={0})
+    VerifyCommit(CHAIN_ID, vals, commit.block_id, 10, commit, backend="cpu")
+
+
+def test_verify_commit_basics_mismatch():
+    vals, by_addr = make_vals([10] * 4)
+    commit = make_commit(vals, by_addr)
+    with pytest.raises(ErrInvalidCommit):
+        VerifyCommit(CHAIN_ID, vals, commit.block_id, 11, commit,
+                     backend="cpu")
+    with pytest.raises(ErrInvalidCommit):
+        VerifyCommit(CHAIN_ID, vals, BlockID(b"\x01" * 32,
+                                             PartSetHeader(1, b"\x02" * 32)),
+                     10, commit, backend="cpu")
+    small = ValidatorSet(vals.validators[:3])
+    with pytest.raises(ErrInvalidCommit):
+        VerifyCommit(CHAIN_ID, small, commit.block_id, 10, commit,
+                     backend="cpu")
+
+
+@pytest.mark.parametrize("backend", ["cpu", "jax"])
+def test_verify_commit_light_trusting(backend):
+    vals, by_addr = make_vals([10] * 8)
+    commit = make_commit(vals, by_addr)
+    # trusted set: 4 of the original validators + 2 unknown, different powers
+    trusted_vals = [v.copy() for v in vals.validators[:4]]
+    extra, extra_addr = make_vals([10, 10], secret_prefix=b"x")
+    trusted = ValidatorSet(trusted_vals + [v.copy()
+                                           for v in extra.validators])
+    VerifyCommitLightTrusting(CHAIN_ID, trusted, commit,
+                              Fraction(1, 3), backend=backend)
+    with pytest.raises(ErrNotEnoughVotingPower):
+        VerifyCommitLightTrusting(CHAIN_ID, trusted, commit,
+                                  Fraction(1, 1), backend=backend)
+
+
+def test_vote_sign_verify_roundtrip():
+    sk = Ed25519PrivKey.from_secret(b"val")
+    bid = BlockID(b"\x11" * 32, PartSetHeader(2, b"\x22" * 32))
+    v = Vote(type=PRECOMMIT_TYPE, height=3, round=0, block_id=bid,
+             timestamp_ns=1_700_000_000_000_000_000,
+             validator_address=sk.pub_key().address(), validator_index=0)
+    v.signature = sk.sign(v.sign_bytes(CHAIN_ID))
+    assert v.validate_basic() is None
+    assert v.verify(CHAIN_ID, sk.pub_key())
+    assert not v.verify("other-chain", sk.pub_key())
+    v.extension = b"ext-data"
+    v.extension_signature = sk.sign(v.extension_sign_bytes(CHAIN_ID))
+    assert v.verify_extension(CHAIN_ID, sk.pub_key())
+
+
+def test_header_block_hash():
+    vals, by_addr = make_vals([10] * 4)
+    h = Header(chain_id=CHAIN_ID, height=5,
+               time_ns=1_700_000_000_000_000_000,
+               last_block_id=BlockID(b"\x01" * 32,
+                                     PartSetHeader(1, b"\x02" * 32)),
+               validators_hash=vals.hash(), next_validators_hash=vals.hash(),
+               proposer_address=vals.get_proposer().address)
+    b = Block(header=h, data=Data(txs=[b"tx1", b"tx2"]),
+              last_commit=make_commit(vals, by_addr, height=4))
+    b.fill_hashes()
+    assert b.validate_basic() is None
+    h1 = b.hash()
+    assert len(h1) == 32
+    b.data.txs.append(b"tx3")
+    b.fill_hashes()
+    assert b.hash() != h1
+    # tampering with data without refreshing hashes is caught
+    b.data.txs.append(b"tx4")
+    assert b.validate_basic() == "wrong data_hash"

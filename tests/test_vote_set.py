@@ -1,0 +1,204 @@
+"""VoteSet, PartSet, BitArray, evidence, genesis tests."""
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.types import (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT,
+                                BlockID, PartSetHeader, Validator,
+                                ValidatorSet, Vote, PRECOMMIT_TYPE,
+                                PREVOTE_TYPE)
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSet, PartSetError
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote_set import (ConflictingVoteError, VoteSet,
+                                         VoteSetError)
+
+CHAIN_ID = "vs-chain"
+BID = BlockID(b"\x0a" * 32, PartSetHeader(1, b"\x0b" * 32))
+BID2 = BlockID(b"\x0c" * 32, PartSetHeader(1, b"\x0d" * 32))
+
+
+def setup_vals(n, power=10):
+    pvs = [MockPV.from_secret(b"pv%d" % i) for i in range(n)]
+    vals = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    ordered = []
+    for v in vals.validators:
+        ordered.append(next(p for p in pvs
+                            if p.get_pub_key().address() == v.address))
+    return vals, ordered
+
+
+def make_vote(pv, vals, idx, bid, typ=PRECOMMIT_TYPE, height=3, round_=0,
+              ts=1_700_000_000_000_000_000):
+    v = Vote(type=typ, height=height, round=round_, block_id=bid,
+             timestamp_ns=ts, validator_address=pv.get_pub_key().address(),
+             validator_index=idx)
+    pv.sign_vote(CHAIN_ID, v, sign_extension=False)
+    return v
+
+
+def test_vote_set_majority_and_commit():
+    vals, pvs = setup_vals(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+    assert not vs.has_two_thirds_any()
+    for i in range(3):
+        assert vs.add_vote(make_vote(pvs[i], vals, i, BID))
+        if i < 2:
+            assert not vs.has_two_thirds_majority()
+    assert vs.has_two_thirds_majority()
+    maj, ok = vs.two_thirds_majority()
+    assert ok and maj == BID
+
+    commit = vs.make_commit()
+    assert commit.height == 3 and commit.block_id == BID
+    assert commit.size() == 4
+    assert commit.signatures[3].block_id_flag == BLOCK_ID_FLAG_ABSENT
+    flags = [cs.block_id_flag for cs in commit.signatures[:3]]
+    assert flags == [BLOCK_ID_FLAG_COMMIT] * 3
+    # commit verifies against the validator set
+    from cometbft_tpu.types import VerifyCommit
+    VerifyCommit(CHAIN_ID, vals, BID, 3, commit, backend="cpu")
+
+
+def test_vote_set_rejects():
+    vals, pvs = setup_vals(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PRECOMMIT_TYPE, vals)
+    good = make_vote(pvs[0], vals, 0, BID)
+    assert vs.add_vote(good)
+    assert not vs.add_vote(good)          # duplicate -> False, no error
+    with pytest.raises(VoteSetError):      # wrong height
+        vs.add_vote(make_vote(pvs[1], vals, 1, BID, height=4))
+    with pytest.raises(VoteSetError):      # index/address mismatch
+        bad = make_vote(pvs[1], vals, 2, BID)
+        vs.add_vote(bad)
+    with pytest.raises(VoteSetError):      # bad signature
+        v = make_vote(pvs[1], vals, 1, BID)
+        v.signature = v.signature[:-1] + bytes([v.signature[-1] ^ 1])
+        vs.add_vote(v)
+
+
+def test_vote_set_conflicting_votes_surface_for_evidence():
+    vals, pvs = setup_vals(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+    v1 = make_vote(pvs[0], vals, 0, BID, typ=PREVOTE_TYPE)
+    v2 = make_vote(pvs[0], vals, 0, BID2, typ=PREVOTE_TYPE)
+    assert vs.add_vote(v1)
+    with pytest.raises(ConflictingVoteError) as ce:
+        vs.add_vote(v2)
+    ev = DuplicateVoteEvidence.from_votes(ce.value.existing, ce.value.new,
+                                          1234, vals)
+    assert ev.validate_basic() is None
+    assert ev.validator_power == 10 and ev.total_voting_power == 40
+
+
+def test_vote_set_peer_maj23_tracks_conflicts():
+    vals, pvs = setup_vals(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+    assert vs.add_vote(make_vote(pvs[0], vals, 0, BID, typ=PREVOTE_TYPE))
+    vs.set_peer_maj23("peer1", BID2)
+    with pytest.raises(ConflictingVoteError):
+        vs.add_vote(make_vote(pvs[0], vals, 0, BID2, typ=PREVOTE_TYPE))
+    ba = vs.bit_array_by_block_id(BID2)
+    assert ba is not None and ba.get_index(0)   # tracked under declared maj23
+    with pytest.raises(VoteSetError):
+        vs.set_peer_maj23("peer1", BID)         # changed claim
+
+
+def test_part_set_roundtrip_and_proofs():
+    data = bytes(range(256)) * 1024           # 256 KB -> 4 parts
+    ps = PartSet.from_data(data)
+    assert ps.total == 4 and ps.is_complete()
+    header = ps.header()
+
+    rx = PartSet(header)
+    assert not rx.is_complete()
+    for i in (2, 0, 3, 1):
+        assert rx.add_part(ps.get_part(i))
+    assert rx.is_complete() and rx.get_data() == data
+
+    rx2 = PartSet(header)
+    bad = ps.get_part(1)
+    tampered = type(bad)(1, bad.bytes_[:-1] + b"\x00", bad.proof)
+    with pytest.raises(PartSetError):
+        rx2.add_part(tampered)
+
+    tiny = PartSet.from_data(b"x")
+    assert tiny.total == 1
+    rt = PartSet(tiny.header())
+    assert rt.add_part(tiny.get_part(0)) and rt.get_data() == b"x"
+
+
+def test_bit_array():
+    b = BitArray(10)
+    assert b.is_empty() and not b.is_full()
+    b.set_index(3, True)
+    b.set_index(9, True)
+    assert b.get_true_indices() == [3, 9]
+    c = b.copy()
+    c.set_index(3, False)
+    assert b.get_index(3) and not c.get_index(3)
+    assert b.sub(c).get_true_indices() == [3]
+    assert b.or_(c).get_true_indices() == [3, 9]
+    idx, ok = b.pick_random()
+    assert ok and idx in (3, 9)
+    full = BitArray.from_indices(3, [0, 1, 2])
+    assert full.is_full()
+
+
+def test_genesis_roundtrip(tmp_path):
+    pvs = [MockPV.from_secret(b"g%d" % i) for i in range(3)]
+    doc = GenesisDoc(chain_id="genesis-chain",
+                     validators=[GenesisValidator(p.get_pub_key(), 5)
+                                 for p in pvs])
+    doc.consensus_params.feature.vote_extensions_enable_height = 100
+    path = str(tmp_path / "genesis.json")
+    doc.save(path)
+    doc2 = GenesisDoc.load(path)
+    assert doc2.chain_id == "genesis-chain"
+    assert doc2.validator_set().hash() == doc.validator_set().hash()
+    assert doc2.consensus_params.feature.vote_extensions_enable_height == 100
+
+
+def test_genesis_roundtrip_all_params(tmp_path):
+    doc = GenesisDoc(chain_id="p-chain")
+    doc.consensus_params.evidence.max_age_num_blocks = 50_000
+    doc.consensus_params.synchrony.precision_ns = 123
+    doc.consensus_params.block.max_gas = 777
+    path = str(tmp_path / "g.json")
+    doc.save(path)
+    doc2 = GenesisDoc.load(path)
+    assert doc2.consensus_params.evidence.max_age_num_blocks == 50_000
+    assert doc2.consensus_params.synchrony.precision_ns == 123
+    assert doc2.consensus_params.block.max_gas == 777
+    assert doc2.consensus_params.hash() == doc.consensus_params.hash()
+
+
+def test_peer_maj23_conflicts_can_promote():
+    vals, pvs = setup_vals(4)
+    vs = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals)
+    # all four first vote for BID... but peers claim BID2 has maj23
+    vs.set_peer_maj23("p", BID2)
+    for i in range(4):
+        assert vs.add_vote(make_vote(pvs[i], vals, i, BID, typ=PREVOTE_TYPE))
+    # oops: BID already promoted (4/4). build a fresh set where only 1 votes BID
+    vals2, pvs2 = setup_vals(4, power=10)
+    vs2 = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals2)
+    vs2.set_peer_maj23("p", BID2)
+    for i in range(3):
+        assert vs2.add_vote(make_vote(pvs2[i], vals2, i, BID,
+                                      typ=PREVOTE_TYPE))
+    # equivocators now vote BID2; conflicts tracked AND promote BID2? they
+    # can't outnumber BID... use a set where BID never got 2/3:
+    vals3, pvs3 = setup_vals(4, power=10)
+    vs3 = VoteSet(CHAIN_ID, 3, 0, PREVOTE_TYPE, vals3)
+    vs3.set_peer_maj23("p", BID2)
+    assert vs3.add_vote(make_vote(pvs3[0], vals3, 0, BID, typ=PREVOTE_TYPE))
+    assert vs3.add_vote(make_vote(pvs3[1], vals3, 1, BID2, typ=PREVOTE_TYPE))
+    assert vs3.add_vote(make_vote(pvs3[2], vals3, 2, BID2, typ=PREVOTE_TYPE))
+    # validator 0 equivocates to BID2 -> conflict, but tracked: 3 x 10 = 30 > 2/3*40
+    with pytest.raises(ConflictingVoteError):
+        vs3.add_vote(make_vote(pvs3[0], vals3, 0, BID2, typ=PREVOTE_TYPE))
+    maj, ok = vs3.two_thirds_majority()
+    assert ok and maj == BID2
