@@ -1,0 +1,33 @@
+"""ABCI 2.0: the application interface (reference: ``abci/``).
+
+14 methods (``abci/types/application.go:9-35``): Info, Query, CheckTx,
+InitChain, PrepareProposal, ProcessProposal, FinalizeBlock, ExtendVote,
+VerifyVoteExtension, Commit, ListSnapshots, OfferSnapshot,
+LoadSnapshotChunk, ApplySnapshotChunk.
+"""
+
+from .types import (CheckTxResponse, CommitResponse, Event, EventAttribute,
+                    ExecTxResult, ExtendVoteResponse, FinalizeBlockRequest,
+                    FinalizeBlockResponse, InfoResponse, InitChainRequest,
+                    InitChainResponse, Misbehavior, PrepareProposalRequest,
+                    PrepareProposalResponse, ProcessProposalRequest,
+                    QueryResponse, Snapshot, ValidatorUpdate,
+                    VerifyVoteExtensionResponse, CODE_TYPE_OK,
+                    PROCESS_PROPOSAL_ACCEPT, PROCESS_PROPOSAL_REJECT,
+                    VERIFY_VOTE_EXT_ACCEPT, VERIFY_VOTE_EXT_REJECT,
+                    OFFER_SNAPSHOT_ACCEPT, OFFER_SNAPSHOT_REJECT,
+                    APPLY_CHUNK_ACCEPT)
+from .application import Application
+
+__all__ = [
+    "Application", "CheckTxResponse", "CommitResponse", "Event",
+    "EventAttribute", "ExecTxResult", "ExtendVoteResponse",
+    "FinalizeBlockRequest", "FinalizeBlockResponse", "InfoResponse",
+    "InitChainRequest", "InitChainResponse", "Misbehavior",
+    "PrepareProposalRequest", "PrepareProposalResponse",
+    "ProcessProposalRequest", "QueryResponse", "Snapshot",
+    "ValidatorUpdate", "VerifyVoteExtensionResponse", "CODE_TYPE_OK",
+    "PROCESS_PROPOSAL_ACCEPT", "PROCESS_PROPOSAL_REJECT",
+    "VERIFY_VOTE_EXT_ACCEPT", "VERIFY_VOTE_EXT_REJECT",
+    "OFFER_SNAPSHOT_ACCEPT", "OFFER_SNAPSHOT_REJECT", "APPLY_CHUNK_ACCEPT",
+]

@@ -1,0 +1,201 @@
+"""ABCI request/response types (reference: ``abci/types/types.pb.go``
+surface, slimmed to the fields consensus/mempool/sync actually use)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+CODE_TYPE_OK = 0
+
+PROCESS_PROPOSAL_ACCEPT = 1
+PROCESS_PROPOSAL_REJECT = 2
+VERIFY_VOTE_EXT_ACCEPT = 1
+VERIFY_VOTE_EXT_REJECT = 2
+OFFER_SNAPSHOT_ACCEPT = 1
+OFFER_SNAPSHOT_REJECT = 2
+OFFER_SNAPSHOT_REJECT_FORMAT = 3
+OFFER_SNAPSHOT_REJECT_SENDER = 4
+APPLY_CHUNK_ACCEPT = 1
+APPLY_CHUNK_ABORT = 2
+APPLY_CHUNK_RETRY = 3
+
+
+@dataclass
+class EventAttribute:
+    key: str
+    value: str
+    index: bool = True
+
+
+@dataclass
+class Event:
+    type: str
+    attributes: list[EventAttribute] = field(default_factory=list)
+
+
+@dataclass
+class ExecTxResult:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    gas_wanted: int = 0
+    gas_used: int = 0
+    events: list[Event] = field(default_factory=list)
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+    def encode(self) -> bytes:
+        """Deterministic encoding for last_results_hash."""
+        from ..types import wire
+
+        return (wire.field_varint(1, self.code)
+                + wire.field_bytes(2, self.data)
+                + wire.field_varint(5, self.gas_wanted)
+                + wire.field_varint(6, self.gas_used))
+
+
+@dataclass
+class ValidatorUpdate:
+    pub_key_type: str
+    pub_key_bytes: bytes
+    power: int
+
+
+@dataclass
+class Misbehavior:
+    type: str                 # "DUPLICATE_VOTE" | "LIGHT_CLIENT_ATTACK"
+    validator_address: bytes
+    validator_power: int
+    height: int
+    time_ns: int
+    total_voting_power: int
+
+
+@dataclass
+class Snapshot:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+
+@dataclass
+class InfoResponse:
+    data: str = ""
+    version: str = ""
+    app_version: int = 0
+    last_block_height: int = 0
+    last_block_app_hash: bytes = b""
+
+
+@dataclass
+class QueryResponse:
+    code: int = CODE_TYPE_OK
+    log: str = ""
+    key: bytes = b""
+    value: bytes = b""
+    height: int = 0
+
+
+@dataclass
+class CheckTxResponse:
+    code: int = CODE_TYPE_OK
+    data: bytes = b""
+    log: str = ""
+    gas_wanted: int = 0
+
+    @property
+    def is_ok(self) -> bool:
+        return self.code == CODE_TYPE_OK
+
+
+@dataclass
+class InitChainRequest:
+    chain_id: str
+    initial_height: int
+    time_ns: int
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_state_bytes: bytes = b""
+    consensus_params: object = None
+
+
+@dataclass
+class InitChainResponse:
+    validators: list[ValidatorUpdate] = field(default_factory=list)
+    app_hash: bytes = b""
+    consensus_params: object = None
+
+
+@dataclass
+class PrepareProposalRequest:
+    max_tx_bytes: int
+    txs: list[bytes]
+    height: int
+    time_ns: int
+    proposer_address: bytes = b""
+    local_last_commit: object = None
+    misbehavior: list[Misbehavior] = field(default_factory=list)
+
+
+@dataclass
+class PrepareProposalResponse:
+    txs: list[bytes] = field(default_factory=list)
+
+
+@dataclass
+class ProcessProposalRequest:
+    txs: list[bytes]
+    height: int
+    time_ns: int
+    hash: bytes = b""
+    proposer_address: bytes = b""
+    misbehavior: list[Misbehavior] = field(default_factory=list)
+
+
+@dataclass
+class FinalizeBlockRequest:
+    txs: list[bytes]
+    height: int
+    time_ns: int
+    hash: bytes = b""
+    proposer_address: bytes = b""
+    decided_last_commit: object = None
+    misbehavior: list[Misbehavior] = field(default_factory=list)
+    syncing_to_height: int = 0
+
+
+@dataclass
+class FinalizeBlockResponse:
+    events: list[Event] = field(default_factory=list)
+    tx_results: list[ExecTxResult] = field(default_factory=list)
+    validator_updates: list[ValidatorUpdate] = field(default_factory=list)
+    consensus_param_updates: object = None
+    app_hash: bytes = b""
+
+    def results_hash(self) -> bytes:
+        from ..crypto import merkle
+
+        return merkle.hash_from_byte_slices(
+            [r.encode() for r in self.tx_results])
+
+
+@dataclass
+class ExtendVoteResponse:
+    vote_extension: bytes = b""
+
+
+@dataclass
+class VerifyVoteExtensionResponse:
+    status: int = VERIFY_VOTE_EXT_ACCEPT
+
+    @property
+    def accepted(self) -> bool:
+        return self.status == VERIFY_VOTE_EXT_ACCEPT
+
+
+@dataclass
+class CommitResponse:
+    retain_height: int = 0

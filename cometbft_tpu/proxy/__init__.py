@@ -1,0 +1,7 @@
+"""AppConns: four logical ABCI connections sharing one client
+(reference: ``proxy/multi_app_conn.go`` — consensus, mempool, query,
+snapshot)."""
+
+from .multi_app_conn import AppConns, ClientCreator, local_client_creator
+
+__all__ = ["AppConns", "ClientCreator", "local_client_creator"]

@@ -1,0 +1,55 @@
+"""Multiplexed application connections (reference: ``proxy/``).
+
+The reference maintains four logical connections (consensus, mempool,
+query, snapshot — ``proxy/multi_app_conn.go``) so mempool CheckTx traffic
+can't head-of-line-block consensus.  Here each logical connection is its own
+client instance (its own lock / socket), produced by a ClientCreator
+(``proxy/client.go:16`` analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Awaitable, Callable
+
+from ..abci.application import Application
+from ..abci.client import ABCIClient, LocalClient, SocketClient
+
+ClientCreator = Callable[[], Awaitable[ABCIClient]]
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    """All four connections share the app; each gets its own lock —
+    UNSYNCED local semantics per connection, serialized within one."""
+
+    async def create() -> ABCIClient:
+        return LocalClient(app)
+
+    return create
+
+
+def socket_client_creator(host: str = "127.0.0.1", port: int = 26658,
+                          unix_path: str | None = None) -> ClientCreator:
+    async def create() -> ABCIClient:
+        return await SocketClient.connect(host, port, unix_path)
+
+    return create
+
+
+class AppConns:
+    def __init__(self, creator: ClientCreator):
+        self._creator = creator
+        self.consensus: ABCIClient | None = None
+        self.mempool: ABCIClient | None = None
+        self.query: ABCIClient | None = None
+        self.snapshot: ABCIClient | None = None
+
+    async def start(self) -> None:
+        self.consensus = await self._creator()
+        self.mempool = await self._creator()
+        self.query = await self._creator()
+        self.snapshot = await self._creator()
+
+    async def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            if c is not None:
+                await c.close()

@@ -1,0 +1,13 @@
+"""Storage layer (reference: ``store/``, ``state/store.go``, cometbft-db).
+
+KV abstraction with an in-memory backend and a crash-safe append-only log
+backend; BlockStore and StateStore above it.  A C++ KV engine slots in
+behind the same ``KVStore`` interface (SURVEY.md §2.9 item 3).
+"""
+
+from .db import KVStore, MemDB, LogDB, open_db
+from .blockstore import BlockStore, BlockMeta
+from .statestore import State, StateStore
+
+__all__ = ["KVStore", "MemDB", "LogDB", "open_db", "BlockStore", "BlockMeta",
+           "State", "StateStore"]

@@ -1,0 +1,132 @@
+"""BlockStore (reference: ``store/store.go:46``): persisted blocks, part
+sets, commits and seen-commits, keyed by height with a height-ordered key
+layout (the reference's storage study found height-ordered keys keep
+throughput under pruning, ``docs/references/storage/README.md:202``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import msgpack
+
+from ..types import codec
+from ..types.block_id import BlockID
+from ..types.commit import Commit, ExtendedCommit
+from ..types.header import Block
+from ..types.part_set import PartSet
+from .db import KVStore, height_key as _hkey
+
+
+K_BLOCK = b"B/"
+K_COMMIT = b"C/"          # canonical commit for height (from block H+1 or seen)
+K_SEEN_COMMIT = b"SC"     # latest seen commit (one record)
+K_EXT_COMMIT = b"EC/"
+K_META = b"M/"
+K_STATE = b"BSJ"          # base/height bookkeeping
+
+
+@dataclass
+class BlockMeta:
+    block_id: BlockID
+    block_size: int
+    num_txs: int
+    header_height: int
+
+
+class BlockStore:
+    def __init__(self, db: KVStore):
+        self.db = db
+        raw = db.get(K_STATE)
+        if raw:
+            d = msgpack.unpackb(raw, raw=False)
+            self._base, self._height = d["base"], d["height"]
+        else:
+            self._base = self._height = 0
+
+    def base(self) -> int:
+        return self._base
+
+    def height(self) -> int:
+        return self._height
+
+    def size(self) -> int:
+        return self._height - self._base + 1 if self._height else 0
+
+    def _save_bookkeeping(self):
+        self.db.set(K_STATE, msgpack.packb(
+            {"base": self._base, "height": self._height}))
+
+    def save_block(self, block: Block, parts: PartSet,
+                   seen_commit: Commit) -> None:
+        h = block.header.height
+        if h != self._height + 1 and self._height != 0:
+            raise ValueError(
+                f"non-contiguous block save: {h} after {self._height}")
+        bid = BlockID(block.hash(), parts.header())
+        if self._base == 0:
+            self._base = h
+        self._height = h
+        batch: dict[bytes, bytes] = {
+            _hkey(K_BLOCK, h): codec.pack(block),
+            _hkey(K_META, h): msgpack.packb({
+                "bid": codec.to_dict(bid), "size": parts.byte_size,
+                "ntxs": len(block.data.txs), "h": h}),
+            K_SEEN_COMMIT: codec.pack(seen_commit),
+            K_STATE: msgpack.packb({"base": self._base,
+                                    "height": self._height}),
+        }
+        if block.last_commit is not None:
+            batch[_hkey(K_COMMIT, h - 1)] = codec.pack(block.last_commit)
+        # single grouped write: one fsync on LogDB, no torn bookkeeping
+        self.db.set_batch(batch)
+
+    def save_block_with_extended_commit(self, block: Block, parts: PartSet,
+                                        seen_ext: ExtendedCommit) -> None:
+        self.save_block(block, parts, seen_ext.to_commit())
+        self.db.set(_hkey(K_EXT_COMMIT, block.header.height),
+                    codec.pack(seen_ext))
+
+    def load_block(self, height: int) -> Block | None:
+        raw = self.db.get(_hkey(K_BLOCK, height))
+        return codec.unpack(raw) if raw else None
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self.db.get(_hkey(K_META, height))
+        if not raw:
+            return None
+        d = msgpack.unpackb(raw, raw=False)
+        return BlockMeta(codec.from_dict(d["bid"]), d["size"], d["ntxs"],
+                         d["h"])
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """The canonical commit for ``height`` (stored from block h+1's
+        LastCommit)."""
+        raw = self.db.get(_hkey(K_COMMIT, height))
+        return codec.unpack(raw) if raw else None
+
+    def load_seen_commit(self) -> Commit | None:
+        raw = self.db.get(K_SEEN_COMMIT)
+        return codec.unpack(raw) if raw else None
+
+    def load_block_extended_commit(self, height: int) -> ExtendedCommit | None:
+        raw = self.db.get(_hkey(K_EXT_COMMIT, height))
+        return codec.unpack(raw) if raw else None
+
+    def prune_blocks(self, retain_height: int) -> int:
+        """Remove blocks below retain_height (store/store.go PruneBlocks);
+        returns number pruned.  Errors past the store height like the
+        reference (cannot prune what was never stored)."""
+        if retain_height <= self._base:
+            return 0
+        if retain_height > self._height + 1:
+            raise ValueError(
+                f"retain height {retain_height} beyond store height "
+                f"{self._height}")
+        pruned = 0
+        for h in range(self._base, retain_height):
+            for prefix in (K_BLOCK, K_META, K_COMMIT, K_EXT_COMMIT):
+                self.db.delete(_hkey(prefix, h))
+            pruned += 1
+        self._base = retain_height
+        self._save_bookkeeping()
+        return pruned

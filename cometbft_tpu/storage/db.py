@@ -1,0 +1,222 @@
+"""Key-value store abstraction (reference: the cometbft-db interface —
+Get/Set/Delete/Iterator/Batch over pluggable backends, ``go.mod:10``).
+
+Backends: ``MemDB`` (tests, light stores) and ``LogDB`` — a crash-safe
+append-only record log with an in-memory index and size-triggered
+compaction (the pure-host analogue of goleveldb for round 1; the C++
+engine replaces it behind this same interface).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from abc import ABC, abstractmethod
+
+
+class KVStore(ABC):
+    @abstractmethod
+    def get(self, key: bytes) -> bytes | None: ...
+
+    @abstractmethod
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    @abstractmethod
+    def delete(self, key: bytes) -> None: ...
+
+    @abstractmethod
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        """Yield (key, value) sorted ascending, key in [start, end)."""
+
+    @abstractmethod
+    def close(self) -> None: ...
+
+    def set_batch(self, items: dict[bytes, bytes | None]) -> None:
+        """Grouped write: None value = delete.  Backends may override to
+        make this a single durable append (LogDB: one fsync)."""
+        for k, v in items.items():
+            if v is None:
+                self.delete(k)
+            else:
+                self.set(k, v)
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+
+def height_key(prefix: bytes, height: int) -> bytes:
+    """Height-ordered key layout shared by block/state stores (the layout
+    the reference's storage study found keeps pruning cheap)."""
+    return prefix + height.to_bytes(8, "big")
+
+
+class MemDB(KVStore):
+    def __init__(self):
+        self._data: dict[bytes, bytes] = {}
+
+    def get(self, key):
+        return self._data.get(key)
+
+    def set(self, key, value):
+        self._data[bytes(key)] = bytes(value)
+
+    def delete(self, key):
+        self._data.pop(key, None)
+
+    def iterate(self, start=b"", end=None):
+        for k in sorted(self._data):
+            if k < start:
+                continue
+            if end is not None and k >= end:
+                break
+            yield k, self._data[k]
+
+    def close(self):
+        pass
+
+
+# LogDB record: u32 crc | u32 klen | u32 vlen(or 0xFFFFFFFF tombstone) | k | v
+_HDR = struct.Struct("<III")
+_TOMBSTONE = 0xFFFFFFFF
+
+
+class LogDB(KVStore):
+    """Append-only log + in-memory index; corrupt/torn tails are truncated
+    on open (crash safety like the reference's WAL-substrate autofile)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._data: dict[bytes, bytes] = {}
+        self._live_bytes = 0
+        self._log_bytes = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._replay()
+        self._f = open(path, "ab")
+
+    def _replay(self):
+        if not os.path.exists(self.path):
+            return
+        good_end = 0
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        off = 0
+        while off + _HDR.size <= len(raw):
+            crc, klen, vlen = _HDR.unpack_from(raw, off)
+            vl = 0 if vlen == _TOMBSTONE else vlen
+            end = off + _HDR.size + klen + vl
+            if end > len(raw):
+                break
+            body = raw[off + _HDR.size:end]
+            if zlib.crc32(body) != crc:
+                break
+            key = body[:klen]
+            if vlen == _TOMBSTONE:
+                self._data.pop(key, None)
+            else:
+                self._data[key] = body[klen:]
+            off = good_end = end
+        if good_end < len(raw):
+            with open(self.path, "r+b") as f:
+                f.truncate(good_end)
+        self._live_bytes = sum(len(k) + len(v)
+                               for k, v in self._data.items())
+        self._log_bytes = good_end
+
+    @staticmethod
+    def _record(key: bytes, value: bytes | None) -> bytes:
+        vlen = _TOMBSTONE if value is None else len(value)
+        body = key + (value or b"")
+        return _HDR.pack(zlib.crc32(body), len(key), vlen) + body
+
+    def _append(self, key: bytes, value: bytes | None):
+        self._append_raw(self._record(key, value))
+
+    def _append_raw(self, rec: bytes):
+        self._f.write(rec)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._log_bytes += len(rec)
+        if (self._log_bytes > 1 << 20
+                and self._log_bytes > 4 * max(self._live_bytes, 1)):
+            self._compact()
+
+    def set_batch(self, items):
+        """All records in one append + one fsync (block-save hot path)."""
+        recs = []
+        for k, v in items.items():
+            k = bytes(k)
+            old = self._data.get(k)
+            if v is None:
+                if old is None:
+                    continue
+                del self._data[k]
+                self._live_bytes -= len(k) + len(old)
+            else:
+                v = bytes(v)
+                self._data[k] = v
+                self._live_bytes += len(k) + len(v) - (
+                    len(k) + len(old) if old is not None else 0)
+            recs.append(self._record(k, v))
+        if recs:
+            self._append_raw(b"".join(recs))
+
+    def _compact(self):
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as f:
+            total = 0
+            for k, v in self._data.items():
+                body = k + v
+                rec = _HDR.pack(zlib.crc32(body), len(k), len(v)) + body
+                f.write(rec)
+                total += len(rec)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._log_bytes = total
+
+    def get(self, key):
+        return self._data.get(key)
+
+    def set(self, key, value):
+        key, value = bytes(key), bytes(value)
+        old = self._data.get(key)
+        self._data[key] = value
+        self._live_bytes += len(key) + len(value) - (
+            len(key) + len(old) if old is not None else 0)
+        self._append(key, value)
+
+    def delete(self, key):
+        if key in self._data:
+            old = self._data.pop(key)
+            self._live_bytes -= len(key) + len(old)
+            self._append(key, None)
+
+    def iterate(self, start=b"", end=None):
+        for k in sorted(self._data):
+            if k < start:
+                continue
+            if end is not None and k >= end:
+                break
+            yield k, self._data[k]
+
+    def close(self):
+        self._f.close()
+
+
+def open_db(backend: str, path: str | None = None) -> KVStore:
+    if backend == "memdb":
+        return MemDB()
+    if backend == "logdb":
+        if not path:
+            raise ValueError("logdb requires a path")
+        return LogDB(path)
+    if backend == "cppdb":
+        from .cppdb import CppDB
+
+        if not path:
+            raise ValueError("cppdb requires a path")
+        return CppDB(path)
+    raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,217 @@
+"""State and StateStore (reference: ``state/state.go``, ``state/store.go``).
+
+``State`` is the deterministic snapshot consensus carries between heights
+(validator sets, params, last results); ``StateStore`` persists it plus
+per-height validator sets / params and FinalizeBlock responses, with
+pruning honoring retain heights (``state/store.go:112-152``, pruner
+``state/pruner.go``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import msgpack
+
+from ..types import codec
+from ..types.block_id import BlockID
+from ..types.genesis import GenesisDoc
+from ..types.params import ConsensusParams, default_consensus_params
+from ..types.validator_set import ValidatorSet
+from .db import KVStore, height_key as _hkey
+
+K_STATE = b"S/state"
+K_VALS = b"S/v/"
+K_PARAMS = b"S/p/"
+K_ABCI = b"S/r/"
+K_RETAIN = b"S/retain"
+K_PRUNED_TO = b"S/prunedto"
+K_OFFLINE_SS = b"S/offliness"
+
+
+@dataclass
+class State:
+    chain_id: str
+    initial_height: int
+    last_block_height: int
+    last_block_id: BlockID
+    last_block_time_ns: int
+    validators: ValidatorSet
+    next_validators: ValidatorSet
+    last_validators: ValidatorSet | None
+    last_height_validators_changed: int
+    consensus_params: ConsensusParams
+    last_height_params_changed: int
+    last_results_hash: bytes
+    app_hash: bytes
+
+    @classmethod
+    def from_genesis(cls, doc: GenesisDoc) -> "State":
+        vals = doc.validator_set()
+        return cls(
+            chain_id=doc.chain_id,
+            initial_height=doc.initial_height,
+            last_block_height=0,
+            last_block_id=BlockID(),
+            last_block_time_ns=doc.genesis_time_ns,
+            validators=vals,
+            next_validators=vals.copy_increment_proposer_priority(1),
+            last_validators=None,
+            last_height_validators_changed=doc.initial_height,
+            consensus_params=doc.consensus_params,
+            last_height_params_changed=doc.initial_height,
+            last_results_hash=b"",
+            app_hash=doc.app_hash,
+        )
+
+    def copy(self) -> "State":
+        return replace(
+            self,
+            validators=self.validators.copy(),
+            next_validators=self.next_validators.copy(),
+            last_validators=(self.last_validators.copy()
+                             if self.last_validators else None),
+        )
+
+    def is_empty(self) -> bool:
+        return self.last_block_height == 0 and not self.chain_id
+
+
+class StateStore:
+    def __init__(self, db: KVStore):
+        self.db = db
+
+    # ----------------------------------------------------------- state
+
+    def save(self, state: State) -> None:
+        self.db.set(K_STATE, msgpack.packb({
+            "chain": state.chain_id,
+            "ih": state.initial_height,
+            "h": state.last_block_height,
+            "bid": codec.to_dict(state.last_block_id),
+            "ts": state.last_block_time_ns,
+            "vals": codec.to_dict(state.validators),
+            "nvals": codec.to_dict(state.next_validators),
+            "lvals": codec.to_dict(state.last_validators),
+            "lhvc": state.last_height_validators_changed,
+            "params": _params_to_dict(state.consensus_params),
+            "lhpc": state.last_height_params_changed,
+            "lrh": state.last_results_hash,
+            "ah": state.app_hash,
+        }, use_bin_type=True))
+        # per-height validator sets for light client / evidence lookups
+        self.save_validators(state.last_block_height + 1, state.validators)
+        self.save_validators(state.last_block_height + 2,
+                             state.next_validators)
+        self.db.set(_hkey(K_PARAMS, state.last_block_height + 1),
+                    msgpack.packb(_params_to_dict(state.consensus_params)))
+
+    def load(self) -> State | None:
+        raw = self.db.get(K_STATE)
+        if not raw:
+            return None
+        d = msgpack.unpackb(raw, raw=False)
+        return State(
+            chain_id=d["chain"], initial_height=d["ih"],
+            last_block_height=d["h"],
+            last_block_id=codec.from_dict(d["bid"]),
+            last_block_time_ns=d["ts"],
+            validators=codec.from_dict(d["vals"]),
+            next_validators=codec.from_dict(d["nvals"]),
+            last_validators=codec.from_dict(d["lvals"]),
+            last_height_validators_changed=d["lhvc"],
+            consensus_params=_params_from_dict(d["params"]),
+            last_height_params_changed=d["lhpc"],
+            last_results_hash=d["lrh"], app_hash=d["ah"])
+
+    def bootstrap(self, state: State) -> None:
+        """Direct state install (statesync; state/store.go Bootstrap)."""
+        self.save(state)
+        if state.last_validators is not None:
+            self.save_validators(state.last_block_height,
+                                 state.last_validators)
+
+    # ----------------------------------------- validators/params by height
+
+    def save_validators(self, height: int, vals: ValidatorSet) -> None:
+        self.db.set(_hkey(K_VALS, height), codec.pack(vals))
+
+    def load_validators(self, height: int) -> ValidatorSet | None:
+        raw = self.db.get(_hkey(K_VALS, height))
+        return codec.unpack(raw) if raw else None
+
+    def load_params(self, height: int) -> ConsensusParams | None:
+        raw = self.db.get(_hkey(K_PARAMS, height))
+        if not raw:
+            return None
+        return _params_from_dict(msgpack.unpackb(raw, raw=False))
+
+    # ------------------------------------------------- abci responses
+
+    def save_finalize_block_response(self, height: int, resp_raw: bytes):
+        self.db.set(_hkey(K_ABCI, height), resp_raw)
+
+    def load_finalize_block_response(self, height: int) -> bytes | None:
+        return self.db.get(_hkey(K_ABCI, height))
+
+    # ------------------------------------------------------- pruning
+
+    def set_retain_heights(self, app: int, companion: int = 0) -> None:
+        self.db.set(K_RETAIN, msgpack.packb({"app": app, "dc": companion}))
+
+    def get_retain_height(self) -> int:
+        raw = self.db.get(K_RETAIN)
+        if not raw:
+            return 0
+        d = msgpack.unpackb(raw, raw=False)
+        vals = [v for v in (d["app"], d["dc"]) if v > 0]
+        return min(vals) if vals else 0
+
+    def prune_states(self, retain_height: int) -> int:
+        """Delete per-height records below retain_height, resuming from a
+        persisted low-water mark (state/store.go PruneStates pattern) so no
+        height is ever skipped regardless of how far retain jumps."""
+        raw = self.db.get(K_PRUNED_TO)
+        start = msgpack.unpackb(raw) if raw else 1
+        pruned = 0
+        for h in range(start, retain_height):
+            for prefix in (K_VALS, K_PARAMS, K_ABCI):
+                if self.db.has(_hkey(prefix, h)):
+                    self.db.delete(_hkey(prefix, h))
+                    pruned += 1
+        if retain_height > start:
+            self.db.set(K_PRUNED_TO, msgpack.packb(retain_height))
+        return pruned
+
+    def set_offline_state_sync_height(self, height: int) -> None:
+        self.db.set(K_OFFLINE_SS, msgpack.packb(height))
+
+    def get_offline_state_sync_height(self) -> int:
+        raw = self.db.get(K_OFFLINE_SS)
+        return msgpack.unpackb(raw) if raw else 0
+
+
+def _params_to_dict(p: ConsensusParams) -> dict:
+    return {
+        "block": [p.block.max_bytes, p.block.max_gas],
+        "evidence": [p.evidence.max_age_num_blocks,
+                     p.evidence.max_age_duration_ns, p.evidence.max_bytes],
+        "validator": p.validator.pub_key_types,
+        "version": p.version.app,
+        "feature": [p.feature.vote_extensions_enable_height,
+                    p.feature.pbts_enable_height],
+        "synchrony": [p.synchrony.precision_ns,
+                      p.synchrony.message_delay_ns],
+    }
+
+
+def _params_from_dict(d: dict) -> ConsensusParams:
+    p = default_consensus_params()
+    p.block.max_bytes, p.block.max_gas = d["block"]
+    (p.evidence.max_age_num_blocks, p.evidence.max_age_duration_ns,
+     p.evidence.max_bytes) = d["evidence"]
+    p.validator.pub_key_types = list(d["validator"])
+    p.version.app = d["version"]
+    (p.feature.vote_extensions_enable_height,
+     p.feature.pbts_enable_height) = d["feature"]
+    p.synchrony.precision_ns, p.synchrony.message_delay_ns = d["synchrony"]
+    return p

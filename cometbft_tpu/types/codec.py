@@ -1,0 +1,170 @@
+"""Serialization codec for storage, WAL records and p2p payloads.
+
+The reference serializes with protobuf everywhere; this framework splits
+concerns: *hash/sign* bytes use the canonical proto wire encodings
+(``types/wire.py`` — consensus-critical, byte-exact), while *storage and
+transport* use a msgpack dataclass codec (self-describing, fast, and — per
+SURVEY.md §7.5 — only required to interop with itself, not with Go nodes).
+"""
+
+from __future__ import annotations
+
+import msgpack
+
+from ..crypto.keys import Ed25519PubKey
+from .block_id import BlockID, PartSetHeader
+from .commit import Commit, CommitSig, ExtendedCommit, ExtendedCommitSig
+from .header import Block, Data, Header
+from .evidence import (DuplicateVoteEvidence, Evidence,
+                       LightClientAttackEvidence)
+from .validator_set import Validator, ValidatorSet
+from .vote import Proposal, Vote
+
+
+def pack(obj) -> bytes:
+    return msgpack.packb(to_dict(obj), use_bin_type=True)
+
+
+def unpack(raw: bytes):
+    return from_dict(msgpack.unpackb(raw, raw=False))
+
+
+# --------------------------------------------------------------- dict codecs
+
+def to_dict(obj):
+    if obj is None or isinstance(obj, (int, str, bytes, bool)):
+        return obj
+    if isinstance(obj, (list, tuple)):
+        return [to_dict(o) for o in obj]
+    t = type(obj).__name__
+    if isinstance(obj, PartSetHeader):
+        return {"!": t, "total": obj.total, "hash": obj.hash}
+    if isinstance(obj, BlockID):
+        return {"!": t, "hash": obj.hash,
+                "psh": to_dict(obj.part_set_header)}
+    if isinstance(obj, CommitSig):
+        return {"!": t, "flag": obj.block_id_flag,
+                "addr": obj.validator_address, "ts": obj.timestamp_ns,
+                "sig": obj.signature}
+    if isinstance(obj, Commit):
+        return {"!": t, "h": obj.height, "r": obj.round,
+                "bid": to_dict(obj.block_id),
+                "sigs": [to_dict(s) for s in obj.signatures]}
+    if isinstance(obj, ExtendedCommitSig):
+        return {"!": t, "cs": to_dict(obj.commit_sig), "ext": obj.extension,
+                "extsig": obj.extension_signature}
+    if isinstance(obj, ExtendedCommit):
+        return {"!": t, "h": obj.height, "r": obj.round,
+                "bid": to_dict(obj.block_id),
+                "sigs": [to_dict(s) for s in obj.extended_signatures]}
+    if isinstance(obj, Vote):
+        return {"!": t, "t": obj.type, "h": obj.height, "r": obj.round,
+                "bid": to_dict(obj.block_id), "ts": obj.timestamp_ns,
+                "addr": obj.validator_address, "idx": obj.validator_index,
+                "sig": obj.signature, "ext": obj.extension,
+                "extsig": obj.extension_signature}
+    if isinstance(obj, Proposal):
+        return {"!": t, "h": obj.height, "r": obj.round,
+                "pol": obj.pol_round, "bid": to_dict(obj.block_id),
+                "ts": obj.timestamp_ns, "sig": obj.signature}
+    if isinstance(obj, Header):
+        return {"!": t, "chain": obj.chain_id, "h": obj.height,
+                "ts": obj.time_ns, "lbi": to_dict(obj.last_block_id),
+                "lch": obj.last_commit_hash, "dh": obj.data_hash,
+                "vh": obj.validators_hash, "nvh": obj.next_validators_hash,
+                "ch": obj.consensus_hash, "ah": obj.app_hash,
+                "lrh": obj.last_results_hash, "eh": obj.evidence_hash,
+                "prop": obj.proposer_address, "vb": obj.version_block,
+                "va": obj.version_app}
+    if isinstance(obj, Data):
+        return {"!": t, "txs": list(obj.txs)}
+    if isinstance(obj, Block):
+        return {"!": t, "hdr": to_dict(obj.header), "data": to_dict(obj.data),
+                "ev": [to_dict(e) for e in obj.evidence],
+                "lc": to_dict(obj.last_commit)}
+    if isinstance(obj, Validator):
+        return {"!": t, "pk_type": obj.pub_key.type(),
+                "pk": obj.pub_key.bytes(), "power": obj.voting_power,
+                "prio": obj.proposer_priority}
+    if isinstance(obj, ValidatorSet):
+        return {"!": t, "vals": [to_dict(v) for v in obj.validators],
+                "prop": obj.proposer.address if obj.proposer else b""}
+    if isinstance(obj, DuplicateVoteEvidence):
+        return {"!": t, "a": to_dict(obj.vote_a), "b": to_dict(obj.vote_b),
+                "tvp": obj.total_voting_power, "vp": obj.validator_power,
+                "ts": obj.timestamp_ns}
+    if isinstance(obj, LightClientAttackEvidence):
+        return {"!": t, "chh": obj.conflicting_header_hash,
+                "chht": obj.conflicting_height, "comh": obj.common_height,
+                "byz": [to_dict(v) for v in obj.byzantine_validators],
+                "tvp": obj.total_voting_power, "ts": obj.timestamp_ns}
+    raise TypeError(f"codec: unsupported type {t}")
+
+
+def from_dict(d):
+    if d is None or isinstance(d, (int, str, bytes, bool)):
+        return d
+    if isinstance(d, list):
+        return [from_dict(x) for x in d]
+    t = d.get("!")
+    if t == "PartSetHeader":
+        return PartSetHeader(d["total"], d["hash"])
+    if t == "BlockID":
+        return BlockID(d["hash"], from_dict(d["psh"]))
+    if t == "CommitSig":
+        return CommitSig(d["flag"], d["addr"], d["ts"], d["sig"])
+    if t == "Commit":
+        return Commit(d["h"], d["r"], from_dict(d["bid"]),
+                      [from_dict(s) for s in d["sigs"]])
+    if t == "ExtendedCommitSig":
+        return ExtendedCommitSig(from_dict(d["cs"]), d["ext"], d["extsig"])
+    if t == "ExtendedCommit":
+        return ExtendedCommit(d["h"], d["r"], from_dict(d["bid"]),
+                              [from_dict(s) for s in d["sigs"]])
+    if t == "Vote":
+        return Vote(type=d["t"], height=d["h"], round=d["r"],
+                    block_id=from_dict(d["bid"]), timestamp_ns=d["ts"],
+                    validator_address=d["addr"], validator_index=d["idx"],
+                    signature=d["sig"], extension=d["ext"],
+                    extension_signature=d["extsig"])
+    if t == "Proposal":
+        return Proposal(height=d["h"], round=d["r"], pol_round=d["pol"],
+                        block_id=from_dict(d["bid"]), timestamp_ns=d["ts"],
+                        signature=d["sig"])
+    if t == "Header":
+        return Header(chain_id=d["chain"], height=d["h"], time_ns=d["ts"],
+                      last_block_id=from_dict(d["lbi"]),
+                      last_commit_hash=d["lch"], data_hash=d["dh"],
+                      validators_hash=d["vh"], next_validators_hash=d["nvh"],
+                      consensus_hash=d["ch"], app_hash=d["ah"],
+                      last_results_hash=d["lrh"], evidence_hash=d["eh"],
+                      proposer_address=d["prop"], version_block=d["vb"],
+                      version_app=d["va"])
+    if t == "Data":
+        return Data(txs=list(d["txs"]))
+    if t == "Block":
+        return Block(header=from_dict(d["hdr"]), data=from_dict(d["data"]),
+                     evidence=[from_dict(e) for e in d["ev"]],
+                     last_commit=from_dict(d["lc"]))
+    if t == "Validator":
+        if d["pk_type"] != "ed25519":
+            raise TypeError(f"unsupported pubkey type {d['pk_type']}")
+        return Validator(Ed25519PubKey(d["pk"]), d["power"], d["prio"])
+    if t == "ValidatorSet":
+        vs = ValidatorSet.__new__(ValidatorSet)
+        vs.validators = [from_dict(v) for v in d["vals"]]
+        vs._total = None
+        vs.proposer = None
+        if d["prop"]:
+            idx, v = vs.get_by_address(d["prop"])
+            vs.proposer = v
+        return vs
+    if t == "DuplicateVoteEvidence":
+        return DuplicateVoteEvidence(from_dict(d["a"]), from_dict(d["b"]),
+                                     d["tvp"], d["vp"], d["ts"])
+    if t == "LightClientAttackEvidence":
+        return LightClientAttackEvidence(
+            d["chh"], d["chht"], d["comh"],
+            byzantine_validators=[from_dict(v) for v in d.get("byz", [])],
+            total_voting_power=d["tvp"], timestamp_ns=d["ts"])
+    raise TypeError(f"codec: unknown tag {t!r}")

@@ -1,0 +1,246 @@
+"""Storage (KV/block/state stores) and ABCI (clients, server, kvstore app)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import (CODE_TYPE_OK, FinalizeBlockRequest,
+                               InitChainRequest, PrepareProposalRequest,
+                               ProcessProposalRequest,
+                               PROCESS_PROPOSAL_ACCEPT,
+                               PROCESS_PROPOSAL_REJECT, ValidatorUpdate,
+                               OFFER_SNAPSHOT_ACCEPT, APPLY_CHUNK_ACCEPT)
+from cometbft_tpu.abci.client import LocalClient, SocketClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.server import ABCIServer
+from cometbft_tpu.proxy import AppConns, local_client_creator
+from cometbft_tpu.storage import (BlockStore, LogDB, MemDB, State, StateStore)
+from cometbft_tpu.types import (BlockID, Commit, CommitSig, PartSetHeader,
+                                Validator, ValidatorSet)
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.header import Block, Data, Header
+from cometbft_tpu.types.part_set import PartSet
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types import codec
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+# ---------------------------------------------------------------------- db
+
+def test_logdb_crash_recovery(tmp_path):
+    path = str(tmp_path / "kv.log")
+    db = LogDB(path)
+    db.set(b"a", b"1")
+    db.set(b"b", b"2")
+    db.set(b"a", b"3")
+    db.delete(b"b")
+    db.close()
+
+    db2 = LogDB(path)
+    assert db2.get(b"a") == b"3" and db2.get(b"b") is None
+    # torn tail: append garbage, must be truncated on reopen
+    db2.close()
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x03garbage-partial-record")
+    db3 = LogDB(path)
+    assert db3.get(b"a") == b"3"
+    db3.set(b"c", b"4")
+    db3.close()
+    db4 = LogDB(path)
+    assert db4.get(b"c") == b"4"
+    assert list(db4.iterate(b"a", b"c")) == [(b"a", b"3")]
+    db4.close()
+
+
+def test_logdb_compaction(tmp_path):
+    path = str(tmp_path / "kv.log")
+    db = LogDB(path)
+    for i in range(300):
+        db.set(b"key", b"v" * 4096)       # rewrite same key: log grows
+    db.set(b"other", b"x")
+    import os
+    assert os.path.getsize(path) < 1 << 21   # compaction kept it bounded
+    db.close()
+    db2 = LogDB(path)
+    assert db2.get(b"key") == b"v" * 4096 and db2.get(b"other") == b"x"
+    db2.close()
+
+
+# -------------------------------------------------------------- blockstore
+
+def make_block_at(height, vals, pvs, prev_bid):
+    h = Header(chain_id="s-chain", height=height, time_ns=height * 10**9,
+               last_block_id=prev_bid, validators_hash=vals.hash(),
+               next_validators_hash=vals.hash(),
+               proposer_address=vals.get_proposer().address)
+    commit = None
+    if height > 1:
+        commit = Commit(height - 1, 0, prev_bid,
+                        [CommitSig(2, v.address, 1, b"s" * 64)
+                         for v in vals.validators])
+    b = Block(header=h, data=Data(txs=[b"tx%d" % height]), last_commit=commit)
+    b.fill_hashes()
+    return b
+
+
+def test_blockstore_roundtrip(tmp_path):
+    pvs = [MockPV.from_secret(b"b%d" % i) for i in range(3)]
+    vals = ValidatorSet([Validator(p.get_pub_key(), 5) for p in pvs])
+    store = BlockStore(MemDB())
+    prev = BlockID()
+    blocks = []
+    for height in range(1, 6):
+        b = make_block_at(height, vals, pvs, prev)
+        parts = PartSet.from_data(codec.pack(b))
+        seen = Commit(height, 0, BlockID(b.hash(), parts.header()),
+                      [CommitSig(2, v.address, 1, b"s" * 64)
+                       for v in vals.validators])
+        store.save_block(b, parts, seen)
+        prev = BlockID(b.hash(), parts.header())
+        blocks.append(b)
+
+    assert store.height() == 5 and store.base() == 1
+    got = store.load_block(3)
+    assert got.hash() == blocks[2].hash()
+    meta = store.load_block_meta(3)
+    assert meta.block_id.hash == blocks[2].hash()
+    c2 = store.load_block_commit(2)           # from block 3's last_commit
+    assert c2.height == 2
+    seen = store.load_seen_commit()
+    assert seen.height == 5
+    with pytest.raises(ValueError):
+        store.save_block(blocks[2], PartSet.from_data(b"x"), seen)  # gap
+    assert store.prune_blocks(3) == 2
+    assert store.base() == 3 and store.load_block(2) is None
+    assert store.load_block(3) is not None
+
+
+# -------------------------------------------------------------- statestore
+
+def test_statestore_roundtrip():
+    pvs = [MockPV.from_secret(b"s%d" % i) for i in range(3)]
+    doc = GenesisDoc(chain_id="ss-chain",
+                     validators=[GenesisValidator(p.get_pub_key(), 7)
+                                 for p in pvs])
+    st = State.from_genesis(doc)
+    store = StateStore(MemDB())
+    store.save(st)
+    st2 = store.load()
+    assert st2.chain_id == "ss-chain"
+    assert st2.validators.hash() == st.validators.hash()
+    assert st2.next_validators.hash() == st.next_validators.hash()
+    assert st2.consensus_params.hash() == st.consensus_params.hash()
+    # proposer survives the round trip (consensus-critical)
+    assert st2.validators.get_proposer().address == \
+        st.validators.get_proposer().address
+    vals1 = store.load_validators(1)
+    assert vals1 is not None and vals1.hash() == st.validators.hash()
+
+
+# -------------------------------------------------------------------- abci
+
+def test_kvstore_local_client():
+    async def main():
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        await client.init_chain(InitChainRequest(
+            chain_id="kv", initial_height=1, time_ns=0,
+            validators=[ValidatorUpdate("ed25519", b"\x01" * 32, 10)]))
+        info = await client.info()
+        assert info.data == "kvstore"
+
+        resp = await client.check_tx(b"name=satoshi")
+        assert resp.is_ok
+        assert not (await client.check_tx(b"garbage")).is_ok
+
+        pp = await client.prepare_proposal(PrepareProposalRequest(
+            max_tx_bytes=1 << 20, txs=[b"a=1", b"b=2"], height=1, time_ns=0))
+        assert pp.txs == [b"a=1", b"b=2"]
+        assert (await client.process_proposal(ProcessProposalRequest(
+            txs=pp.txs, height=1, time_ns=0))) == PROCESS_PROPOSAL_ACCEPT
+        assert (await client.process_proposal(ProcessProposalRequest(
+            txs=[b"bad"], height=1, time_ns=0))) == PROCESS_PROPOSAL_REJECT
+
+        fin = await client.finalize_block(FinalizeBlockRequest(
+            txs=pp.txs, height=1, time_ns=0))
+        assert all(r.is_ok for r in fin.tx_results)
+        assert fin.app_hash
+        await client.commit()
+
+        q = await client.query("/key", b"a", 0, False)
+        assert q.value == b"1"
+
+        ext = await client.extend_vote(1, 0, b"h" * 32)
+        ok = await client.verify_vote_extension(1, 0, b"a" * 20, b"h" * 32,
+                                                ext.vote_extension)
+        assert ok.accepted
+        bad = await client.verify_vote_extension(2, 0, b"a" * 20, b"h" * 32,
+                                                 ext.vote_extension)
+        assert not bad.accepted
+        return True
+
+    assert run(main())
+
+
+def test_kvstore_snapshots_restore():
+    async def main():
+        app = KVStoreApplication()
+        c = LocalClient(app)
+        await c.finalize_block(FinalizeBlockRequest(
+            txs=[b"x=%d" % i for i in range(50)], height=1, time_ns=0))
+        await c.commit()
+        snaps = await c.list_snapshots()
+        assert snaps and snaps[0].height == 1
+
+        app2 = KVStoreApplication()
+        c2 = LocalClient(app2)
+        assert (await c2.offer_snapshot(snaps[0], b"")) == \
+            OFFER_SNAPSHOT_ACCEPT
+        for i in range(snaps[0].chunks):
+            chunk = await c.load_snapshot_chunk(1, 1, i)
+            assert (await c2.apply_snapshot_chunk(i, chunk, "p")) == \
+                APPLY_CHUNK_ACCEPT
+        assert app2.state == app.state and app2.height == app.height
+        assert app2.app_hash == app.app_hash
+        return True
+
+    assert run(main())
+
+
+def test_socket_server_roundtrip():
+    async def main():
+        app = KVStoreApplication()
+        server = ABCIServer(app, port=0)
+        await server.start()
+        client = await SocketClient.connect(port=server.port)
+        assert (await client.echo("hello")) == "hello"
+        fin = await client.finalize_block(FinalizeBlockRequest(
+            txs=[b"k=v"], height=1, time_ns=0,
+            misbehavior=[]))
+        assert fin.tx_results[0].is_ok and fin.app_hash == app.app_hash
+        # pipelining: concurrent calls resolve correctly
+        import asyncio as aio
+        results = await aio.gather(*[client.query("/k", b"k", 0, False)
+                                     for _ in range(10)])
+        assert all(r.value == b"v" for r in results)
+        await client.close()
+        await server.stop()
+        return True
+
+    assert run(main())
+
+
+def test_app_conns():
+    async def main():
+        app = KVStoreApplication()
+        conns = AppConns(local_client_creator(app))
+        await conns.start()
+        assert (await conns.query.info()).data == "kvstore"
+        assert (await conns.mempool.check_tx(b"a=b")).is_ok
+        await conns.stop()
+        return True
+
+    assert run(main())
