@@ -1,0 +1,140 @@
+"""Configuration tree (reference: ``config/config.go:78-93`` — one Config
+struct covering base/p2p/mempool/consensus/storage/rpc/instrumentation,
+TOML-persisted, with a test variant that shrinks consensus timeouts to tens
+of milliseconds for fast in-proc ensembles (``config/config.go:1210-1225``)).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+NS = 1_000_000_000
+MS = 1_000_000
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts in ns (defaults: config/config.go:1189-1207)."""
+
+    timeout_propose: int = 3 * NS
+    timeout_propose_delta: int = 500 * MS
+    timeout_prevote: int = 1 * NS
+    timeout_prevote_delta: int = 500 * MS
+    timeout_precommit: int = 1 * NS
+    timeout_precommit_delta: int = 500 * MS
+    timeout_commit: int = 1 * NS
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: int = 0
+    peer_gossip_sleep_duration: int = 100 * MS
+    peer_query_maj23_sleep_duration: int = 2 * NS
+    wal_path: str = "data/cs.wal"
+
+    def propose_timeout(self, round_: int) -> int:
+        return self.timeout_propose + self.timeout_propose_delta * round_
+
+    def prevote_timeout(self, round_: int) -> int:
+        return self.timeout_prevote + self.timeout_prevote_delta * round_
+
+    def precommit_timeout(self, round_: int) -> int:
+        return self.timeout_precommit + self.timeout_precommit_delta * round_
+
+    def commit_timeout(self) -> int:
+        return self.timeout_commit
+
+
+def test_consensus_config() -> ConsensusConfig:
+    """Shrunk timeouts for in-proc multi-validator tests
+    (config/config.go:1210 TestConsensusConfig pattern)."""
+    return ConsensusConfig(
+        timeout_propose=80 * MS, timeout_propose_delta=20 * MS,
+        timeout_prevote=40 * MS, timeout_prevote_delta=10 * MS,
+        timeout_precommit=40 * MS, timeout_precommit_delta=10 * MS,
+        timeout_commit=20 * MS, peer_gossip_sleep_duration=5 * MS)
+
+
+@dataclass
+class MempoolConfig:
+    size: int = 5000
+    max_tx_bytes: int = 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    broadcast: bool = True
+    recheck: bool = True
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    handshake_timeout: int = 20 * NS
+    dial_timeout: int = 3 * NS
+    send_rate: int = 5 * 1024 * 1024
+    recv_rate: int = 5 * 1024 * 1024
+    pex: bool = True
+    addr_book_path: str = "config/addrbook.json"
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+    batch_size: int = 64              # cross-block sig batching window
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period: int = 168 * 3600 * NS
+    rpc_servers: list[str] = field(default_factory=list)
+
+
+@dataclass
+class StorageConfig:
+    db_backend: str = "logdb"
+    discard_abci_responses: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+
+
+@dataclass
+class BaseConfig:
+    moniker: str = "node"
+    root_dir: str = "."
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    abci: str = "builtin"             # builtin | socket
+    proxy_app: str = "kvstore"
+    signature_backend: str = "auto"   # auto | tpu | jax | cpu  <- TPU seam
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig)

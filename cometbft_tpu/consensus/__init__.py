@@ -1,0 +1,15 @@
+"""Tendermint consensus state machine (reference: ``internal/consensus/``):
+round state, height vote sets, timeout ticker, WAL, the single-writer
+receive loop, and crash-recovery replay/handshake."""
+
+from .round_state import (STEP_COMMIT, STEP_NEW_HEIGHT, STEP_NEW_ROUND,
+                          STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT, STEP_PREVOTE,
+                          STEP_PREVOTE_WAIT, STEP_PROPOSE, RoundState)
+from .height_vote_set import HeightVoteSet
+from .state import ConsensusState
+from .ticker import TimeoutInfo, TimeoutTicker
+
+__all__ = ["ConsensusState", "RoundState", "HeightVoteSet", "TimeoutTicker",
+           "TimeoutInfo", "STEP_NEW_HEIGHT", "STEP_NEW_ROUND", "STEP_PROPOSE",
+           "STEP_PREVOTE", "STEP_PREVOTE_WAIT", "STEP_PRECOMMIT",
+           "STEP_PRECOMMIT_WAIT", "STEP_COMMIT"]

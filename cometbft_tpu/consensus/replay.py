@@ -1,0 +1,105 @@
+"""Handshaker: reconcile app height with store/state height on startup
+(reference: ``internal/consensus/replay.go:201-446`` ReplayBlocks case
+matrix).
+
+Cases handled:
+- fresh chain (state height 0): InitChain, apply the app's genesis response
+  (validators / app hash / params overrides) to state;
+- store height == state height + 1 (crash after SaveBlock + WAL EndHeight
+  but before ApplyBlock): apply that block through the executor;
+- app behind state: replay stored blocks into the app (FinalizeBlock +
+  Commit only — state already reflects them);
+- app ahead of state: unrecoverable, raise.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+
+from ..abci import types as abci
+from ..crypto.keys import Ed25519PubKey
+from ..proxy.multi_app_conn import AppConns
+from ..sm.execution import BlockExecutor
+from ..storage.blockstore import BlockStore
+from ..storage.statestore import State, StateStore
+from ..types.block_id import BlockID
+from ..types.genesis import GenesisDoc
+from ..types.validator_set import Validator, ValidatorSet
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class Handshaker:
+    def __init__(self, state_store: StateStore, block_store: BlockStore,
+                 genesis_doc: GenesisDoc):
+        self.state_store = state_store
+        self.block_store = block_store
+        self.genesis = genesis_doc
+
+    async def handshake(self, state: State, app_conns: AppConns,
+                        executor: BlockExecutor) -> State:
+        info = await app_conns.query.info()
+        app_height = info.last_block_height
+        store_height = self.block_store.height()
+
+        if state.last_block_height == 0 and app_height == 0:
+            state = await self._init_chain(state, app_conns)
+
+        # crash between SaveBlock and ApplyBlock: finish applying
+        if store_height == state.last_block_height + 1 and store_height > 0:
+            block = self.block_store.load_block(store_height)
+            meta = self.block_store.load_block_meta(store_height)
+            state = await executor.apply_block(state, meta.block_id, block)
+            self.state_store.save(state)
+
+        if app_height > state.last_block_height:
+            raise HandshakeError(
+                f"app height {app_height} ahead of state "
+                f"{state.last_block_height}")
+
+        # replay blocks the app missed (app-only: state already has them)
+        for h in range(app_height + 1, state.last_block_height + 1):
+            block = self.block_store.load_block(h)
+            if block is None:
+                raise HandshakeError(f"missing block {h} for app replay")
+            req = abci.FinalizeBlockRequest(
+                txs=list(block.data.txs), height=h,
+                time_ns=block.header.time_ns, hash=block.hash(),
+                proposer_address=block.header.proposer_address,
+                decided_last_commit=block.last_commit,
+                syncing_to_height=state.last_block_height)
+            resp = await app_conns.consensus.finalize_block(req)
+            await app_conns.consensus.commit()
+            if h == state.last_block_height and \
+                    resp.app_hash != state.app_hash:
+                raise HandshakeError(
+                    f"app hash mismatch after replay at {h}: "
+                    f"{resp.app_hash.hex()} != {state.app_hash.hex()}")
+        return state
+
+    async def _init_chain(self, state: State, app_conns: AppConns) -> State:
+        """InitChain + genesis-response overrides (replay.go:310)."""
+        vals = [abci.ValidatorUpdate("ed25519", v.pub_key.bytes(), v.power)
+                for v in self.genesis.validators]
+        resp = await app_conns.consensus.init_chain(abci.InitChainRequest(
+            chain_id=self.genesis.chain_id,
+            initial_height=self.genesis.initial_height,
+            time_ns=self.genesis.genesis_time_ns,
+            validators=vals,
+            app_state_bytes=self.genesis.app_state,
+            consensus_params=self.genesis.consensus_params))
+        if resp.validators:
+            new_vals = ValidatorSet(
+                [Validator(Ed25519PubKey(vu.pub_key_bytes), vu.power)
+                 for vu in resp.validators])
+            state = dc_replace(
+                state, validators=new_vals,
+                next_validators=new_vals.copy_increment_proposer_priority(1))
+        if resp.app_hash:
+            state = dc_replace(state, app_hash=resp.app_hash)
+        if resp.consensus_params is not None:
+            state = dc_replace(state, consensus_params=resp.consensus_params)
+        self.state_store.save(state)
+        return state

@@ -1,0 +1,739 @@
+"""The Tendermint consensus state machine
+(reference: ``internal/consensus/state.go`` — 2776 LoC single-writer core).
+
+Architecture: the reference's ``receiveRoutine`` goroutine maps to one
+asyncio task consuming a queue of events (peer messages, own messages,
+timeouts, txs-available).  Everything mutating round state happens on that
+task — the same single-writer discipline the reference uses in place of
+locks (SURVEY.md §5 "race detection").  WAL-before-processing ordering and
+the fsync rules (own votes hit disk before they can be sent;
+EndHeightMessage fsync'd before the block is applied) mirror
+``state.go:830-869,1899``.
+
+Round logic follows the Tendermint arXiv:1807.04938 rules as implemented by
+``enterNewRound/enterPropose/defaultDoPrevote/enterPrecommit/...``
+(state.go:1056-1945), including locking/valid-block bookkeeping, PBTS
+proposal timeliness, and ABCI 2.0 vote extensions on precommits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable
+
+from ..config import ConsensusConfig
+from ..libs.pubsub import EventBus
+from ..sm.execution import BlockExecutor
+from ..sm.validation import BlockValidationError
+from ..storage.blockstore import BlockStore
+from ..storage.statestore import State
+from ..types import codec
+from ..types import events as ev
+from ..types.block_id import BlockID
+from ..types.commit import ExtendedCommit
+from ..types.part_set import Part, PartSet
+from ..types.priv_validator import PrivValidator
+from ..types.vote import (PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote)
+from ..types.vote_set import ConflictingVoteError, VoteSetError
+from .height_vote_set import HeightVoteSet
+from .round_state import (STEP_COMMIT, STEP_NEW_HEIGHT, STEP_NEW_ROUND,
+                          STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT, STEP_PREVOTE,
+                          STEP_PREVOTE_WAIT, STEP_PROPOSE, RoundState)
+from .ticker import TimeoutInfo, TimeoutTicker
+from .wal import WAL
+
+
+class ConsensusState:
+    def __init__(self, cfg: ConsensusConfig, state: State,
+                 block_exec: BlockExecutor, block_store: BlockStore,
+                 wal: WAL | None = None,
+                 priv_validator: PrivValidator | None = None,
+                 event_bus: EventBus | None = None,
+                 now_ns: Callable[[], int] = time.time_ns,
+                 name: str = "cs"):
+        self.cfg = cfg
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.wal = wal
+        self.priv_validator = priv_validator
+        self.event_bus = event_bus or block_exec.event_bus
+        self.now_ns = now_ns
+        self.name = name
+
+        self.rs = RoundState()
+        self.state: State | None = None
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.ticker = TimeoutTicker(self._deliver_timeout)
+        self._task: asyncio.Task | None = None
+        self._replaying = False
+        self._stopped = asyncio.Event()
+        self.decided = asyncio.Event()      # pulses on every commit (tests)
+
+        # outbound hooks (set by the in-proc harness or the p2p reactor)
+        self.broadcast_proposal: Callable[[Proposal], None] = lambda p: None
+        self.broadcast_block_part: Callable[[int, int, Part], None] = \
+            lambda h, r, p: None
+        self.broadcast_vote: Callable[[Vote], None] = lambda v: None
+        self.on_conflicting_vote: Callable[[Vote, Vote], None] = \
+            lambda a, b: None
+
+        self._update_to_state(state)
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        """WAL catch-up replay then launch the receive routine
+        (state.go:322 OnStart)."""
+        if self.wal is not None:
+            await self._catchup_replay()
+        self._task = asyncio.create_task(self._receive_routine())
+        self._schedule_round0_now()
+
+    async def stop(self) -> None:
+        self.ticker.stop()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self.wal is not None:
+            self.wal.flush_and_sync()
+
+    # --------------------------------------------------------- public feeds
+
+    def feed_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self.queue.put_nowait(("proposal", proposal, peer_id))
+
+    def feed_block_part(self, height: int, round_: int, part: Part,
+                        peer_id: str = "") -> None:
+        self.queue.put_nowait(("part", (height, round_, part), peer_id))
+
+    def feed_vote(self, vote: Vote, peer_id: str = "") -> None:
+        self.queue.put_nowait(("vote", vote, peer_id))
+
+    def notify_txs_available(self) -> None:
+        self.queue.put_nowait(("txs_available", None, ""))
+
+    def _deliver_timeout(self, ti: TimeoutInfo) -> None:
+        self.queue.put_nowait(("timeout", ti, ""))
+
+    # ------------------------------------------------------- receive routine
+
+    async def _receive_routine(self) -> None:
+        """state.go:788 — the single writer."""
+        while True:
+            kind, payload, peer = await self.queue.get()
+            try:
+                await self._handle(kind, payload, peer, replay=False)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:       # keep consensus alive; log
+                import traceback
+                traceback.print_exc()
+                print(f"[{self.name}] consensus error on {kind}: {e!r}")
+
+    async def _handle(self, kind: str, payload, peer: str,
+                      replay: bool) -> None:
+        if kind == "timeout":
+            self._wal_write({"#": "timeout", "ti": {
+                "d": payload.duration_ns, "h": payload.height,
+                "r": payload.round, "s": payload.step}}, sync=True)
+            await self._handle_timeout(payload)
+            return
+        if kind == "txs_available":
+            await self._handle_txs_available()
+            return
+        # WAL-before-processing; own messages (peer == "") are fsync'd
+        if not replay:
+            self._wal_write({"#": kind, "peer": peer,
+                             "data": _msg_to_wire(kind, payload)},
+                            sync=(peer == ""))
+        if kind == "proposal":
+            await self._set_proposal(payload)
+        elif kind == "part":
+            h, r, part = payload
+            await self._add_proposal_block_part(h, r, part)
+        elif kind == "vote":
+            await self._try_add_vote(payload, peer)
+
+    # ------------------------------------------------------------------ WAL
+
+    def _wal_write(self, rec: dict, sync: bool) -> None:
+        if self.wal is None or self._replaying:
+            return
+        if sync:
+            self.wal.write_sync(rec)
+        else:
+            self.wal.write(rec)
+
+    async def _catchup_replay(self) -> None:
+        """Re-drive recorded messages through the handlers (replay.go:95)."""
+        height = self.rs.height
+        try:
+            records = self.wal.records_after_height(height - 1)
+        except Exception:
+            records = []
+        self._replaying = True
+        try:
+            for rec in records:
+                kind = rec.get("#")
+                if kind == "timeout":
+                    d = rec["ti"]
+                    await self._handle_timeout(TimeoutInfo(
+                        d["d"], d["h"], d["r"], d["s"]))
+                elif kind in ("proposal", "part", "vote"):
+                    await self._handle(kind,
+                                       _msg_from_wire(kind, rec["data"]),
+                                       rec.get("peer", ""), replay=True)
+        finally:
+            self._replaying = False
+
+    # --------------------------------------------------------- state switch
+
+    def _update_to_state(self, state: State) -> None:
+        """state.go updateToState: advance to the next height."""
+        ext_enabled = state.consensus_params.feature.vote_extensions_enabled(
+            state.last_block_height + 1)
+        height = state.last_block_height + 1 \
+            if state.last_block_height else state.initial_height
+
+        prev_precommits = None
+        if self.rs.votes is not None and self.rs.commit_round >= 0 and \
+                self.rs.height == state.last_block_height:
+            prev_precommits = self.rs.votes.precommits(self.rs.commit_round)
+
+        self.state = state
+        self.rs = RoundState(
+            height=height,
+            round=0,
+            step=STEP_NEW_HEIGHT,
+            validators=state.validators.copy(),
+            last_validators=(state.last_validators.copy()
+                             if state.last_validators else None),
+            votes=HeightVoteSet(state.chain_id, height, state.validators,
+                                extensions_enabled=ext_enabled),
+            last_commit=prev_precommits,
+            commit_time_ns=self.now_ns(),
+        )
+        self.rs.start_time_ns = self.rs.commit_time_ns + \
+            self.cfg.commit_timeout()
+
+    def _schedule_round0_now(self) -> None:
+        delay = max(self.rs.start_time_ns - self.now_ns(), 1)
+        self.ticker.schedule(TimeoutInfo(delay, self.rs.height, 0,
+                                         STEP_NEW_HEIGHT))
+
+    # ------------------------------------------------------------- timeouts
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """state.go:970 handleTimeout."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or \
+                (ti.round == rs.round and ti.step < rs.step):
+            return
+        if ti.step == STEP_NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self.event_bus.publish(ev.EVENT_TIMEOUT_PROPOSE,
+                                   {"height": ti.height, "round": ti.round})
+            await self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self.event_bus.publish(ev.EVENT_TIMEOUT_WAIT,
+                                   {"height": ti.height, "round": ti.round})
+            await self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self.event_bus.publish(ev.EVENT_TIMEOUT_WAIT,
+                                   {"height": ti.height, "round": ti.round})
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+
+    async def _handle_txs_available(self) -> None:
+        rs = self.rs
+        if rs.step == STEP_NEW_HEIGHT:
+            # fast-path round 0 on pending txs (createEmptyBlocks interval)
+            self._schedule_round0_now()
+
+    # ----------------------------------------------------------- new round
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step != STEP_NEW_HEIGHT):
+            return
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        if round_ > 0:
+            # reset proposal for the new round (keep valid block)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_)
+        rs.triggered_timeout_precommit = False
+        self.event_bus.publish(ev.EVENT_NEW_ROUND,
+                               {"height": height, "round": round_,
+                                "proposer": self._round_proposer(
+                                    round_).address.hex()})
+        await self._enter_propose(height, round_)
+
+    def _round_proposer(self, round_: int):
+        vals = self.state.validators
+        if round_ == 0:
+            return vals.get_proposer()
+        return vals.copy_increment_proposer_priority(round_).get_proposer()
+
+    def _is_our_turn(self, round_: int) -> bool:
+        if self.priv_validator is None:
+            return False
+        return self._round_proposer(round_).address == \
+            self.priv_validator.get_pub_key().address()
+
+    # -------------------------------------------------------------- propose
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PROPOSE):
+            return
+        rs.step = STEP_PROPOSE
+        self.ticker.schedule(TimeoutInfo(self.cfg.propose_timeout(round_),
+                                         height, round_, STEP_PROPOSE))
+        if self._is_our_turn(round_):
+            await self._decide_proposal(height, round_)
+        if rs.proposal_complete():
+            await self._enter_prevote(height, round_)
+
+    async def _decide_proposal(self, height: int, round_: int) -> None:
+        """state.go:1219 defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            last_ext = self._last_extended_commit()
+            if last_ext is None:
+                return
+            block, parts = await self.block_exec.create_proposal_block(
+                height, self.state, last_ext,
+                self.priv_validator.get_pub_key().address(), self.now_ns())
+        bid = BlockID(block.hash(), parts.header())
+        proposal = Proposal(height=height, round=round_,
+                            pol_round=rs.valid_round, block_id=bid,
+                            timestamp_ns=block.header.time_ns)
+        self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        # own proposal: deliver to self (WAL-synced) + broadcast
+        await self._handle("proposal", proposal, "", replay=False)
+        for i in range(parts.total):
+            await self._handle("part", (height, round_, parts.get_part(i)),
+                               "", replay=False)
+        if not self._replaying:
+            self.broadcast_proposal(proposal)
+            for i in range(parts.total):
+                self.broadcast_block_part(height, round_, parts.get_part(i))
+
+    def _last_extended_commit(self) -> ExtendedCommit | None:
+        """Commit for height-1 used when proposing (from our own precommit
+        set, or the block store after catch-up)."""
+        rs = self.rs
+        if rs.height == self.state.initial_height:
+            return ExtendedCommit(0, 0, BlockID(), [])
+        if rs.last_commit is not None and \
+                rs.last_commit.has_two_thirds_majority():
+            return rs.last_commit.make_extended_commit()
+        stored = self.block_store.load_block_extended_commit(rs.height - 1)
+        if stored is not None:
+            return stored
+        seen = self.block_store.load_seen_commit()
+        if seen is not None and seen.height == rs.height - 1:
+            from ..types.commit import ExtendedCommitSig
+
+            return ExtendedCommit(seen.height, seen.round, seen.block_id,
+                                  [ExtendedCommitSig(cs)
+                                   for cs in seen.signatures])
+        return None
+
+    # ------------------------------------------------------------ proposal rx
+
+    async def _set_proposal(self, proposal: Proposal) -> None:
+        """state.go setProposal + defaultSetProposal."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or \
+                (proposal.pol_round >= proposal.round):
+            return
+        proposer = self._round_proposer(rs.round)
+        if not proposal.verify(self.state.chain_id, proposer.pub_key):
+            raise VoteSetError("invalid proposal signature")
+        rs.proposal = proposal
+        rs.proposal_receive_time_ns = self.now_ns()
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header)
+
+    async def _add_proposal_block_part(self, height: int, round_: int,
+                                       part: Part) -> None:
+        rs = self.rs
+        if height != rs.height:
+            return
+        if rs.proposal_block_parts is None:
+            return              # parts before proposal: dropped (gossip re-sends)
+        try:
+            added = rs.proposal_block_parts.add_part(part)
+        except Exception:
+            return
+        if not added or not rs.proposal_block_parts.is_complete():
+            return
+        rs.proposal_block = codec.unpack(rs.proposal_block_parts.get_data())
+        self.event_bus.publish(ev.EVENT_COMPLETE_PROPOSAL,
+                               {"height": height,
+                                "hash": rs.proposal_block.hash().hex()})
+        await self._handle_complete_proposal(height)
+
+    async def _handle_complete_proposal(self, height: int) -> None:
+        """state.go handleCompleteProposal."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        maj, has_maj = (prevotes.two_thirds_majority()
+                        if prevotes else (None, False))
+        if has_maj and maj is not None and not maj.is_nil() and \
+                rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == maj.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= STEP_PROPOSE and rs.proposal_complete():
+            await self._enter_prevote(height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            await self._try_finalize_commit(height)
+
+    # -------------------------------------------------------------- prevote
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE):
+            return
+        rs.step = STEP_PREVOTE
+        await self._do_prevote(height, round_)
+
+    async def _do_prevote(self, height: int, round_: int) -> None:
+        """state.go:1380 defaultDoPrevote."""
+        rs = self.rs
+        # locked block: prevote it (L22/L28 with lock awareness)
+        if rs.proposal is None or rs.proposal_block is None:
+            await self._sign_add_vote(PREVOTE_TYPE, BlockID())
+            return
+        block = rs.proposal_block
+        pol = rs.proposal.pol_round
+        prevote_ok: bool
+        if rs.locked_round == -1 or rs.locked_block is None:
+            lock_allows = True
+        elif rs.locked_block.hash() == block.hash():
+            lock_allows = True
+        elif pol >= 0:
+            pol_votes = rs.votes.prevotes(pol)
+            pol_maj, has = (pol_votes.two_thirds_majority()
+                            if pol_votes else (None, False))
+            lock_allows = (has and pol_maj is not None
+                           and pol_maj.hash == block.hash()
+                           and pol >= rs.locked_round)
+        else:
+            lock_allows = False
+
+        valid = lock_allows
+        if valid:
+            try:
+                self.block_exec.validate_block(self.state, block)
+            except BlockValidationError:
+                valid = False
+        if valid and self.state.consensus_params.feature.pbts_enabled(height):
+            valid = self.state.consensus_params.synchrony.in_timely_bounds(
+                rs.proposal.timestamp_ns, rs.proposal_receive_time_ns,
+                round_)
+        if valid:
+            valid = await self.block_exec.process_proposal(block, self.state)
+
+        if valid:
+            bid = BlockID(block.hash(), rs.proposal_block_parts.header())
+            await self._sign_add_vote(PREVOTE_TYPE, bid)
+        else:
+            await self._sign_add_vote(PREVOTE_TYPE, BlockID())
+
+    # ------------------------------------------------------------ precommit
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT):
+            return
+        rs.step = STEP_PREVOTE_WAIT
+        self.ticker.schedule(TimeoutInfo(self.cfg.prevote_timeout(round_),
+                                         height, round_, STEP_PREVOTE_WAIT))
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        """state.go:1604."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
+            return
+        rs.step = STEP_PRECOMMIT
+        prevotes = rs.votes.prevotes(round_)
+        maj, has_maj = (prevotes.two_thirds_majority()
+                        if prevotes else (None, False))
+        if not has_maj:
+            await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
+            return
+        if maj.is_nil():
+            # +2/3 prevoted nil: unlock (state.go: "the latest POLRound")
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
+            return
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == maj.hash:
+            rs.locked_round = round_          # relock
+            self.event_bus.publish(ev.EVENT_RELOCK, {"height": height})
+            await self._sign_add_vote(PRECOMMIT_TYPE, maj)
+            return
+        if rs.proposal_block is not None and \
+                rs.proposal_block.hash() == maj.hash:
+            try:
+                self.block_exec.validate_block(self.state, rs.proposal_block)
+            except BlockValidationError:
+                await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
+                return
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish(ev.EVENT_LOCK, {"height": height})
+            await self._sign_add_vote(PRECOMMIT_TYPE, maj)
+            return
+        # +2/3 for a block we don't have: precommit nil, fetch via gossip
+        await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                rs.triggered_timeout_precommit:
+            return
+        rs.triggered_timeout_precommit = True
+        self.ticker.schedule(TimeoutInfo(self.cfg.precommit_timeout(round_),
+                                         height, round_,
+                                         STEP_PRECOMMIT_WAIT))
+
+    # --------------------------------------------------------------- commit
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        """state.go:1738."""
+        rs = self.rs
+        if rs.height != height or rs.step == STEP_COMMIT:
+            return
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = self.now_ns()
+        precommits = rs.votes.precommits(commit_round)
+        maj, _ = precommits.two_thirds_majority()
+        # if we have the locked block and it is the committed one, promote it
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == maj.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        elif rs.proposal_block is None or \
+                rs.proposal_block.hash() != maj.hash:
+            # we don't have the block yet: set up parts to receive it
+            if rs.proposal_block_parts is None or \
+                    rs.proposal_block_parts.header() != maj.part_set_header:
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(maj.part_set_header)
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(rs.commit_round)
+        maj, has = precommits.two_thirds_majority()
+        if not has or maj is None or maj.is_nil():
+            return
+        if rs.proposal_block is None or rs.proposal_block.hash() != maj.hash:
+            return
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """state.go:1829 — save, WAL EndHeight, apply, advance."""
+        rs = self.rs
+        precommits = rs.votes.precommits(rs.commit_round)
+        maj, _ = precommits.two_thirds_majority()
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        bid = BlockID(block.hash(), parts.header())
+
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < height:
+            ext = precommits.make_extended_commit()
+            self.block_store.save_block_with_extended_commit(
+                block, parts, ext)
+        if self.wal is not None and not self._replaying:
+            self.wal.write_end_height(height)
+
+        new_state = await self.block_exec.apply_block(
+            self.state, bid, block, verified=True)
+
+        self._update_to_state(new_state)
+        self.decided.set()
+        self.decided = asyncio.Event()
+        self.decided_height = height
+        self._schedule_round0_now()
+
+    # ----------------------------------------------------------------- votes
+
+    async def _sign_add_vote(self, typ: int, block_id: BlockID) -> None:
+        """state.go:2587 signAddVote + vote extension handling (:2544)."""
+        if self.priv_validator is None:
+            return
+        rs = self.rs
+        addr = self.priv_validator.get_pub_key().address()
+        idx, val = self.state.validators.get_by_address(addr)
+        if idx < 0:
+            return
+        vote = Vote(type=typ, height=rs.height, round=rs.round,
+                    block_id=block_id, timestamp_ns=self.now_ns(),
+                    validator_address=addr, validator_index=idx)
+        ext_enabled = self.state.consensus_params.feature \
+            .vote_extensions_enabled(rs.height)
+        sign_ext = False
+        if typ == PRECOMMIT_TYPE and not block_id.is_nil() and ext_enabled:
+            vote.extension = await self.block_exec.extend_vote(vote)
+            sign_ext = True
+        self.priv_validator.sign_vote(self.state.chain_id, vote,
+                                      sign_extension=sign_ext)
+        await self._handle("vote", vote, "", replay=False)
+        if not self._replaying:
+            self.broadcast_vote(vote)
+
+    async def _try_add_vote(self, vote: Vote, peer: str) -> None:
+        """state.go:2284 addVote."""
+        rs = self.rs
+        # late precommit for the previous height extends our last commit
+        if vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            if rs.last_commit is not None:
+                try:
+                    rs.last_commit.add_vote(vote)
+                except (VoteSetError, ConflictingVoteError):
+                    pass
+            return
+        if vote.height != rs.height:
+            return
+
+        # verify extension for our-height precommits when enabled
+        ext_enabled = self.state.consensus_params.feature \
+            .vote_extensions_enabled(rs.height)
+        if (ext_enabled and vote.type == PRECOMMIT_TYPE
+                and not vote.block_id.is_nil()
+                and peer != ""):
+            if not await self.block_exec.verify_vote_extension(vote):
+                raise VoteSetError("rejected vote extension")
+
+        try:
+            added = rs.votes.add_vote(vote, peer)
+        except ConflictingVoteError as e:
+            self.on_conflicting_vote(e.existing, e.new)
+            return
+        except VoteSetError:
+            if peer == "":
+                return          # replay of our own vote with drifted ts
+            raise
+        if not added:
+            return
+        self.event_bus.publish(ev.EVENT_VOTE, {"vote": vote})
+
+        if vote.type == PREVOTE_TYPE:
+            await self._on_prevote_added(vote)
+        else:
+            await self._on_precommit_added(vote)
+
+    async def _on_prevote_added(self, vote: Vote) -> None:
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        maj, has_maj = prevotes.two_thirds_majority()
+
+        if has_maj and maj is not None and not maj.is_nil():
+            # unlock if a newer POL supersedes our lock (L32/L36)
+            if rs.locked_round < vote.round <= rs.round and \
+                    rs.locked_block is not None and \
+                    rs.locked_block.hash() != maj.hash:
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+            # update valid block (L36)
+            if vote.round == rs.round and rs.valid_round < vote.round:
+                if rs.proposal_block is not None and \
+                        rs.proposal_block.hash() == maj.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                self.event_bus.publish(ev.EVENT_POLKA,
+                                       {"height": rs.height,
+                                        "round": vote.round})
+
+        if vote.round == rs.round:
+            if has_maj and maj is not None:
+                if rs.step >= STEP_PREVOTE and not maj.is_nil():
+                    await self._enter_precommit(rs.height, vote.round)
+                elif rs.step >= STEP_PREVOTE and maj.is_nil():
+                    await self._enter_precommit(rs.height, vote.round)
+            elif rs.step == STEP_PREVOTE and prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(rs.height, vote.round)
+        elif vote.round > rs.round and \
+                prevotes.has_two_thirds_any():
+            # skip ahead (L55: f+1 messages from a higher round; we use the
+            # stronger 2/3-any condition like the reference)
+            await self._enter_new_round(rs.height, vote.round)
+
+    async def _on_precommit_added(self, vote: Vote) -> None:
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        maj, has_maj = precommits.two_thirds_majority()
+        if has_maj and maj is not None:
+            await self._enter_new_round(rs.height, vote.round)
+            await self._enter_precommit(rs.height, vote.round)
+            if not maj.is_nil():
+                await self._enter_commit(rs.height, vote.round)
+            else:
+                await self._enter_precommit_wait(rs.height, vote.round)
+        elif precommits.has_two_thirds_any():
+            if vote.round >= rs.round:
+                await self._enter_new_round(rs.height, vote.round)
+                await self._enter_precommit_wait(rs.height, vote.round)
+
+
+# --------------------------------------------------------- WAL wire helpers
+
+def _msg_to_wire(kind: str, payload):
+    if kind == "proposal":
+        return codec.to_dict(payload)
+    if kind == "vote":
+        return codec.to_dict(payload)
+    if kind == "part":
+        h, r, part = payload
+        return {"h": h, "r": r, "i": part.index, "b": part.bytes_,
+                "pt": part.proof.total, "pi": part.proof.index,
+                "pl": part.proof.leaf_hash, "pa": part.proof.aunts}
+    raise ValueError(kind)
+
+
+def _msg_from_wire(kind: str, data):
+    if kind in ("proposal", "vote"):
+        return codec.from_dict(data)
+    if kind == "part":
+        from ..crypto.merkle import Proof
+
+        part = Part(data["i"], data["b"],
+                    Proof(data["pt"], data["pi"], data["pl"],
+                          list(data["pa"])))
+        return (data["h"], data["r"], part)
+    raise ValueError(kind)

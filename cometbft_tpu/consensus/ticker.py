@@ -1,0 +1,41 @@
+"""Timeout ticker (reference: ``internal/consensus/ticker.go``): one pending
+timeout at a time; scheduling overrides the previous.  Mockable for
+deterministic tests (tests drive ``fire`` directly)."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration_ns: int
+    height: int
+    round: int
+    step: int
+
+
+class TimeoutTicker:
+    def __init__(self, deliver):
+        """``deliver(TimeoutInfo)`` is called on the event loop when a
+        timeout fires (posts into the consensus queue)."""
+        self._deliver = deliver
+        self._task: asyncio.Task | None = None
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = asyncio.get_running_loop().create_task(self._run(ti))
+
+    async def _run(self, ti: TimeoutInfo) -> None:
+        try:
+            await asyncio.sleep(ti.duration_ns / 1e9)
+            self._deliver(ti)
+        except asyncio.CancelledError:
+            pass
+
+    def stop(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None

@@ -1,0 +1,104 @@
+"""Consensus write-ahead log (reference: ``internal/consensus/wal.go``).
+
+Every message (peer msg, own msg, timeout) is logged *before* processing;
+own votes/proposals are fsync'd before they can be sent (the double-sign
+safety argument, ``internal/consensus/state.go:843``).  Records are
+``crc32(body) | len | body`` with msgpack bodies; a height sentinel
+(``EndHeightMessage``, wal.go:43) marks each committed height so replay
+starts after the last one.  Torn tails are truncated on open."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import msgpack
+
+_HDR = struct.Struct("<II")
+MAX_BODY = 1 << 20          # 1 MB cap, like the reference's maxMsgSizeBytes
+
+
+class WALError(Exception):
+    pass
+
+
+class WAL:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._truncate_torn_tail()
+        self._f = open(path, "ab")
+
+    def _truncate_torn_tail(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        off = 0
+        good = 0
+        while off + _HDR.size <= len(raw):
+            crc, ln = _HDR.unpack_from(raw, off)
+            if ln > MAX_BODY:
+                break
+            end = off + _HDR.size + ln
+            if end > len(raw) or zlib.crc32(raw[off + _HDR.size:end]) != crc:
+                break
+            off = good = end
+        if good < len(raw):
+            with open(self.path, "r+b") as f:
+                f.truncate(good)
+
+    def write(self, record: dict) -> None:
+        body = msgpack.packb(record, use_bin_type=True)
+        if len(body) > MAX_BODY:
+            raise WALError(f"record too big: {len(body)}")
+        self._f.write(_HDR.pack(zlib.crc32(body), len(body)) + body)
+
+    def write_sync(self, record: dict) -> None:
+        self.write(record)
+        self.flush_and_sync()
+
+    def write_end_height(self, height: int) -> None:
+        """fsync'd height sentinel (wal.go:202 EndHeightMessage)."""
+        self.write_sync({"#": "endheight", "h": height})
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def iter_records(self):
+        """All intact records from the start (corruption already truncated)."""
+        self.flush_and_sync()
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        off = 0
+        while off + _HDR.size <= len(raw):
+            crc, ln = _HDR.unpack_from(raw, off)
+            end = off + _HDR.size + ln
+            if end > len(raw) or zlib.crc32(raw[off + _HDR.size:end]) != crc:
+                return
+            yield msgpack.unpackb(raw[off + _HDR.size:end], raw=False)
+            off = end
+
+    def records_after_height(self, height: int) -> list[dict]:
+        """Records following the EndHeight(h) sentinel for h == height
+        (replay input: catchupReplay, replay.go:95).  If the sentinel is
+        missing, returns records from the start (fresh WAL)."""
+        out: list[dict] = []
+        found = height == 0
+        for rec in self.iter_records():
+            if rec.get("#") == "endheight":
+                if rec["h"] == height:
+                    found = True
+                    out = []
+                elif rec["h"] > height and not found:
+                    raise WALError(
+                        f"WAL jumped past height {height} (saw {rec['h']})")
+                continue
+            if found or height == 0:
+                out.append(rec)
+        return out
+
+    def close(self) -> None:
+        self._f.close()

@@ -1,0 +1,68 @@
+"""Async pub/sub event bus (reference: ``libs/pubsub/pubsub.go`` +
+``types/event_bus.go``).
+
+Subscriptions match on event type plus optional attribute equality
+constraints (the core of the reference's query language
+``tm.event='Tx' AND tx.hash='...'``; the full query grammar lives in
+``rpc/``'s query compiler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Message:
+    event_type: str
+    data: object
+    attrs: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Subscription:
+    query: dict[str, str]                # attr -> required value; "" matches
+    queue: asyncio.Queue = field(default_factory=lambda: asyncio.Queue(256))
+
+    def matches(self, msg: Message) -> bool:
+        for k, want in self.query.items():
+            if k == "tm.event":
+                if msg.event_type != want:
+                    return False
+            elif msg.attrs.get(k) != want:
+                return False
+        return True
+
+
+class EventBus:
+    """Fire-and-forget publisher; slow subscribers drop oldest (the
+    reference cancels slow subscribers — dropping oldest keeps liveness
+    without killing the subscription)."""
+
+    def __init__(self):
+        self._subs: dict[str, Subscription] = {}
+
+    def subscribe(self, subscriber: str,
+                  query: dict[str, str]) -> Subscription:
+        sub = Subscription(query)
+        self._subs[subscriber] = sub
+        return sub
+
+    def unsubscribe(self, subscriber: str) -> None:
+        self._subs.pop(subscriber, None)
+
+    def publish(self, event_type: str, data: object,
+                attrs: dict[str, str] | None = None) -> None:
+        msg = Message(event_type, data, attrs or {})
+        for sub in self._subs.values():
+            if sub.matches(msg):
+                if sub.queue.full():
+                    try:
+                        sub.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        pass
+                sub.queue.put_nowait(msg)
+
+    def num_subscribers(self) -> int:
+        return len(self._subs)

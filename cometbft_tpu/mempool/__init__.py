@@ -1,0 +1,8 @@
+"""Mempool (reference: ``mempool/``): the Mempool interface
+(``mempool/mempool.go:26-100``), the CList FIFO implementation and the
+disabled variant."""
+
+from .mempool import Mempool, NopMempool, TxKey
+from .clist_mempool import CListMempool
+
+__all__ = ["Mempool", "NopMempool", "CListMempool", "TxKey"]

@@ -1,0 +1,30 @@
+"""Fixed-size LRU cache of tx keys (reference: ``mempool/cache.go``)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class LRUTxCache:
+    def __init__(self, size: int = 10_000):
+        self.capacity = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, key: bytes) -> bool:
+        """Returns False if the key was already present."""
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        self._map[key] = None
+        if len(self._map) > self.capacity:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, key: bytes) -> None:
+        self._map.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._map
+
+    def reset(self) -> None:
+        self._map.clear()

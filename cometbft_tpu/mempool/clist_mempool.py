@@ -1,0 +1,149 @@
+"""FIFO mempool (reference: ``mempool/clist_mempool.go``).
+
+The reference's concurrent linked list + mutexes collapse, under a
+single-threaded asyncio runtime, to an ordered dict guarded by one async
+lock for the update/recheck critical section.  Semantics kept: LRU cache
+dedup (committed txs stay cached), post-block recheck of survivors through
+the app's mempool connection, gas/byte-capped reaping, and an async
+"txs available" signal for the consensus proposer
+(``mempool/clist_mempool.go:241,307,383,497``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+
+from ..abci.client import ABCIClient
+from .cache import LRUTxCache
+from .mempool import Mempool, TxKey
+
+
+@dataclass
+class _MempoolTx:
+    tx: bytes
+    gas_wanted: int
+    height: int          # height when first admitted
+
+
+class TxRejectedError(Exception):
+    def __init__(self, code: int, log: str):
+        self.code = code
+        self.log = log
+        super().__init__(f"tx rejected: code={code} {log}")
+
+
+class CListMempool(Mempool):
+    def __init__(self, app_conn: ABCIClient, max_txs: int = 5000,
+                 max_tx_bytes: int = 1024 * 1024, cache_size: int = 10_000,
+                 keep_invalid_txs_in_cache: bool = False):
+        self.app = app_conn
+        self.max_txs = max_txs
+        self.max_tx_bytes = max_tx_bytes
+        self.cache = LRUTxCache(cache_size)
+        self.keep_invalid = keep_invalid_txs_in_cache
+        self._txs: dict[bytes, _MempoolTx] = {}      # insertion-ordered FIFO
+        self._lock = asyncio.Lock()
+        self._txs_available = asyncio.Event()
+        self._notified_available = False
+        self.height = 0
+
+    # ------------------------------------------------------------- check_tx
+
+    async def check_tx(self, tx: bytes) -> None:
+        """Admit a tx (rpc broadcast_tx / p2p gossip entry).  Raises
+        TxRejectedError on app rejection; silently ignores cache hits."""
+        if len(tx) > self.max_tx_bytes:
+            raise TxRejectedError(1, "tx too large")
+        if len(self._txs) >= self.max_txs:
+            raise TxRejectedError(1, "mempool is full")
+        key = TxKey(tx)
+        if not self.cache.push(key):
+            return                       # seen before (maybe committed)
+        async with self._lock:
+            res = await self.app.check_tx(tx, recheck=False)
+            if not res.is_ok:
+                if not self.keep_invalid:
+                    self.cache.remove(key)
+                raise TxRejectedError(res.code, res.log)
+            if key not in self._txs:
+                self._txs[key] = _MempoolTx(tx, res.gas_wanted, self.height)
+                self._notify_available()
+
+    def _notify_available(self):
+        if self._txs and not self._notified_available:
+            self._notified_available = True
+            self._txs_available.set()
+
+    def txs_available(self) -> asyncio.Event:
+        return self._txs_available
+
+    # --------------------------------------------------------------- reaping
+
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> list[bytes]:
+        out, total_bytes, total_gas = [], 0, 0
+        for item in self._txs.values():
+            total_bytes += len(item.tx)
+            if max_bytes >= 0 and total_bytes > max_bytes:
+                break
+            total_gas += item.gas_wanted
+            if max_gas >= 0 and total_gas > max_gas:
+                break
+            out.append(item.tx)
+        return out
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        return [item.tx for item in list(self._txs.values())[:n]]
+
+    # ---------------------------------------------------------------- update
+
+    def lock(self):
+        """The executor holds this across FinalizeBlock-Commit-update
+        (state/execution.go:295,391-460)."""
+        return self._lock
+
+    async def update(self, height: int, txs: list[bytes],
+                     tx_results: list) -> None:
+        """Remove committed txs, keep them cached, recheck survivors.
+        Caller must hold lock() (like the reference's Lock/Update contract)."""
+        self.height = height
+        self._notified_available = False
+        self._txs_available.clear()
+        for i, tx in enumerate(txs):
+            key = TxKey(tx)
+            ok = i >= len(tx_results) or tx_results[i].is_ok
+            if ok:
+                self.cache.push(key)     # committed txs stay in cache
+            elif not self.keep_invalid:
+                self.cache.remove(key)
+            self._txs.pop(key, None)
+        # recheck survivors against the post-block app state
+        for key in list(self._txs.keys()):
+            item = self._txs.get(key)
+            if item is None:
+                continue
+            res = await self.app.check_tx(item.tx, recheck=True)
+            if not res.is_ok:
+                del self._txs[key]
+                if not self.keep_invalid:
+                    self.cache.remove(key)
+        if self._txs:
+            self._notify_available()
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        return sum(len(i.tx) for i in self._txs.values())
+
+    async def flush(self) -> None:
+        async with self._lock:
+            self._txs.clear()
+            self.cache.reset()
+            self._txs_available.clear()
+            self._notified_available = False
+
+    def contents(self) -> list[bytes]:
+        """Iteration snapshot for the gossip reactor."""
+        return [i.tx for i in self._txs.values()]

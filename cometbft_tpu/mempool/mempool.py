@@ -1,0 +1,61 @@
+"""Mempool interface + Nop variant (reference: ``mempool/mempool.go:26-100``,
+``mempool/nop_mempool.go``)."""
+
+from __future__ import annotations
+
+import hashlib
+from abc import ABC, abstractmethod
+
+
+def TxKey(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+class Mempool(ABC):
+    @abstractmethod
+    async def check_tx(self, tx: bytes): ...
+
+    @abstractmethod
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> list[bytes]: ...
+
+    @abstractmethod
+    async def update(self, height: int, txs: list[bytes],
+                     tx_results: list) -> None: ...
+
+    @abstractmethod
+    def lock(self): ...
+
+    @abstractmethod
+    def size(self) -> int: ...
+
+    @abstractmethod
+    async def flush(self) -> None: ...
+
+    def txs_available(self):
+        """Async event set when txs become available (may be unsupported)."""
+        return None
+
+
+class NopMempool(Mempool):
+    """Disabled mempool for app-side mempools (``mempool/nop_mempool.go``)."""
+
+    async def check_tx(self, tx):
+        raise RuntimeError("mempool is disabled")
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return []
+
+    async def update(self, height, txs, tx_results):
+        pass
+
+    def lock(self):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def size(self):
+        return 0
+
+    async def flush(self):
+        pass

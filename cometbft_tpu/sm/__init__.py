@@ -1,0 +1,8 @@
+"""State-machine replication core (reference: ``state/`` — BlockExecutor,
+block validation, state transitions)."""
+
+from .execution import BlockExecutor, NopEvidencePool
+from .validation import validate_block, BlockValidationError
+
+__all__ = ["BlockExecutor", "NopEvidencePool", "validate_block",
+           "BlockValidationError"]

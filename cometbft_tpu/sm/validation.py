@@ -1,0 +1,97 @@
+"""Block validation against committed state (reference:
+``state/validation.go``).  The LastCommit check at the bottom is THE
+batch hot path — ``state/validation.go:94`` → ``types/validation.go:28`` →
+the TPU batch verifier."""
+
+from __future__ import annotations
+
+from ..storage.statestore import State
+from ..types.commit import Commit
+from ..types.header import BLOCK_PROTOCOL_VERSION, Block
+from ..types.validation import VerifyCommit
+
+
+class BlockValidationError(Exception):
+    pass
+
+
+def median_time(commit: Commit, validators) -> int:
+    """Voting-power-weighted median of commit timestamps — BFT time
+    (types/block.go:949 MedianTime)."""
+    pairs = []
+    total = 0
+    for i, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        _, val = validators.get_by_address(cs.validator_address)
+        if val is None:
+            continue
+        pairs.append((cs.timestamp_ns, val.voting_power))
+        total += val.voting_power
+    if not pairs:
+        return 0
+    pairs.sort()
+    mid = (total - 1) // 2
+    acc = 0
+    for ts, power in pairs:
+        acc += power
+        if acc > mid:
+            return ts
+    return pairs[-1][0]
+
+
+def validate_block(state: State, block: Block,
+                   backend: str | None = None) -> None:
+    """Raises BlockValidationError; mirrors state/validation.go checks."""
+    err = block.validate_basic()
+    if err:
+        raise BlockValidationError(f"invalid block: {err}")
+    h = block.header
+
+    if h.version_block != BLOCK_PROTOCOL_VERSION:
+        raise BlockValidationError("wrong block protocol version")
+    if h.chain_id != state.chain_id:
+        raise BlockValidationError(
+            f"wrong chain id {h.chain_id!r} != {state.chain_id!r}")
+    want_height = state.last_block_height + 1 \
+        if state.last_block_height else state.initial_height
+    if h.height != want_height:
+        raise BlockValidationError(
+            f"wrong height {h.height}, expected {want_height}")
+    if h.last_block_id != state.last_block_id:
+        raise BlockValidationError("wrong last_block_id")
+    if h.validators_hash != state.validators.hash():
+        raise BlockValidationError("wrong validators_hash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise BlockValidationError("wrong next_validators_hash")
+    if h.consensus_hash != state.consensus_params.hash():
+        raise BlockValidationError("wrong consensus_hash")
+    if h.app_hash != state.app_hash:
+        raise BlockValidationError("wrong app_hash")
+    if h.last_results_hash != state.last_results_hash:
+        raise BlockValidationError("wrong last_results_hash")
+    if not state.validators.has_address(h.proposer_address):
+        raise BlockValidationError("proposer not in validator set")
+
+    is_initial = h.height == state.initial_height
+    if is_initial:
+        if block.last_commit is not None and block.last_commit.size() > 0:
+            raise BlockValidationError(
+                "initial block cannot have a last commit")
+    else:
+        if block.last_commit is None:
+            raise BlockValidationError("missing last commit")
+        if state.last_validators is None:
+            raise BlockValidationError("no last validators to verify commit")
+        # ---- THE batch-verification hot path ----
+        VerifyCommit(state.chain_id, state.last_validators,
+                     state.last_block_id, h.height - 1, block.last_commit,
+                     backend=backend)
+        # BFT time: block time advances monotonically past the last block
+        if h.time_ns <= state.last_block_time_ns:
+            raise BlockValidationError("block time not monotonic")
+        if not state.consensus_params.feature.pbts_enabled(h.height):
+            want = median_time(block.last_commit, state.last_validators)
+            if h.time_ns != want:
+                raise BlockValidationError(
+                    f"block time {h.time_ns} != median time {want}")

@@ -1,8 +1,12 @@
 """Shared test/bench factories (role of the reference's ``internal/test``
-helpers, SURVEY.md §4): deterministic signature batches in the dense layout
-the device kernel consumes."""
+helpers + ``internal/consensus/common_test.go``, SURVEY.md §4):
+deterministic signature batches for the device kernel, and the tier-1
+in-process multi-validator consensus network (N ConsensusStates wired
+queue-to-queue with no real networking)."""
 
 from __future__ import annotations
+
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -38,3 +42,133 @@ def dense_signature_batch(bsz: int, msg_len: int = 120, seed: int = 7,
         host_items.append((pk, msg, sig))
     blocks, active = sha512.host_pad(hin, lens, 2)
     return (pubs, rs, ss, blocks, active), host_items
+
+
+@dataclass
+class InProcNode:
+    name: str
+    pv: object
+    app: object
+    state: object
+    consensus: object
+    block_store: object
+    state_store: object
+    mempool: object
+    event_bus: object
+    wal_path: str | None = None
+
+
+class InProcNetwork:
+    """Tier-1 harness: N validators in one event loop, direct queue wiring
+    (the reference's common_test.go ensemble without networking)."""
+
+    def __init__(self, nodes: list[InProcNode], partitions=None):
+        self.nodes = nodes
+        self.isolated: set[str] = set()      # names cut off from gossip
+        for node in nodes:
+            self._wire(node)
+
+    def _wire(self, node: InProcNode):
+        cs = node.consensus
+
+        def broadcast(fn_name, *args, _from=node.name):
+            if _from in self.isolated:
+                return
+            for other in self.nodes:
+                if other.name == _from or other.name in self.isolated:
+                    continue
+                getattr(other.consensus, fn_name)(*args, _from)
+
+        cs.broadcast_proposal = lambda p, _f=node.name: broadcast(
+            "feed_proposal", p, _from=_f)
+        cs.broadcast_block_part = lambda h, r, part, _f=node.name: broadcast(
+            "feed_block_part", h, r, part, _from=_f)
+        cs.broadcast_vote = lambda v, _f=node.name: broadcast(
+            "feed_vote", v, _from=_f)
+
+    def isolate(self, name: str):
+        self.isolated.add(name)
+
+    def heal(self, name: str):
+        self.isolated.discard(name)
+
+    async def start(self):
+        for n in self.nodes:
+            await n.consensus.start()
+
+    async def stop(self):
+        for n in self.nodes:
+            await n.consensus.stop()
+
+    async def wait_for_height(self, height: int, timeout: float = 30.0,
+                              nodes=None):
+        import asyncio
+
+        targets = nodes or self.nodes
+        async def all_reached():
+            while True:
+                if all(t.block_store.height() >= height for t in targets):
+                    return
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(all_reached(), timeout)
+
+
+async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
+                              app_factory=None, config=None,
+                              vote_extensions_height: int = 0,
+                              wal_dir: str | None = None,
+                              backend: str = "cpu",
+                              power=None) -> InProcNetwork:
+    from .abci.kvstore import KVStoreApplication
+    from .abci.client import LocalClient
+    from .config import test_consensus_config
+    from .consensus.state import ConsensusState
+    from .consensus.wal import WAL
+    from .libs.pubsub import EventBus
+    from .mempool.clist_mempool import CListMempool
+    from .sm.execution import BlockExecutor
+    from .storage import BlockStore, MemDB, State, StateStore
+    from .types.genesis import GenesisDoc, GenesisValidator
+    from .types.priv_validator import MockPV
+
+    app_factory = app_factory or KVStoreApplication
+    cfg = config or test_consensus_config()
+    pvs = [MockPV.from_secret(b"inproc%d" % i) for i in range(n_validators)]
+    doc = GenesisDoc(chain_id=chain_id,
+                     validators=[GenesisValidator(
+                         pv.get_pub_key(),
+                         (power[i] if power else 10))
+                         for i, pv in enumerate(pvs)])
+    doc.consensus_params.feature.vote_extensions_enable_height = \
+        vote_extensions_height
+
+    nodes = []
+    for i, pv in enumerate(pvs):
+        app = app_factory()
+        client = LocalClient(app)
+        bus = EventBus()
+        bstore = BlockStore(MemDB())
+        sstore = StateStore(MemDB())
+        mp = CListMempool(LocalClient(app))
+        state = State.from_genesis(doc)
+        execu = BlockExecutor(sstore, bstore, client, mp,
+                              event_bus=bus, backend=backend)
+        # app InitChain
+        from .abci import types as abci_t
+        await client.init_chain(abci_t.InitChainRequest(
+            chain_id=chain_id, initial_height=1, time_ns=0,
+            validators=[abci_t.ValidatorUpdate(
+                "ed25519", v.pub_key.bytes(), v.power)
+                for v in doc.validators],
+            app_state_bytes=doc.app_state))
+        wal = WAL(f"{wal_dir}/wal{i}.log") if wal_dir else None
+        cs = ConsensusState(cfg, state, execu, bstore, wal=wal,
+                            priv_validator=pv, event_bus=bus,
+                            name=f"node{i}")
+        nodes.append(InProcNode(
+            name=f"node{i}", pv=pv, app=app, state=state, consensus=cs,
+            block_store=bstore, state_store=sstore, mempool=mp,
+            event_bus=bus, wal_path=f"{wal_dir}/wal{i}.log"
+            if wal_dir else None))
+    return InProcNetwork(nodes)

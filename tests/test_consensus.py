@@ -1,0 +1,158 @@
+"""Tier-1 consensus tests: in-process multi-validator ensembles
+(the reference's internal/consensus/*_test.go strategy, SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.testing import make_inproc_network
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_four_validators_commit_blocks():
+    """THE milestone: 4 in-proc validators committing kvstore blocks."""
+
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            await net.start()
+            # inject transactions on every node's mempool
+            for i, node in enumerate(net.nodes):
+                await node.mempool.check_tx(b"k%d=v%d" % (i, i))
+            # a full proposer rotation so every node proposes at least once
+            await net.wait_for_height(6, timeout=60)
+            # all nodes agree on every block hash
+            for h in range(1, 7):
+                hashes = {n.block_store.load_block(h).hash()
+                          for n in net.nodes}
+                assert len(hashes) == 1, f"fork at height {h}"
+            committed = set()
+            for n in net.nodes:
+                for h in range(1, n.block_store.height() + 1):
+                    for tx in n.block_store.load_block(h).data.txs:
+                        committed.add(bytes(tx))
+            # every injected tx rode in on its owner's proposal turn
+            want = {b"k%d=v%d" % (i, i) for i in range(4)}
+            assert want <= committed, committed
+            # the app executed them: key present in every app's state
+            for n in net.nodes:
+                if n.block_store.height() >= 6:
+                    assert n.app.state.get(b"k0") == b"v0"
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_progress_with_one_node_down():
+    """3 of 4 validators (> 2/3) keep committing; the 4th catches up via
+    late vote delivery when healed (liveness under crash fault)."""
+
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            net.isolate("node3")
+            await net.start()
+            await net.wait_for_height(2, timeout=60, nodes=net.nodes[:3])
+            assert net.nodes[3].block_store.height() == 0
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_no_progress_without_quorum():
+    """With 2 of 4 isolated there is no +2/3: no blocks may be committed."""
+
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            net.isolate("node2")
+            net.isolate("node3")
+            await net.start()
+            await asyncio.sleep(2.0)
+            assert all(n.block_store.height() == 0 for n in net.nodes)
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_vote_extensions_enabled():
+    """Extensions enabled from height 1: extended commits carry extension
+    signatures and verify."""
+
+    async def main():
+        net = await make_inproc_network(4, vote_extensions_height=1)
+        try:
+            await net.start()
+            await net.wait_for_height(2, timeout=60)
+            node = net.nodes[0]
+            ext = node.block_store.load_block_extended_commit(1)
+            assert ext is not None
+            assert ext.ensure_extensions(True)
+            n_with_ext = sum(1 for e in ext.extended_signatures
+                             if e.commit_sig.is_commit()
+                             and e.extension_signature)
+            assert n_with_ext >= 3          # +2/3 signed extensions
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_wal_crash_recovery(tmp_path):
+    """Kill a node mid-flight; restart from WAL + stores; it rejoins and
+    the network continues (crash/recovery tier of SURVEY §4)."""
+
+    async def main():
+        net = await make_inproc_network(4, wal_dir=str(tmp_path))
+        try:
+            await net.start()
+            await net.wait_for_height(2, timeout=60)
+            # hard-stop node0 (no graceful anything)
+            victim = net.nodes[0]
+            await victim.consensus.stop()
+            net.isolate("node0")
+            await net.wait_for_height(
+                victim.block_store.height() + 1, timeout=60,
+                nodes=net.nodes[1:])
+
+            # restart consensus over the same stores + WAL
+            from cometbft_tpu.config import test_consensus_config
+            from cometbft_tpu.consensus.state import ConsensusState
+            from cometbft_tpu.consensus.wal import WAL
+
+            state = victim.state_store.load()
+            cs2 = ConsensusState(
+                test_consensus_config(), state,
+                victim.consensus.block_exec, victim.block_store,
+                wal=WAL(victim.wal_path), priv_validator=victim.pv,
+                event_bus=victim.event_bus, name="node0r")
+            victim.consensus = cs2
+            net._wire(victim)
+            net.heal("node0")
+            await cs2.start()
+            target = max(n.block_store.height() for n in net.nodes) + 2
+            await net.wait_for_height(target, timeout=60)
+            hashes = {n.block_store.load_block(target).hash()
+                      for n in net.nodes}
+            assert len(hashes) == 1
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
