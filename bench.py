@@ -3,52 +3,84 @@
 Mirrors BASELINE.json's metric ("ed25519 sig-verifies/sec/chip; p50
 Commit.VerifyCommit latency @10k vals") and the reference's bench harness
 (``crypto/ed25519/bench_test.go:31-67``, which benches BatchVerify at fixed
-sig counts): 10240 ed25519 signatures over ~120-byte vote-sign-bytes
-messages, verified on the accelerator via the ZIP-215 kernel.
+sig counts): ed25519 signatures over ~120-byte vote-sign-bytes messages,
+verified on the accelerator via the ZIP-215 kernel.
 
 ``vs_baseline`` is the measured speedup over the host CPU single-verify
-path (OpenSSL via the `cryptography` library on this machine's core — the
-stand-in for the reference's Go curve25519-voi verifier; voi's batch mode
-is ~2x the single path, so divide by ~2 for a conservative read).
+path (the stand-in for the reference's Go curve25519-voi verifier; voi's
+batch mode is ~2x the single path, so divide by ~2 for a conservative read).
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Robustness contract (the whole point of this file's structure): the parent
+process NEVER imports jax.  The TPU attempt runs in a subprocess with a hard
+timeout — on this image the axon TPU relay can wedge so that backend init
+hangs forever — and on failure/timeout a CPU-backend subprocess runs
+instead.  Exactly one JSON line is always printed, and the exit code is 0,
+so the driver always records a result.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
-def main():
+# --------------------------------------------------------------------------
+# child: does the actual measurement on one backend, prints one JSON line
+# --------------------------------------------------------------------------
+
+def _child_main(backend: str, nsig: int) -> None:
+    def note(msg):
+        print(f"[bench:{backend}] {msg}", file=sys.stderr, flush=True)
+
     import jax
+
+    from cometbft_tpu.jaxenv import enable_compile_cache, force_cpu_backend
+
+    enable_compile_cache()
+    if backend == "cpu":
+        force_cpu_backend()
+
+    import numpy as np
 
     from cometbft_tpu.crypto.keys import verify_ed25519_zip215
     from cometbft_tpu.ops import ed25519
     from cometbft_tpu.testing import dense_signature_batch
 
-    nsig = int(os.environ.get("BENCH_NSIG", "10240"))
-    batch_args, host_items = dense_signature_batch(nsig, msg_len=120, seed=2024)
+    note("building signature batch")
+    batch_args, host_items = dense_signature_batch(nsig, msg_len=120,
+                                                   seed=2024)
 
+    note("initializing backend")
     dev = jax.devices()[0]
+    note(f"device = {dev}")
+    if backend == "tpu" and dev.platform == "cpu":
+        # jax silently fell back to CPU: fail so the parent runs the
+        # properly-sized CPU attempt instead of mislabeling this one.
+        raise RuntimeError("requested accelerator but got CPU backend")
     fn = jax.jit(ed25519.verify_padded)
     args = jax.device_put(batch_args, dev)
-    out = np.asarray(fn(*args))          # compile + correctness
+    note("compiling + first run")
+    t0 = time.perf_counter()
+    out = np.asarray(fn(*args))
+    note(f"compile+run took {time.perf_counter() - t0:.1f}s")
     assert out.all(), "benchmark batch failed verification"
 
+    reps = int(os.environ.get("BENCH_REPS", "10" if backend != "cpu" else "5"))
     times = []
-    for _ in range(10):
+    for _ in range(reps):
         t0 = time.perf_counter()
         fn(*args)[0].block_until_ready()
         times.append(time.perf_counter() - t0)
     p50 = float(np.percentile(times, 50))
     sigs_per_sec = nsig / p50
 
-    # CPU baseline: host single-verify over a 512-sig sample, extrapolated
-    sample = host_items[:512]
+    # Host baseline: single-verify over a sample, extrapolated to nsig.
+    sample = host_items[:min(256, len(host_items))]
     t0 = time.perf_counter()
     for pk, msg, sig in sample:
         assert verify_ed25519_zip215(pk, msg, sig)
@@ -56,16 +88,87 @@ def main():
     vs_baseline = (cpu_per_sig * nsig) / p50
 
     print(json.dumps({
-        "metric": "ed25519 sig-verifies/sec/chip (10k-validator extended-commit batch)",
+        "metric": "ed25519 sig-verifies/sec/chip "
+                  "(extended-commit-shaped batch)",
         "value": round(sigs_per_sec, 1),
         "unit": "sigs/s",
         "vs_baseline": round(vs_baseline, 2),
         "p50_batch_latency_ms": round(p50 * 1e3, 3),
         "batch_size": nsig,
+        "backend": backend,
         "device": str(dev),
         "cpu_single_verify_us": round(cpu_per_sig * 1e6, 1),
-    }))
+    }), flush=True)
+
+
+# --------------------------------------------------------------------------
+# parent: orchestrates attempts; never imports jax; always emits JSON
+# --------------------------------------------------------------------------
+
+def _run_attempt(backend: str, nsig: int, timeout_s: float) -> dict | None:
+    env = dict(os.environ)
+    if backend == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--_child", backend, str(nsig)]
+    print(f"[bench] attempt backend={backend} nsig={nsig} "
+          f"timeout={timeout_s:.0f}s", file=sys.stderr, flush=True)
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"[bench] backend={backend} TIMED OUT after {timeout_s:.0f}s",
+              file=sys.stderr, flush=True)
+        return None
+    if proc.returncode != 0:
+        print(f"[bench] backend={backend} exited rc={proc.returncode}",
+              file=sys.stderr, flush=True)
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    nsig_tpu = int(os.environ.get("BENCH_NSIG", "10240"))
+    nsig_cpu = int(os.environ.get("BENCH_NSIG_CPU", "1024"))
+    t_tpu = float(os.environ.get("BENCH_TPU_TIMEOUT", "480"))
+    t_cpu = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    want_tpu = ("cpu" != platforms.strip().lower())
+
+    attempts: list[tuple[str, int, float]] = []
+    if want_tpu:
+        attempts.append(("tpu", nsig_tpu, t_tpu))
+    attempts.append(("cpu", nsig_cpu, t_cpu))
+
+    errors = []
+    for backend, nsig, timeout_s in attempts:
+        result = _run_attempt(backend, nsig, timeout_s)
+        if result is not None:
+            print(json.dumps(result), flush=True)
+            return
+        errors.append(backend)
+
+    # Every attempt failed: still emit a well-formed result line.
+    print(json.dumps({
+        "metric": "ed25519 sig-verifies/sec/chip "
+                  "(extended-commit-shaped batch)",
+        "value": 0,
+        "unit": "sigs/s",
+        "vs_baseline": 0,
+        "error": f"all backends failed: {errors}",
+    }), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 2 and sys.argv[1] == "--_child":
+        _child_main(sys.argv[2], int(sys.argv[3]))
+    else:
+        main()

@@ -1,0 +1,68 @@
+"""JAX backend environment control shared by tests, bench, and driver entry.
+
+On this image a sitecustomize pre-registers the ``axon`` TPU backend whose
+relay can wedge so that the first backend init (``jax.devices()``) hangs
+forever.  Anything that is CPU-only by design (tests, the multichip dryrun,
+the bench CPU fallback) must force the CPU backend *and* deregister the
+axon/tpu factories before any backend init, or it can never be trusted to
+terminate.  Keeping the defense here means one place to fix when a jax
+upgrade moves the private factory registry.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def enable_compile_cache(cache_dir: str | None = None,
+                         min_compile_secs: float = 2.0) -> None:
+    """Point jax at the persistent on-disk XLA compile cache.
+
+    The ed25519 kernel takes ~1 min to compile per batch-shape bucket on one
+    CPU core; the cache makes every repeat process start in milliseconds.
+    """
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      cache_dir or os.path.join(_REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      min_compile_secs)
+
+
+def force_cpu_backend(min_devices: int | None = None) -> None:
+    """Force jax onto the CPU backend, optionally with >= min_devices
+    virtual devices, before any backend init.
+
+    Raises RuntimeError if a non-CPU backend was already initialized in this
+    process — the config updates would silently not apply.
+    """
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    platforms = sorted(getattr(_xb, "_backends", {}) or {})
+    if platforms and platforms != ["cpu"]:
+        raise RuntimeError(
+            f"jax backend(s) {platforms} already initialized; "
+            "force_cpu_backend() must run before any jax.devices()/jit")
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        _xb._backend_factories.pop("axon", None)
+        _xb._backend_factories.pop("tpu", None)
+    except AttributeError:  # private registry moved in a jax upgrade
+        pass
+
+    if min_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None or int(m.group(1)) < min_devices:
+            try:
+                jax.config.update("jax_num_cpu_devices", min_devices)
+            except Exception:
+                os.environ["XLA_FLAGS"] = (
+                    flags +
+                    f" --xla_force_host_platform_device_count={min_devices}"
+                ).strip()

@@ -1,8 +1,9 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU mesh *before* jax is imported anywhere:
+Force JAX onto a virtual 8-device CPU mesh *before* any backend init:
 multi-chip sharding (parallel/) is exercised on host CPU exactly the way the
-driver's dryrun does, and tests never contend for the real TPU.
+driver's dryrun does, and tests never contend for (or hang on) the real TPU.
+The force-CPU + compile-cache defenses live in cometbft_tpu.jaxenv.
 """
 
 import os
@@ -17,23 +18,46 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# The image's sitecustomize imports jax (axon TPU plugin) at interpreter
-# start, so jax latched JAX_PLATFORMS=axon before this file ran — the env
-# vars above don't reach jax.config anymore.  Force CPU through the config
-# API and deregister the axon/tpu factories so backend discovery can never
-# dial the TPU relay (tests are CPU-only by design; a wedged relay would
-# otherwise hang the first jit forever).
-import jax  # noqa: E402  (registers factories, does not init backends)
-from jax._src import xla_bridge as _xb  # noqa: E402
+from cometbft_tpu.jaxenv import enable_compile_cache, force_cpu_backend  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-# persistent compile cache: kernel compiles dominate suite time on 1 CPU core
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-try:
-    _xb._backend_factories.pop("axon", None)
-    _xb._backend_factories.pop("tpu", None)
-except AttributeError:  # private symbol moved in a jax upgrade
-    pass
+force_cpu_backend(min_devices=8)
+enable_compile_cache()
+
+
+# ---------------------------------------------------------------------------
+# Real per-test timeout enforcement. ``pytest-timeout`` is not installed in
+# this image, so ``pytest.mark.timeout(N)`` marks would silently be no-ops;
+# this hook honors them (default 180 s) via SIGALRM, which interrupts even a
+# stuck asyncio loop on the main thread.
+# ---------------------------------------------------------------------------
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+_DEFAULT_TEST_TIMEOUT = 180
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args \
+        else _DEFAULT_TEST_TIMEOUT
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"test exceeded {seconds}s timeout (conftest SIGALRM)")
+
+    old = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test wall-clock limit "
+        "(enforced by conftest SIGALRM)")
