@@ -67,6 +67,7 @@ class ConsensusState:
         self.ticker = TimeoutTicker(self._deliver_timeout)
         self._task: asyncio.Task | None = None
         self._replaying = False
+        self.fatal_error: Exception | None = None
         self._stopped = asyncio.Event()
         self.decided = asyncio.Event()      # pulses on every commit (tests)
 
@@ -121,18 +122,33 @@ class ConsensusState:
 
     # ------------------------------------------------------- receive routine
 
+    # consecutive handler failures before the node halts itself: a
+    # deterministic bug must not become a silent infinite error loop
+    MAX_CONSECUTIVE_ERRORS = 16
+
     async def _receive_routine(self) -> None:
         """state.go:788 — the single writer."""
+        consecutive_errors = 0
         while True:
             kind, payload, peer = await self.queue.get()
             try:
                 await self._handle(kind, payload, peer, replay=False)
+                consecutive_errors = 0
             except asyncio.CancelledError:
                 raise
-            except Exception as e:       # keep consensus alive; log
+            except Exception as e:       # recoverable: log and continue
                 import traceback
                 traceback.print_exc()
                 print(f"[{self.name}] consensus error on {kind}: {e!r}")
+                consecutive_errors += 1
+                if consecutive_errors >= self.MAX_CONSECUTIVE_ERRORS:
+                    # fatal: stop processing so the failure is observable
+                    # (the reference dies and relies on WAL recovery)
+                    self.fatal_error = e
+                    self.ticker.stop()
+                    print(f"[{self.name}] HALT: {consecutive_errors} "
+                          "consecutive consensus errors")
+                    return
 
     async def _handle(self, kind: str, payload, peer: str,
                       replay: bool) -> None:
@@ -347,6 +363,13 @@ class ConsensusState:
             return stored
         seen = self.block_store.load_seen_commit()
         if seen is not None and seen.height == rs.height - 1:
+            if self.state.consensus_params.feature.vote_extensions_enabled(
+                    rs.height - 1):
+                # A plain commit cannot be promoted when extensions were
+                # required at that height (types/block.go EnsureExtensions):
+                # the fabricated ExtendedCommitSigs would carry no
+                # extensions and the proposal would be invalid.
+                return None
             from ..types.commit import ExtendedCommitSig
 
             return ExtendedCommit(seen.height, seen.round, seen.block_id,
@@ -429,8 +452,14 @@ class ConsensusState:
             await self._sign_add_vote(PREVOTE_TYPE, BlockID())
             return
         block = rs.proposal_block
+        # proposal timestamp must equal the proposed block's header time
+        # (defaultDoPrevote: a Byzantine proposer could otherwise commit an
+        # arbitrary header time — the network validates the *proposal*
+        # timestamp, so the block must carry the same one)
+        if rs.proposal.timestamp_ns != block.header.time_ns:
+            await self._sign_add_vote(PREVOTE_TYPE, BlockID())
+            return
         pol = rs.proposal.pol_round
-        prevote_ok: bool
         if rs.locked_round == -1 or rs.locked_block is None:
             lock_allows = True
         elif rs.locked_block.hash() == block.hash():
@@ -451,7 +480,11 @@ class ConsensusState:
                 self.block_exec.validate_block(self.state, block)
             except BlockValidationError:
                 valid = False
-        if valid and self.state.consensus_params.feature.pbts_enabled(height):
+        # PBTS timeliness applies only to fresh proposals (pol_round == -1);
+        # reproposals of a polka'd block are exempt (reference
+        # defaultDoPrevote) — re-checking them would hurt liveness.
+        if valid and pol == -1 and \
+                self.state.consensus_params.feature.pbts_enabled(height):
             valid = self.state.consensus_params.synchrony.in_timely_bounds(
                 rs.proposal.timestamp_ns, rs.proposal_receive_time_ns,
                 round_)
@@ -489,10 +522,9 @@ class ConsensusState:
             await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
             return
         if maj.is_nil():
-            # +2/3 prevoted nil: unlock (state.go: "the latest POLRound")
-            rs.locked_round = -1
-            rs.locked_block = None
-            rs.locked_block_parts = None
+            # +2/3 prevoted nil: precommit nil but KEEP the lock — the
+            # reference removed all unlock rules (locks reset only in
+            # updateToState) to match the proven Tendermint algorithm.
             await self._sign_add_vote(PRECOMMIT_TYPE, BlockID())
             return
         if rs.locked_block is not None and \
@@ -661,38 +693,43 @@ class ConsensusState:
         prevotes = rs.votes.prevotes(vote.round)
         maj, has_maj = prevotes.two_thirds_majority()
 
-        if has_maj and maj is not None and not maj.is_nil():
-            # unlock if a newer POL supersedes our lock (L32/L36)
-            if rs.locked_round < vote.round <= rs.round and \
-                    rs.locked_block is not None and \
-                    rs.locked_block.hash() != maj.hash:
-                rs.locked_round = -1
-                rs.locked_block = None
-                rs.locked_block_parts = None
-            # update valid block (L36)
-            if vote.round == rs.round and rs.valid_round < vote.round:
-                if rs.proposal_block is not None and \
-                        rs.proposal_block.hash() == maj.hash:
-                    rs.valid_round = vote.round
-                    rs.valid_block = rs.proposal_block
-                    rs.valid_block_parts = rs.proposal_block_parts
-                self.event_bus.publish(ev.EVENT_POLKA,
-                                       {"height": rs.height,
-                                        "round": vote.round})
+        # valid-block bookkeeping (addVote): on +2/3 for a block in the
+        # current round, record it as valid; if we don't hold it, reset the
+        # part set so gossip can deliver it.  No unlocking here — the
+        # reference deliberately removed all unlock rules.
+        if has_maj and maj is not None and not maj.is_nil() and \
+                rs.valid_round < vote.round and vote.round == rs.round:
+            if rs.proposal_block is not None and \
+                    rs.proposal_block.hash() == maj.hash:
+                rs.valid_round = vote.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+            else:
+                rs.proposal_block = None
+                if rs.proposal_block_parts is None or \
+                        rs.proposal_block_parts.header() != \
+                        maj.part_set_header:
+                    rs.proposal_block_parts = PartSet(maj.part_set_header)
+            self.event_bus.publish(ev.EVENT_POLKA,
+                                   {"height": rs.height,
+                                    "round": vote.round})
 
-        if vote.round == rs.round:
-            if has_maj and maj is not None:
-                if rs.step >= STEP_PREVOTE and not maj.is_nil():
-                    await self._enter_precommit(rs.height, vote.round)
-                elif rs.step >= STEP_PREVOTE and maj.is_nil():
-                    await self._enter_precommit(rs.height, vote.round)
-            elif rs.step == STEP_PREVOTE and prevotes.has_two_thirds_any():
-                await self._enter_prevote_wait(rs.height, vote.round)
-        elif vote.round > rs.round and \
-                prevotes.has_two_thirds_any():
-            # skip ahead (L55: f+1 messages from a higher round; we use the
-            # stronger 2/3-any condition like the reference)
+        if vote.round > rs.round and prevotes.has_two_thirds_any():
+            # skip ahead (the reference uses the 2/3-any condition)
             await self._enter_new_round(rs.height, vote.round)
+        elif vote.round == rs.round and rs.step >= STEP_PREVOTE:
+            # only precommit once the proposal is complete (or the polka is
+            # nil) — otherwise wait for the block to arrive (addVote)
+            if has_maj and maj is not None and \
+                    (rs.proposal_complete() or maj.is_nil()):
+                await self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                await self._enter_prevote_wait(rs.height, vote.round)
+        elif rs.proposal is not None and \
+                0 <= rs.proposal.pol_round == vote.round and \
+                rs.proposal_complete():
+            # proposal's POL round just completed: we can now prevote
+            await self._enter_prevote(rs.height, rs.round)
 
     async def _on_precommit_added(self, vote: Vote) -> None:
         rs = self.rs

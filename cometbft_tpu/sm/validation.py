@@ -31,11 +31,11 @@ def median_time(commit: Commit, validators) -> int:
     if not pairs:
         return 0
     pairs.sort()
-    mid = (total - 1) // 2
+    mid = total // 2
     acc = 0
     for ts, power in pairs:
         acc += power
-        if acc > mid:
+        if acc >= mid:
             return ts
     return pairs[-1][0]
 
