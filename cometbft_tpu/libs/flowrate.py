@@ -1,0 +1,55 @@
+"""Flow-rate metering and limiting (reference: ``internal/flowrate`` —
+send/recv metering for MConnection; SURVEY §2.8 small pkgs).
+
+A Monitor tracks an exponentially-weighted transfer rate; ``limit`` returns
+how many bytes may be sent now to stay under a target rate (the caller
+sleeps when it gets 0).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Monitor:
+    def __init__(self, sample_period: float = 0.1, ema_alpha: float = 0.25,
+                 now=time.monotonic):
+        self._now = now
+        self._period = sample_period
+        self._alpha = ema_alpha
+        self._start = now()
+        self._sample_start = self._start
+        self._sample_bytes = 0
+        self._rate = 0.0            # bytes/sec EMA
+        self.total = 0
+
+    def update(self, n: int) -> None:
+        t = self._now()
+        self.total += n
+        self._sample_bytes += n
+        elapsed = t - self._sample_start
+        if elapsed >= self._period:
+            inst = self._sample_bytes / elapsed
+            self._rate = (self._alpha * inst
+                          + (1 - self._alpha) * self._rate)
+            self._sample_start = t
+            self._sample_bytes = 0
+
+    @property
+    def rate(self) -> float:
+        return self._rate
+
+    def status(self) -> dict:
+        t = self._now()
+        dur = max(t - self._start, 1e-9)
+        return {"bytes": self.total, "duration_s": dur,
+                "avg_rate": self.total / dur, "inst_rate": self._rate}
+
+    def limit(self, want: int, max_rate: float | None) -> int:
+        """How many of ``want`` bytes may transfer now under ``max_rate``
+        (None = unlimited).  0 means back off."""
+        if not max_rate:
+            return want
+        t = self._now()
+        allowed = max_rate * (t - self._start) - self.total
+        return max(0, min(want, int(allowed)))

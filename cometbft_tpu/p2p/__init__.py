@@ -1,0 +1,16 @@
+"""TPU-native p2p stack (reference: ``p2p/`` — SURVEY.md §2.7).
+
+Host-side networking is asyncio TCP (the consensus workload's device story
+is batching, not transport): an authenticated-encryption SecretConnection,
+an MConnection channel multiplexer, and a Switch owning peers + reactors.
+"""
+
+from .key import NodeKey
+from .node_info import NodeInfo
+from .peer import Peer
+from .reactor import ChannelDescriptor, Reactor
+from .switch import Switch
+from .transport import Transport
+
+__all__ = ["NodeKey", "NodeInfo", "Peer", "ChannelDescriptor", "Reactor",
+           "Switch", "Transport"]

@@ -1,0 +1,261 @@
+"""MConnection: N prioritized channels multiplexed over one
+SecretConnection (reference: ``p2p/conn/connection.go:80,549,748``).
+
+Structure kept from the reference, mapped to asyncio: per-channel bounded
+send queues; a send task picking the channel with the lowest
+recently-sent/priority ratio (``selectChannelToGossipOn``
+connection.go:549); packets of <= ``PACKET_PAYLOAD`` bytes with an eof bit
+for message re-assembly; ping/pong keepalive with a pong deadline; flowrate
+metering on both directions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import msgpack
+
+from ..libs.flowrate import Monitor
+from .reactor import ChannelDescriptor
+from .secret_connection import SecretConnection
+
+# a packet's msgpack envelope fits a single AEAD frame (DATA_LEN=1024)
+PACKET_PAYLOAD = 1000
+SEND_BATCH_PACKETS = 10             # connection.go:30 numBatchPacketMsgs
+DEFAULT_PING_INTERVAL = 10.0
+DEFAULT_PONG_TIMEOUT = 5.0
+
+
+class MConnectionError(Exception):
+    pass
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.queue: asyncio.Queue[bytes] = asyncio.Queue(
+            desc.send_queue_capacity)
+        self.sending: bytes | None = None      # partially-sent message
+        self.sent_off = 0
+        self.recent = 0.0                      # recently-sent counter
+        self.recv_buf = bytearray()            # re-assembly buffer
+
+    def next_packet(self) -> tuple[bytes, bool]:
+        """Carve the next <=PACKET_PAYLOAD chunk off the in-flight msg."""
+        chunk = self.sending[self.sent_off:self.sent_off + PACKET_PAYLOAD]
+        self.sent_off += len(chunk)
+        eof = self.sent_off >= len(self.sending)
+        if eof:
+            self.sending = None
+            self.sent_off = 0
+        return chunk, eof
+
+    def has_data(self) -> bool:
+        return self.sending is not None or not self.queue.empty()
+
+
+class MConnection:
+    def __init__(self, conn: SecretConnection,
+                 channels: list[ChannelDescriptor],
+                 on_receive, on_error,
+                 ping_interval: float = DEFAULT_PING_INTERVAL,
+                 pong_timeout: float = DEFAULT_PONG_TIMEOUT,
+                 send_rate: float | None = None,
+                 recv_rate: float | None = None):
+        self.conn = conn
+        self.channels: dict[int, _Channel] = {
+            d.channel_id: _Channel(d) for d in channels}
+        self.on_receive = on_receive          # (chan_id, msg_bytes) -> None
+        self.on_error = on_error              # (exc) -> None
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
+        self.send_monitor = Monitor()
+        self.recv_monitor = Monitor()
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
+        self._send_wakeup = asyncio.Event()
+        self._pong_due: float | None = None
+        self._pong_to_send = False
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._tasks = [
+            asyncio.create_task(self._send_routine()),
+            asyncio.create_task(self._recv_routine()),
+            asyncio.create_task(self._ping_routine()),
+        ]
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self.conn.close()
+
+    def _fail(self, exc: Exception) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for t in self._tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+        self.conn.close()
+        try:
+            self.on_error(exc)
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------------- send
+
+    def send(self, chan_id: int, msg: bytes) -> bool:
+        """Enqueue; False if the channel is unknown or its queue is full
+        (Peer.TrySend semantics — callers treat False as backpressure)."""
+        ch = self.channels.get(chan_id)
+        if ch is None or self._stopped:
+            return False
+        try:
+            ch.queue.put_nowait(bytes(msg))
+        except asyncio.QueueFull:
+            return False
+        self._send_wakeup.set()
+        return True
+
+    async def send_blocking(self, chan_id: int, msg: bytes) -> bool:
+        ch = self.channels.get(chan_id)
+        if ch is None or self._stopped:
+            return False
+        await ch.queue.put(bytes(msg))
+        self._send_wakeup.set()
+        return True
+
+    def _select_channel(self) -> _Channel | None:
+        """Lowest recently-sent/priority ratio wins (connection.go:549)."""
+        best, best_ratio = None, None
+        for ch in self.channels.values():
+            if not ch.has_data():
+                continue
+            ratio = ch.recent / max(ch.desc.priority, 1)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        try:
+            while True:
+                self._send_wakeup.clear()
+                if self._pong_to_send:
+                    self._pong_to_send = False
+                    await self._write_packet({"t": "o"})
+                batch = 0
+                while batch < SEND_BATCH_PACKETS:
+                    ch = self._select_channel()
+                    if ch is None:
+                        break
+                    if ch.sending is None:
+                        ch.sending = ch.queue.get_nowait()
+                        ch.sent_off = 0
+                    chunk, eof = ch.next_packet()
+                    await self._write_packet(
+                        {"t": "m", "c": ch.desc.channel_id,
+                         "e": eof, "d": chunk})
+                    ch.recent += len(chunk)
+                    batch += 1
+                # decay recently-sent so idle channels regain priority
+                for ch in self.channels.values():
+                    ch.recent *= 0.8
+                if not any(c.has_data() for c in self.channels.values()) \
+                        and not self._pong_to_send:
+                    try:
+                        await asyncio.wait_for(self._send_wakeup.wait(), 0.5)
+                    except asyncio.TimeoutError:
+                        pass
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    async def _write_packet(self, packet: dict) -> None:
+        raw = msgpack.packb(packet, use_bin_type=True)
+        data = struct.pack("<I", len(raw)) + raw
+        if self.send_rate:
+            while self.send_monitor.limit(len(data), self.send_rate) \
+                    < len(data):
+                await asyncio.sleep(0.01)
+        await self.conn.write(data)
+        self.send_monitor.update(len(data))
+
+    # ----------------------------------------------------------------- recv
+
+    async def _recv_routine(self) -> None:
+        try:
+            while True:
+                (n,) = struct.unpack("<I", await self.conn.read(4))
+                if n > PACKET_PAYLOAD + 256:
+                    raise MConnectionError(f"oversized packet: {n}")
+                raw = await self.conn.read(n)
+                self.recv_monitor.update(n + 4)
+                if self.recv_rate:
+                    while self.recv_monitor.limit(1, self.recv_rate) < 1:
+                        await asyncio.sleep(0.01)
+                packet = msgpack.unpackb(raw, raw=False)
+                t = packet.get("t")
+                if t == "i":                      # ping
+                    self._pong_to_send = True
+                    self._send_wakeup.set()
+                elif t == "o":                    # pong
+                    self._pong_due = None
+                elif t == "m":
+                    self._on_packet_msg(packet)
+                else:
+                    raise MConnectionError(f"unknown packet type {t!r}")
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+            self._fail(MConnectionError(f"connection lost: {e}"))
+        except Exception as e:
+            self._fail(e)
+
+    def _on_packet_msg(self, packet: dict) -> None:
+        ch = self.channels.get(packet.get("c"))
+        if ch is None:
+            raise MConnectionError(f"unknown channel {packet.get('c')}")
+        ch.recv_buf.extend(packet.get("d", b""))
+        if len(ch.recv_buf) > ch.desc.max_msg_size:
+            raise MConnectionError(
+                f"message exceeds max size on channel {ch.desc.channel_id}")
+        if packet.get("e"):
+            msg = bytes(ch.recv_buf)
+            ch.recv_buf.clear()
+            self.on_receive(ch.desc.channel_id, msg)
+
+    # ----------------------------------------------------------------- ping
+
+    async def _ping_routine(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                await asyncio.sleep(self.ping_interval)
+                await self._write_packet({"t": "i"})
+                self._pong_due = loop.time() + self.pong_timeout
+                await asyncio.sleep(self.pong_timeout)
+                if self._pong_due is not None and \
+                        loop.time() >= self._pong_due:
+                    raise MConnectionError("pong timeout")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    def status(self) -> dict:
+        return {"send": self.send_monitor.status(),
+                "recv": self.recv_monitor.status()}

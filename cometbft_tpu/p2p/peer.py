@@ -1,0 +1,49 @@
+"""Peer: a connected, handshaked remote node (reference: ``p2p/peer.go``).
+
+Binds an MConnection's channels to the Switch's reactor dispatch and keeps
+per-peer metadata (NodeInfo, outbound/persistent flags, an arbitrary
+key-value store used by reactors for per-peer state — PeerState lives
+there, like the reference's ``Peer.Set``/``Get``)."""
+
+from __future__ import annotations
+
+from .conn import MConnection
+from .node_info import NodeInfo
+
+
+class Peer:
+    def __init__(self, node_info: NodeInfo, mconn: MConnection,
+                 outbound: bool, persistent: bool = False,
+                 dial_addr: str | None = None):
+        self.node_info = node_info
+        self.mconn = mconn
+        self.outbound = outbound
+        self.persistent = persistent
+        self.dial_addr = dial_addr          # for persistent reconnect
+        self._data: dict = {}               # reactor-attached state
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        return self.mconn.send(channel_id, msg)
+
+    def try_send(self, channel_id: int, msg: bytes) -> bool:
+        return self.mconn.send(channel_id, msg)
+
+    def get(self, key: str):
+        return self._data.get(key)
+
+    def set(self, key: str, value) -> None:
+        self._data[key] = value
+
+    async def stop(self) -> None:
+        await self.mconn.stop()
+
+    def status(self) -> dict:
+        return self.mconn.status()
+
+    def __repr__(self):
+        arrow = "->" if self.outbound else "<-"
+        return f"Peer{{{arrow}{self.id[:12]}}}"

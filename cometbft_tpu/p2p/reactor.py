@@ -1,0 +1,44 @@
+"""Reactor interface + channel descriptors (reference:
+``p2p/base_reactor.go:15-31`` and the channel-id registry of SURVEY §2.7)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class ChannelDescriptor:
+    channel_id: int
+    priority: int = 1
+    send_queue_capacity: int = 64
+    max_msg_size: int = 1 << 20
+    name: str = ""
+
+
+class Reactor:
+    """Subclass and register on a Switch.  All callbacks run on the event
+    loop — same single-writer discipline as everything else."""
+
+    def __init__(self):
+        self.switch = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    def set_switch(self, switch) -> None:
+        self.switch = switch
+
+    async def start(self) -> None:
+        pass
+
+    async def stop(self) -> None:
+        pass
+
+    def add_peer(self, peer) -> None:
+        """Peer successfully connected and exchanged NodeInfo."""
+
+    def remove_peer(self, peer, reason: object = None) -> None:
+        """Peer disconnected or errored."""
+
+    def receive(self, channel_id: int, peer, msg: bytes) -> None:
+        """A complete message arrived for one of our channels."""

@@ -1,0 +1,174 @@
+"""Switch: reactor registry + peer lifecycle (reference:
+``p2p/switch.go:72,110,163,269``).
+
+Owns the Transport, accepts/dials peers, builds each peer's MConnection
+from the union of reactor channel descriptors, dispatches received messages
+to the owning reactor, fans out broadcasts, and reconnects persistent peers
+with exponential backoff after errors (switch.go reconnectToPeer)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from .conn import MConnection
+from .node_info import NodeInfo
+from .peer import Peer
+from .reactor import ChannelDescriptor, Reactor
+from .transport import Transport
+
+RECONNECT_BASE_DELAY = 0.5
+RECONNECT_MAX_DELAY = 30.0
+RECONNECT_MAX_ATTEMPTS = 20
+
+
+class SwitchError(Exception):
+    pass
+
+
+class Switch:
+    def __init__(self, transport: Transport,
+                 ping_interval: float = 10.0, pong_timeout: float = 5.0):
+        self.transport = transport
+        self.reactors: dict[str, Reactor] = {}
+        self._chan_to_reactor: dict[int, Reactor] = {}
+        self._descriptors: list[ChannelDescriptor] = []
+        self.peers: dict[str, Peer] = {}
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
+        self._running = False
+        self._reconnect_tasks: dict[str, asyncio.Task] = {}
+        transport.on_accept = self._on_accepted
+
+    # ----------------------------------------------------------- reactors
+
+    def add_reactor(self, name: str, reactor: Reactor) -> None:
+        for desc in reactor.get_channels():
+            if desc.channel_id in self._chan_to_reactor:
+                raise SwitchError(
+                    f"channel {desc.channel_id:#x} already claimed")
+            self._chan_to_reactor[desc.channel_id] = reactor
+            self._descriptors.append(desc)
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+
+    @property
+    def channel_ids(self) -> bytes:
+        return bytes(sorted(d.channel_id for d in self._descriptors))
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._running = True
+        for reactor in self.reactors.values():
+            await reactor.start()
+
+    async def stop(self) -> None:
+        self._running = False
+        for task in self._reconnect_tasks.values():
+            task.cancel()
+        self._reconnect_tasks.clear()
+        for peer in list(self.peers.values()):
+            await self._remove_peer(peer, "switch stopping")
+        for reactor in self.reactors.values():
+            await reactor.stop()
+        await self.transport.close()
+
+    # -------------------------------------------------------------- peers
+
+    async def _on_accepted(self, conn, node_info: NodeInfo) -> None:
+        await self._add_peer(conn, node_info, outbound=False)
+
+    async def dial_peer(self, addr: str, persistent: bool = False) -> Peer:
+        conn, node_info = await self.transport.dial(addr)
+        return await self._add_peer(conn, node_info, outbound=True,
+                                    persistent=persistent, dial_addr=addr)
+
+    async def _add_peer(self, conn, node_info: NodeInfo, outbound: bool,
+                        persistent: bool = False,
+                        dial_addr: str | None = None) -> Peer:
+        own_id = self.transport.node_key.id
+        if node_info.node_id == own_id:
+            conn.close()
+            raise SwitchError("refusing to connect to self")
+        if node_info.node_id in self.peers:
+            conn.close()
+            raise SwitchError(f"duplicate peer {node_info.node_id[:12]}")
+
+        peer_box: list[Peer] = []
+
+        def on_receive(chan_id: int, msg: bytes) -> None:
+            reactor = self._chan_to_reactor.get(chan_id)
+            if reactor is not None and peer_box:
+                reactor.receive(chan_id, peer_box[0], msg)
+
+        def on_error(exc: Exception) -> None:
+            if peer_box:
+                asyncio.ensure_future(
+                    self.stop_peer_for_error(peer_box[0], exc))
+
+        mconn = MConnection(conn, self._descriptors, on_receive, on_error,
+                            ping_interval=self.ping_interval,
+                            pong_timeout=self.pong_timeout)
+        peer = Peer(node_info, mconn, outbound, persistent, dial_addr)
+        peer_box.append(peer)
+        self.peers[peer.id] = peer
+        mconn.start()
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+        return peer
+
+    async def stop_peer_for_error(self, peer: Peer, err) -> None:
+        """switch.go StopPeerForError + persistent reconnect."""
+        if peer.id not in self.peers:
+            return
+        await self._remove_peer(peer, err)
+        if self._running and peer.persistent and peer.dial_addr:
+            self._schedule_reconnect(peer.dial_addr)
+
+    async def stop_peer_gracefully(self, peer: Peer) -> None:
+        await self._remove_peer(peer, None)
+
+    async def _remove_peer(self, peer: Peer, reason) -> None:
+        self.peers.pop(peer.id, None)
+        for reactor in self.reactors.values():
+            try:
+                reactor.remove_peer(peer, reason)
+            except Exception:
+                pass
+        await peer.stop()
+
+    def _schedule_reconnect(self, addr: str) -> None:
+        if addr in self._reconnect_tasks:
+            return
+
+        async def _reconnect():
+            delay = RECONNECT_BASE_DELAY
+            for _ in range(RECONNECT_MAX_ATTEMPTS):
+                await asyncio.sleep(delay * (1 + 0.2 * random.random()))
+                if not self._running:
+                    return
+                try:
+                    await self.dial_peer(addr, persistent=True)
+                    return
+                except Exception:
+                    delay = min(delay * 2, RECONNECT_MAX_DELAY)
+            # give up silently (reference logs and gives up too)
+
+        task = asyncio.create_task(_reconnect())
+        task.add_done_callback(
+            lambda _t: self._reconnect_tasks.pop(addr, None))
+        self._reconnect_tasks[addr] = task
+
+    # ---------------------------------------------------------- broadcast
+
+    def broadcast(self, channel_id: int, msg: bytes,
+                  except_peer: Peer | None = None) -> None:
+        """Fan a message to every connected peer (switch.go:269)."""
+        for peer in self.peers.values():
+            if except_peer is not None and peer.id == except_peer.id:
+                continue
+            peer.send(channel_id, msg)
+
+    def n_peers(self) -> int:
+        return len(self.peers)

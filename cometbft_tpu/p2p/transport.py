@@ -1,0 +1,90 @@
+"""TCP transport with connection upgrade (reference:
+``p2p/transport.go:137,194,212,410`` MultiplexTransport).
+
+Upgrade sequence on every raw TCP connection, dialed or accepted:
+SecretConnection handshake (authenticated encryption) -> NodeInfo exchange
+-> validation (declared id matches the handshake-proven pubkey,
+compatibility).  Only then does the Switch see the peer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .key import NodeKey, node_id
+from .node_info import NodeInfo, NodeInfoError
+from .secret_connection import SecretConnection, handshake
+
+HANDSHAKE_TIMEOUT = 8.0
+
+
+class TransportError(Exception):
+    pass
+
+
+class Transport:
+    def __init__(self, node_key: NodeKey, node_info_fn,
+                 handshake_timeout: float = HANDSHAKE_TIMEOUT):
+        self.node_key = node_key
+        self.node_info_fn = node_info_fn      # () -> NodeInfo (fresh copy)
+        self.handshake_timeout = handshake_timeout
+        self._server: asyncio.AbstractServer | None = None
+        self.listen_addr: str | None = None
+        self.on_accept = None   # async (SecretConnection, NodeInfo) -> None
+
+    # ------------------------------------------------------------- listen
+
+    async def listen(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        self._server = await asyncio.start_server(
+            self._handle_accept, host, port)
+        sock = self._server.sockets[0]
+        addr = sock.getsockname()
+        self.listen_addr = f"{addr[0]}:{addr[1]}"
+        return self.listen_addr
+
+    async def _handle_accept(self, reader, writer) -> None:
+        try:
+            conn, ni = await asyncio.wait_for(
+                self._upgrade(reader, writer), self.handshake_timeout)
+        except Exception:
+            writer.close()
+            return
+        if self.on_accept is not None:
+            await self.on_accept(conn, ni)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # --------------------------------------------------------------- dial
+
+    async def dial(self, addr: str) -> tuple[SecretConnection, NodeInfo]:
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            return await asyncio.wait_for(
+                self._upgrade(reader, writer), self.handshake_timeout)
+        except Exception:
+            writer.close()
+            raise
+
+    # ------------------------------------------------------------ upgrade
+
+    async def _upgrade(self, reader, writer) \
+            -> tuple[SecretConnection, NodeInfo]:
+        conn = await handshake(reader, writer, self.node_key.priv_key)
+        await conn.write_msg(self.node_info_fn().encode())
+        their_info = NodeInfo.decode(await conn.read_msg(max_size=10240))
+        their_info.validate_basic()
+        proven_id = node_id(conn.remote_pub_key)
+        if their_info.node_id != proven_id:
+            raise TransportError(
+                f"peer declared id {their_info.node_id} but proved "
+                f"{proven_id}")
+        try:
+            self.node_info_fn().compatible_with(their_info)
+        except NodeInfoError as e:
+            raise TransportError(f"incompatible peer: {e}")
+        return conn, their_info
