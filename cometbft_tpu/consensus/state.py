@@ -78,6 +78,9 @@ class ConsensusState:
         self.broadcast_vote: Callable[[Vote], None] = lambda v: None
         self.on_conflicting_vote: Callable[[Vote, Vote], None] = \
             lambda a, b: None
+        # reactor hooks: round-step transitions + votes added to our sets
+        self.on_round_step: Callable[[], None] = lambda: None
+        self.on_vote_added: Callable[[Vote], None] = lambda v: None
 
         self._update_to_state(state)
 
@@ -235,6 +238,7 @@ class ConsensusState:
         )
         self.rs.start_time_ns = self.rs.commit_time_ns + \
             self.cfg.commit_timeout()
+        self.on_round_step()
 
     def _schedule_round0_now(self) -> None:
         delay = max(self.rs.start_time_ns - self.now_ns(), 1)
@@ -289,6 +293,7 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_)
         rs.triggered_timeout_precommit = False
+        self.on_round_step()
         self.event_bus.publish(ev.EVENT_NEW_ROUND,
                                {"height": height, "round": round_,
                                 "proposer": self._round_proposer(
@@ -315,6 +320,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PROPOSE):
             return
         rs.step = STEP_PROPOSE
+        self.on_round_step()
         self.ticker.schedule(TimeoutInfo(self.cfg.propose_timeout(round_),
                                          height, round_, STEP_PROPOSE))
         if self._is_our_turn(round_):
@@ -442,6 +448,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PREVOTE):
             return
         rs.step = STEP_PREVOTE
+        self.on_round_step()
         await self._do_prevote(height, round_)
 
     async def _do_prevote(self, height: int, round_: int) -> None:
@@ -505,6 +512,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT):
             return
         rs.step = STEP_PREVOTE_WAIT
+        self.on_round_step()
         self.ticker.schedule(TimeoutInfo(self.cfg.prevote_timeout(round_),
                                          height, round_, STEP_PREVOTE_WAIT))
 
@@ -515,6 +523,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
             return
         rs.step = STEP_PRECOMMIT
+        self.on_round_step()
         prevotes = rs.votes.prevotes(round_)
         maj, has_maj = (prevotes.two_thirds_majority()
                         if prevotes else (None, False))
@@ -568,6 +577,7 @@ class ConsensusState:
             return
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
+        self.on_round_step()
         rs.commit_time_ns = self.now_ns()
         precommits = rs.votes.precommits(commit_round)
         maj, _ = precommits.two_thirds_majority()
@@ -682,6 +692,7 @@ class ConsensusState:
         if not added:
             return
         self.event_bus.publish(ev.EVENT_VOTE, {"vote": vote})
+        self.on_vote_added(vote)
 
         if vote.type == PREVOTE_TYPE:
             await self._on_prevote_added(vote)

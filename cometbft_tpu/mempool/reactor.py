@@ -1,0 +1,93 @@
+"""Mempool reactor: transaction gossip (reference: ``mempool/reactor.go:22,
+137,198`` — per-peer broadcastTxRoutine walking the clist).
+
+Each peer gets one gossip task that walks the mempool's FIFO contents and
+sends txs the peer hasn't been seen to have (sender-set dedup: a tx is not
+echoed back to the peer that delivered it, ``mempool/reactor.go`` senders
+check).  Received txs enter the mempool through the normal async CheckTx
+pipeline."""
+
+from __future__ import annotations
+
+import asyncio
+
+import msgpack
+
+from ..p2p.reactor import ChannelDescriptor, Reactor
+from .clist_mempool import CListMempool, TxRejectedError
+from .mempool import TxKey
+
+MEMPOOL_CHANNEL = 0x30
+GOSSIP_SLEEP = 0.02
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: CListMempool,
+                 gossip_sleep: float = GOSSIP_SLEEP):
+        super().__init__()
+        self.mempool = mempool
+        self.gossip_sleep = gossip_sleep
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        # tx hash -> set of peer ids that sent it to us (dedup/no-echo)
+        self._senders: dict[bytes, set[str]] = {}
+
+    def get_channels(self):
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=128, name="mempool")]
+
+    def add_peer(self, peer) -> None:
+        self._peer_tasks[peer.id] = asyncio.create_task(
+            self._broadcast_tx_routine(peer))
+
+    def remove_peer(self, peer, reason=None) -> None:
+        task = self._peer_tasks.pop(peer.id, None)
+        if task is not None:
+            task.cancel()
+
+    async def stop(self) -> None:
+        for task in self._peer_tasks.values():
+            task.cancel()
+        self._peer_tasks.clear()
+
+    def receive(self, channel_id: int, peer, msg: bytes) -> None:
+        d = msgpack.unpackb(msg, raw=False)
+        for tx in d.get("txs", []):
+            self._senders.setdefault(TxKey(tx), set()).add(peer.id)
+            asyncio.ensure_future(self._check_tx(tx))
+
+    async def _check_tx(self, tx: bytes) -> None:
+        try:
+            await self.mempool.check_tx(tx)
+        except TxRejectedError:
+            pass
+        except Exception:
+            pass
+
+    async def _broadcast_tx_routine(self, peer) -> None:
+        """Walk the mempool forever, sending each tx the peer didn't give
+        us (broadcastTxRoutine reactor.go:198)."""
+        sent: set[bytes] = set()
+        try:
+            while True:
+                progressed = False
+                for tx in self.mempool.contents():
+                    key = TxKey(tx)
+                    if key in sent:
+                        continue
+                    if peer.id in self._senders.get(key, ()):
+                        sent.add(key)       # peer already has it
+                        continue
+                    if peer.send(MEMPOOL_CHANNEL, msgpack.packb(
+                            {"txs": [tx]}, use_bin_type=True)):
+                        sent.add(key)
+                        progressed = True
+                if not progressed:
+                    await asyncio.sleep(self.gossip_sleep)
+                # bound the sent-set: drop keys no longer in the mempool
+                if len(sent) > 10000:
+                    live = {TxKey(t) for t in self.mempool.contents()}
+                    sent &= live
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass

@@ -1,0 +1,154 @@
+"""Node assembly (reference: ``node/node.go:275,303-576`` NewNode +
+OnStart): wires DBs -> state/genesis -> ABCI connections + handshake ->
+mempool -> consensus (+WAL) -> reactors -> transport/switch.
+
+The reference's two-phase construction (create everything, then OnStart
+starts services in dependency order) is kept; RPC attaches on top via
+``rpc.server`` when configured.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..abci.application import Application
+from ..config import Config, test_consensus_config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import Handshaker
+from ..consensus.state import ConsensusState
+from ..consensus.wal import WAL
+from ..libs.pubsub import EventBus
+from ..mempool.clist_mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p import NodeInfo, NodeKey, Switch, Transport
+from ..proxy.multi_app_conn import AppConns, local_client_creator
+from ..sm.execution import BlockExecutor
+from ..storage import BlockStore, LogDB, MemDB, State, StateStore
+from ..types.genesis import GenesisDoc
+from ..types.priv_validator import PrivValidator
+
+
+def _parse_laddr(laddr: str) -> tuple[str, int]:
+    addr = laddr.removeprefix("tcp://")
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class Node:
+    def __init__(self):
+        # populated by create(); kept flat for introspection/RPC
+        self.config: Config | None = None
+        self.genesis: GenesisDoc | None = None
+        self.block_store: BlockStore | None = None
+        self.state_store: StateStore | None = None
+        self.app_conns: AppConns | None = None
+        self.event_bus: EventBus | None = None
+        self.mempool: CListMempool | None = None
+        self.block_exec: BlockExecutor | None = None
+        self.consensus: ConsensusState | None = None
+        self.consensus_reactor: ConsensusReactor | None = None
+        self.mempool_reactor: MempoolReactor | None = None
+        self.node_key: NodeKey | None = None
+        self.transport: Transport | None = None
+        self.switch: Switch | None = None
+        self.listen_addr: str | None = None
+        self.name = "node"
+        self._started = False
+
+    # ------------------------------------------------------------- create
+
+    @classmethod
+    async def create(cls, genesis_doc: GenesisDoc, app: Application,
+                     priv_validator: PrivValidator | None = None,
+                     config: Config | None = None,
+                     node_key: NodeKey | None = None,
+                     home: str | None = None,
+                     name: str = "node") -> "Node":
+        self = cls()
+        self.name = name
+        cfg = config or Config(consensus=test_consensus_config())
+        self.config = cfg
+        self.genesis = genesis_doc
+
+        if home is not None:
+            os.makedirs(os.path.join(home, "data"), exist_ok=True)
+            bs_db = LogDB(os.path.join(home, "data", "blockstore.db"))
+            ss_db = LogDB(os.path.join(home, "data", "state.db"))
+            wal = WAL(os.path.join(home, "data", "cs.wal"))
+        else:
+            bs_db, ss_db, wal = MemDB(), MemDB(), None
+        self.block_store = BlockStore(bs_db)
+        self.state_store = StateStore(ss_db)
+
+        state = self.state_store.load() or State.from_genesis(genesis_doc)
+
+        self.app_conns = AppConns(local_client_creator(app))
+        await self.app_conns.start()
+        self.event_bus = EventBus()
+        self.mempool = CListMempool(
+            self.app_conns.mempool, max_txs=cfg.mempool.size,
+            max_tx_bytes=cfg.mempool.max_tx_bytes,
+            cache_size=cfg.mempool.cache_size,
+            keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache)
+        self.block_exec = BlockExecutor(
+            self.state_store, self.block_store, self.app_conns.consensus,
+            self.mempool, event_bus=self.event_bus,
+            backend=cfg.base.signature_backend)
+
+        state = await Handshaker(
+            self.state_store, self.block_store, genesis_doc).handshake(
+            state, self.app_conns, self.block_exec)
+
+        self.consensus = ConsensusState(
+            cfg.consensus, state, self.block_exec, self.block_store,
+            wal=wal, priv_validator=priv_validator,
+            event_bus=self.event_bus, name=name)
+
+        gossip_sleep = cfg.consensus.peer_gossip_sleep_duration / 1e9
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus, gossip_sleep=gossip_sleep)
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, gossip_sleep=gossip_sleep)
+
+        self.node_key = node_key or NodeKey.generate()
+        self.transport = Transport(self.node_key, self._node_info)
+        self.switch = Switch(self.transport)
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+        return self
+
+    def _node_info(self) -> NodeInfo:
+        return NodeInfo(
+            node_id=self.node_key.id,
+            listen_addr=self.listen_addr or "",
+            network=self.genesis.chain_id,
+            channels=self.switch.channel_ids if self.switch else b"",
+            moniker=self.name)
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        """node.go:579 OnStart: listen, start reactors, start consensus."""
+        host, port = _parse_laddr(self.config.p2p.laddr) \
+            if self.config.p2p.laddr else ("127.0.0.1", 0)
+        self.listen_addr = await self.transport.listen(host, port)
+        await self.switch.start()
+        await self.consensus.start()
+        self._started = True
+
+    async def stop(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.stop()
+        if self.switch is not None:
+            await self.switch.stop()
+        if self.app_conns is not None:
+            await self.app_conns.stop()
+        self._started = False
+
+    async def dial_peer(self, addr: str, persistent: bool = True):
+        return await self.switch.dial_peer(addr, persistent=persistent)
+
+    # ------------------------------------------------------------- status
+
+    def height(self) -> int:
+        return self.block_store.height()

@@ -90,6 +90,15 @@ class BlockStore:
         raw = self.db.get(_hkey(K_BLOCK, height))
         return codec.unpack(raw) if raw else None
 
+    def load_block_parts(self, height: int) -> PartSet | None:
+        """Rebuild the block's PartSet for gossip catch-up.  Parts are a
+        deterministic function of the block bytes (PartSet.from_data over
+        the codec encoding), so they need not be stored separately."""
+        block = self.load_block(height)
+        if block is None:
+            return None
+        return PartSet.from_data(codec.pack(block))
+
     def load_block_meta(self, height: int) -> BlockMeta | None:
         raw = self.db.get(_hkey(K_META, height))
         if not raw:
