@@ -330,6 +330,10 @@ class ConsensusState:
 
     async def _decide_proposal(self, height: int, round_: int) -> None:
         """state.go:1219 defaultDecideProposal."""
+        if self._replaying:
+            # replay mode never re-proposes: the recorded proposal/parts
+            # will come through the WAL (replay.go; state.go replayMode)
+            return
         rs = self.rs
         if rs.valid_block is not None:
             block, parts = rs.valid_block, rs.valid_block_parts
@@ -635,7 +639,9 @@ class ConsensusState:
 
     async def _sign_add_vote(self, typ: int, block_id: BlockID) -> None:
         """state.go:2587 signAddVote + vote extension handling (:2544)."""
-        if self.priv_validator is None:
+        if self.priv_validator is None or self._replaying:
+            # in replay mode recorded own votes arrive via the WAL; signing
+            # fresh ones would equivocate on timestamp (state.go replayMode)
             return
         rs = self.rs
         addr = self.priv_validator.get_pub_key().address()

@@ -65,6 +65,7 @@ class InProcNetwork:
     def __init__(self, nodes: list[InProcNode], partitions=None):
         self.nodes = nodes
         self.isolated: set[str] = set()      # names cut off from gossip
+        self._catchup_task = None
         for node in nodes:
             self._wire(node)
 
@@ -93,12 +94,62 @@ class InProcNetwork:
         self.isolated.discard(name)
 
     async def start(self):
+        import asyncio
+
         for n in self.nodes:
             await n.consensus.start()
+        self._catchup_task = asyncio.create_task(self._catchup_routine())
 
     async def stop(self):
+        import asyncio
+
+        if self._catchup_task is not None:
+            self._catchup_task.cancel()
+            try:
+                await self._catchup_task
+            except asyncio.CancelledError:
+                pass
+            self._catchup_task = None
         for n in self.nodes:
             await n.consensus.stop()
+
+    async def _catchup_routine(self):
+        """Feed lagging nodes the stored commit votes + block parts for
+        their current height — the in-proc stand-in for the consensus
+        reactor's catch-up gossip (gossipVotesRoutine earlier-height branch
+        + gossipDataForCatchup, internal/consensus/reactor.go:590,646)."""
+        import asyncio
+
+        from .consensus.reactor import votes_from_commit
+
+        while True:
+            await asyncio.sleep(0.05)
+            for lag in self.nodes:
+                cs = lag.consensus
+                if lag.name in self.isolated or cs._task is None or \
+                        cs._task.done():
+                    continue
+                h = cs.rs.height
+                for src in self.nodes:
+                    if src is lag or src.name in self.isolated or \
+                            src.block_store.height() < h:
+                        continue
+                    commit = src.block_store.load_block_commit(h)
+                    if commit is None:
+                        seen = src.block_store.load_seen_commit()
+                        if seen is not None and seen.height == h:
+                            commit = seen
+                    if commit is None:
+                        continue
+                    for v in votes_from_commit(commit):
+                        cs.feed_vote(v, f"catchup:{src.name}")
+                    parts = src.block_store.load_block_parts(h)
+                    if parts is not None:
+                        for i in range(parts.total):
+                            cs.feed_block_part(h, commit.round,
+                                               parts.get_part(i),
+                                               f"catchup:{src.name}")
+                    break
 
     async def wait_for_height(self, height: int, timeout: float = 30.0,
                               nodes=None):
