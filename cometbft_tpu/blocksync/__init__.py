@@ -1,0 +1,4 @@
+from .pool import BlockPool
+from .reactor import BLOCKSYNC_CHANNEL, BlocksyncReactor
+
+__all__ = ["BlockPool", "BlocksyncReactor", "BLOCKSYNC_CHANNEL"]

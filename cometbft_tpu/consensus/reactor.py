@@ -158,6 +158,7 @@ class ConsensusReactor(Reactor):
         super().__init__()
         self.cs = cs
         self.gossip_sleep = gossip_sleep
+        self.wait_sync = False      # True while blocksync owns the chain
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         self._last_nrs = None
         cs.broadcast_proposal = self._broadcast_proposal
@@ -165,6 +166,7 @@ class ConsensusReactor(Reactor):
         cs.broadcast_vote = self._broadcast_vote
         cs.on_round_step = self._broadcast_new_round_step
         cs.on_vote_added = self._broadcast_has_vote
+        cs.on_valid_block = self._broadcast_new_valid_block
 
     def get_channels(self):
         return [
@@ -182,7 +184,11 @@ class ConsensusReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         peer.set("cons_peer_state", PeerState())
-        peer.send(STATE_CHANNEL, self._nrs_msg())
+        if not self.wait_sync:
+            peer.send(STATE_CHANNEL, self._nrs_msg())
+            nvb = self._nvb_msg()
+            if nvb is not None:
+                peer.send(STATE_CHANNEL, nvb)
         self._peer_tasks[peer.id] = [
             asyncio.create_task(self._gossip_data_routine(peer)),
             asyncio.create_task(self._gossip_votes_routine(peer)),
@@ -206,14 +212,47 @@ class ConsensusReactor(Reactor):
         lcr = rs.last_commit.round if rs.last_commit is not None else -1
         return _pack("nrs", h=rs.height, r=rs.round, s=rs.step, lcr=lcr)
 
+    def switch_to_consensus(self) -> None:
+        """Blocksync handed the chain over: resume gossip and announce our
+        (freshly synced) round state (reference SwitchToConsensus)."""
+        self.wait_sync = False
+        self._last_nrs = None
+        self._broadcast_new_round_step()
+
+    def _nvb_msg(self) -> bytes | None:
+        """NewValidBlockMessage analogue (reactor.go
+        broadcastNewValidBlockMessage): advertise which parts of the
+        to-be-committed block we actually hold, so peers whose bookkeeping
+        drifted (parts sent before we had the part-set header were dropped)
+        re-send the gap.  Without this a catch-up node that enters COMMIT
+        after the parts went by deadlocks waiting for a block nobody will
+        re-send."""
+        rs = self.cs.rs
+        if rs.proposal_block_parts is None:
+            return None
+        return _pack(
+            "nvb", h=rs.height, r=rs.round,
+            psh=codec.to_dict(rs.proposal_block_parts.header()),
+            bits=_ba_to_wire(rs.proposal_block_parts.bit_array()))
+
     def _broadcast_new_round_step(self) -> None:
-        if self.switch is None:
+        if self.switch is None or self.wait_sync:
             return
         nrs = self._nrs_msg()
         if nrs == self._last_nrs:
             return
         self._last_nrs = nrs
         self.switch.broadcast(STATE_CHANNEL, nrs)
+
+    def _broadcast_new_valid_block(self) -> None:
+        if self.switch is None or self.wait_sync:
+            return
+        nvb = self._nvb_msg()
+        if nvb is not None:
+            # peers track us against our announced round state: make sure
+            # it precedes the nvb even if the step transition was deduped
+            self.switch.broadcast(STATE_CHANNEL, self._nrs_msg())
+            self.switch.broadcast(STATE_CHANNEL, nvb)
 
     def _broadcast_has_vote(self, vote: Vote) -> None:
         if self.switch is None:
@@ -246,6 +285,11 @@ class ConsensusReactor(Reactor):
     def receive(self, channel_id: int, peer, msg: bytes) -> None:
         ps: PeerState = peer.get("cons_peer_state")
         if ps is None:
+            return
+        if self.wait_sync:
+            # blocksync owns the chain: consensus traffic would pile up in
+            # the unstarted state machine's queue (reference Reactor.Receive
+            # drops messages while WaitSync)
             return
         d = _unpack(msg)
         tag = d.get("@")

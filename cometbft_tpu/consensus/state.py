@@ -81,6 +81,9 @@ class ConsensusState:
         # reactor hooks: round-step transitions + votes added to our sets
         self.on_round_step: Callable[[], None] = lambda: None
         self.on_vote_added: Callable[[Vote], None] = lambda v: None
+        # fired when we set up a part set for a block we don't hold yet
+        # (the reference's EventValidBlock -> NewValidBlockMessage)
+        self.on_valid_block: Callable[[], None] = lambda: None
 
         self._update_to_state(state)
 
@@ -348,7 +351,14 @@ class ConsensusState:
         proposal = Proposal(height=height, round=round_,
                             pol_round=rs.valid_round, block_id=bid,
                             timestamp_ns=block.header.time_ns)
-        self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        try:
+            await self.priv_validator.sign_proposal(self.state.chain_id,
+                                                    proposal)
+        except Exception as e:
+            # a refusing signer skips the proposal, it does not crash the
+            # round (defaultDecideProposal logs and returns on sign error)
+            print(f"[{self.name}] sign_proposal refused: {e!r}")
+            return
         # own proposal: deliver to self (WAL-synced) + broadcast
         await self._handle("proposal", proposal, "", replay=False)
         for i in range(parts.total):
@@ -592,11 +602,15 @@ class ConsensusState:
             rs.proposal_block_parts = rs.locked_block_parts
         elif rs.proposal_block is None or \
                 rs.proposal_block.hash() != maj.hash:
-            # we don't have the block yet: set up parts to receive it
+            # we don't have the block yet: set up parts to receive it and
+            # re-announce our (empty) part bits so peers whose bookkeeping
+            # marked parts as delivered re-send them (the reference fires
+            # EventValidBlock here -> NewValidBlockMessage broadcast)
             if rs.proposal_block_parts is None or \
                     rs.proposal_block_parts.header() != maj.part_set_header:
                 rs.proposal_block = None
                 rs.proposal_block_parts = PartSet(maj.part_set_header)
+                self.on_valid_block()
         await self._try_finalize_commit(height)
 
     async def _try_finalize_commit(self, height: int) -> None:
@@ -657,8 +671,15 @@ class ConsensusState:
         if typ == PRECOMMIT_TYPE and not block_id.is_nil() and ext_enabled:
             vote.extension = await self.block_exec.extend_vote(vote)
             sign_ext = True
-        self.priv_validator.sign_vote(self.state.chain_id, vote,
-                                      sign_extension=sign_ext)
+        try:
+            await self.priv_validator.sign_vote(self.state.chain_id, vote,
+                                                sign_extension=sign_ext)
+        except Exception as e:
+            # a refusing signer (double-sign protection) must not crash the
+            # state machine: skip the vote like the reference (state.go
+            # signAddVote logs and returns on sign error)
+            print(f"[{self.name}] sign_vote refused: {e!r}")
+            return
         await self._handle("vote", vote, "", replay=False)
         if not self._replaying:
             self.broadcast_vote(vote)
@@ -727,6 +748,7 @@ class ConsensusState:
                         rs.proposal_block_parts.header() != \
                         maj.part_set_header:
                     rs.proposal_block_parts = PartSet(maj.part_set_header)
+                    self.on_valid_block()   # re-announce part bits (nvb)
             self.event_bus.publish(ev.EVENT_POLKA,
                                    {"height": rs.height,
                                     "round": vote.round})

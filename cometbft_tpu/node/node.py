@@ -12,6 +12,7 @@ from __future__ import annotations
 import os
 
 from ..abci.application import Application
+from ..blocksync.reactor import BlocksyncReactor
 from ..config import Config, test_consensus_config
 from ..consensus.reactor import ConsensusReactor
 from ..consensus.replay import Handshaker
@@ -48,6 +49,8 @@ class Node:
         self.consensus: ConsensusState | None = None
         self.consensus_reactor: ConsensusReactor | None = None
         self.mempool_reactor: MempoolReactor | None = None
+        self.blocksync_reactor: BlocksyncReactor | None = None
+        self.fast_sync = False
         self.node_key: NodeKey | None = None
         self.transport: Transport | None = None
         self.switch: Switch | None = None
@@ -63,9 +66,11 @@ class Node:
                      config: Config | None = None,
                      node_key: NodeKey | None = None,
                      home: str | None = None,
+                     fast_sync: bool = False,
                      name: str = "node") -> "Node":
         self = cls()
         self.name = name
+        self.fast_sync = fast_sync
         cfg = config or Config(consensus=test_consensus_config())
         self.config = cfg
         self.genesis = genesis_doc
@@ -110,12 +115,29 @@ class Node:
         self.mempool_reactor = MempoolReactor(
             self.mempool, gossip_sleep=gossip_sleep)
 
+        self.blocksync_reactor = BlocksyncReactor(
+            self.block_exec, self.block_store, state,
+            fast_sync=fast_sync,
+            switch_to_consensus=self._switch_to_consensus,
+            backend=cfg.base.signature_backend,
+            name=f"{name}.bs")
+        if fast_sync:
+            self.consensus_reactor.wait_sync = True
+
         self.node_key = node_key or NodeKey.generate()
         self.transport = Transport(self.node_key, self._node_info)
         self.switch = Switch(self.transport)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("blocksync", self.blocksync_reactor)
         return self
+
+    async def _switch_to_consensus(self, state) -> None:
+        """Blocksync caught up: adopt the synced state and start consensus
+        (reference consensus Reactor.SwitchToConsensus)."""
+        self.consensus._update_to_state(state)
+        await self.consensus.start()
+        self.consensus_reactor.switch_to_consensus()
 
     def _node_info(self) -> NodeInfo:
         return NodeInfo(
@@ -133,7 +155,9 @@ class Node:
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)
         await self.switch.start()
-        await self.consensus.start()
+        if not self.fast_sync:
+            # fast-sync defers consensus start to the blocksync handoff
+            await self.consensus.start()
         self._started = True
 
     async def stop(self) -> None:

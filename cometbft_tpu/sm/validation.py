@@ -41,8 +41,14 @@ def median_time(commit: Commit, validators) -> int:
 
 
 def validate_block(state: State, block: Block,
-                   backend: str | None = None) -> None:
-    """Raises BlockValidationError; mirrors state/validation.go checks."""
+                   backend: str | None = None,
+                   verify_last_commit_sigs: bool = True) -> None:
+    """Raises BlockValidationError; mirrors state/validation.go checks.
+
+    ``verify_last_commit_sigs=False`` keeps the structural last-commit
+    checks but skips signature verification — for blocksync, where the
+    commit was already proven inside a cross-block device batch and
+    re-verifying per block would undo the batching win."""
     err = block.validate_basic()
     if err:
         raise BlockValidationError(f"invalid block: {err}")
@@ -83,10 +89,16 @@ def validate_block(state: State, block: Block,
             raise BlockValidationError("missing last commit")
         if state.last_validators is None:
             raise BlockValidationError("no last validators to verify commit")
-        # ---- THE batch-verification hot path ----
-        VerifyCommit(state.chain_id, state.last_validators,
-                     state.last_block_id, h.height - 1, block.last_commit,
-                     backend=backend)
+        if verify_last_commit_sigs:
+            # ---- THE batch-verification hot path ----
+            VerifyCommit(state.chain_id, state.last_validators,
+                         state.last_block_id, h.height - 1,
+                         block.last_commit, backend=backend)
+        else:
+            from ..types.validation import _check_commit_basics
+
+            _check_commit_basics(state.last_validators, block.last_commit,
+                                 h.height - 1, state.last_block_id)
         # BFT time: block time advances monotonically past the last block
         if h.time_ns <= state.last_block_time_ns:
             raise BlockValidationError("block time not monotonic")

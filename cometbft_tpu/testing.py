@@ -170,7 +170,8 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
                               vote_extensions_height: int = 0,
                               wal_dir: str | None = None,
                               backend: str = "cpu",
-                              power=None) -> InProcNetwork:
+                              power=None,
+                              pv_factory=None) -> InProcNetwork:
     from .abci.kvstore import KVStoreApplication
     from .abci.client import LocalClient
     from .config import test_consensus_config
@@ -185,7 +186,9 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
 
     app_factory = app_factory or KVStoreApplication
     cfg = config or test_consensus_config()
-    pvs = [MockPV.from_secret(b"inproc%d" % i) for i in range(n_validators)]
+    pv_factory = pv_factory or \
+        (lambda i: MockPV.from_secret(b"inproc%d" % i))
+    pvs = [pv_factory(i) for i in range(n_validators)]
     doc = GenesisDoc(chain_id=chain_id,
                      validators=[GenesisValidator(
                          pv.get_pub_key(),

@@ -164,6 +164,62 @@ def VerifyCommitLightTrusting(chain_id: str, vals: ValidatorSet,
             verify_nil_sigs=False, lookup_by_address=True, backend=backend)
 
 
+class ErrBatchItemInvalid(CommitVerificationError):
+    """A commit inside a multi-commit batch failed; ``item`` indexes the
+    offending entry so blocksync can redo exactly that height."""
+
+    def __init__(self, item: int, height: int, cause: Exception):
+        self.item = item
+        self.height = height
+        self.cause = cause
+        super().__init__(f"commit #{item} (height {height}): {cause}")
+
+
+def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
+                                 items: list, backend: str | None = None
+                                 ) -> int:
+    """VerifyCommitLight over MANY commits sharing one validator set in a
+    single device batch — the blocksync cross-block batching seam
+    (reference verifies one commit per block sequentially at
+    ``internal/blocksync/reactor.go:495``; here K blocks' commits fill one
+    TPU dispatch, BASELINE configs[4]).
+
+    ``items`` is a list of ``(block_id, height, commit)``.  Returns the
+    number of signatures verified.  Raises ErrBatchItemInvalid naming the
+    first offending item.
+    """
+    bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
+    lanes: list[tuple[int, int]] = []      # (item idx, commit-sig idx)
+    needed = vals.total_voting_power() * 2 // 3
+    for k, (block_id, height, commit) in enumerate(items):
+        try:
+            _check_commit_basics(vals, commit, height, block_id)
+        except CommitVerificationError as e:
+            raise ErrBatchItemInvalid(k, height, e) from e
+        tally = 0
+        for idx, cs in enumerate(commit.signatures):
+            if not cs.is_commit():
+                continue
+            val = vals.get_by_index(idx)
+            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
+                   cs.signature)
+            lanes.append((k, idx))
+            tally += val.voting_power
+            if tally > needed:
+                break
+        if tally <= needed:
+            raise ErrBatchItemInvalid(
+                k, height,
+                ErrNotEnoughVotingPower(f"tallied {tally} <= {needed}"))
+    if len(bv) > 0:
+        ok, oks = bv.verify()
+        if not ok:
+            k, idx = lanes[oks.index(False)]
+            raise ErrBatchItemInvalid(k, items[k][1],
+                                      ErrInvalidSignature(idx))
+    return len(lanes)
+
+
 def VerifyCommitLightTrustingAllSignatures(chain_id: str, vals: ValidatorSet,
                                            commit: Commit,
                                            trust_level: Fraction = Fraction(1, 3),

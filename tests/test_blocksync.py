@@ -1,0 +1,141 @@
+"""Blocksync: cross-block batched commit verification (the flagship
+cross-block TPU batching, BASELINE configs[4]) and fast-sync over real TCP
+(reference: ``internal/blocksync/{pool,reactor}_test.go``)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.validation import (ErrBatchItemInvalid,
+                                           verify_commits_light_batched)
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+from test_types import CHAIN_ID, make_commit
+
+pytestmark = pytest.mark.timeout(150)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _vals(powers):
+    privs = [Ed25519PrivKey.from_secret(b"bsv%d" % i)
+             for i in range(len(powers))]
+    vals = ValidatorSet([Validator(p.pub_key(), pw)
+                         for p, pw in zip(privs, powers)])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, by_addr
+
+
+def _bid(h):
+    return BlockID(bytes([h]) * 32, PartSetHeader(1, bytes([h ^ 0xFF]) * 32))
+
+
+def test_batched_multi_commit_verify_ok():
+    vals, by_addr = _vals([10] * 4)
+    items = []
+    for h in range(1, 6):
+        commit = make_commit(vals, by_addr, height=h, round_=0, bid=_bid(h))
+        items.append((commit.block_id, h, commit))
+    n = verify_commits_light_batched(CHAIN_ID, vals, items, backend="cpu")
+    assert n > 0
+
+
+def test_batched_multi_commit_flags_offending_item():
+    vals, by_addr = _vals([10] * 4)
+    items = []
+    for h in range(1, 6):
+        bad = {0} if h == 3 else set()
+        commit = make_commit(vals, by_addr, height=h, round_=0, bid=_bid(h),
+                             bad_at=bad)
+        items.append((commit.block_id, h, commit))
+    with pytest.raises(ErrBatchItemInvalid) as exc:
+        verify_commits_light_batched(CHAIN_ID, vals, items, backend="cpu")
+    assert exc.value.item == 2 and exc.value.height == 3
+
+
+def test_batched_multi_commit_flags_wrong_block_id():
+    vals, by_addr = _vals([10] * 4)
+    commit = make_commit(vals, by_addr, height=1, round_=0, bid=_bid(1))
+    with pytest.raises(ErrBatchItemInvalid) as exc:
+        verify_commits_light_batched(
+            CHAIN_ID, vals, [(_bid(2), 1, commit)], backend="cpu")
+    assert exc.value.item == 0
+
+
+def test_fast_sync_over_tcp():
+    """A late full node block-syncs a committed chain from 3 validators
+    over real TCP, then follows via consensus (reactor.go:421-431
+    SwitchToConsensus; VERDICT round-1 item 4's bar)."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    def cfg():
+        c = Config(consensus=test_consensus_config())
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        return c
+
+    async def main():
+        pvs = [MockPV.from_secret(b"bsnode%d" % i) for i in range(3)]
+        doc = GenesisDoc(chain_id="bs-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pv, config=cfg(),
+                node_key=NodeKey.from_secret(b"bsk%d" % i), name=f"bs{i}")
+            nodes.append(node)
+        try:
+            for n in nodes:
+                await n.start()
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    await a.dial_peer(b.listen_addr, persistent=True)
+            for i in range(4):
+                await nodes[0].mempool.check_tx(b"bs%d=x%d" % (i, i))
+
+            async def reach(h, who):
+                while not all(n.height() >= h for n in who):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(reach(6, nodes), 60)
+
+            # late joiner: full node (no validator key), fast-sync mode
+            late = await Node.create(
+                doc, KVStoreApplication(), priv_validator=None, config=cfg(),
+                node_key=NodeKey.from_secret(b"bsk9"), fast_sync=True,
+                name="bslate")
+            nodes.append(late)
+            await late.start()
+            for a in nodes[:3]:
+                await late.dial_peer(a.listen_addr, persistent=True)
+
+            # must blocksync to (near) tip, switch to consensus, and follow
+            target = max(n.height() for n in nodes[:3]) + 3
+            await asyncio.wait_for(reach(target, nodes), 90)
+            assert late.blocksync_reactor.synced.is_set()
+            for h in range(1, target + 1):
+                hashes = {n.block_store.load_block(h).hash() for n in nodes}
+                assert len(hashes) == 1, f"fork at height {h}"
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
