@@ -1,0 +1,5 @@
+from .file import DoubleSignError, FilePV
+from .signer import RemoteSignerError, SignerClient, SignerServer
+
+__all__ = ["FilePV", "DoubleSignError", "SignerClient", "SignerServer",
+           "RemoteSignerError"]

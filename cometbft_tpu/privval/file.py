@@ -1,0 +1,211 @@
+"""FilePV: file-backed validator key with persisted last-sign-state and
+double-sign protection (reference: ``privval/file.go:75-142`` FilePVKey /
+FilePVLastSignState, ``:164`` FilePV, ``:332`` signVote).
+
+Safety argument (file.go:100 CheckHRS): the signer never signs two
+different messages for the same (height, round, step).  The last sign
+state — including the produced signature and the exact sign bytes — is
+fsync'd to disk *before* the signature is released, so a crash between
+signing and broadcasting cannot lead to equivocation after restart.  A
+re-request for the identical HRS returns the stored signature; one that
+differs only in timestamp returns the stored signature with the stored
+timestamp; anything else is refused."""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..crypto.keys import Ed25519PrivKey, PubKey
+from ..types.canonical import canonical_vote_sign_bytes
+from ..types.priv_validator import PrivValidator
+from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote
+
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_VOTE_STEP = {PREVOTE_TYPE: STEP_PREVOTE, PRECOMMIT_TYPE: STEP_PRECOMMIT}
+
+
+class DoubleSignError(Exception):
+    """Refusal to sign: would conflict with the last signed state."""
+
+
+class FilePV(PrivValidator):
+    def __init__(self, priv_key: Ed25519PrivKey, key_path: str,
+                 state_path: str):
+        self.priv_key = priv_key
+        self.key_path = key_path
+        self.state_path = state_path
+        # last sign state (file.go FilePVLastSignState)
+        self.height = 0
+        self.round = 0
+        self.step = 0
+        self.signature = b""
+        self.sign_bytes = b""
+        self.ext_signature = b""
+
+    # ------------------------------------------------------------- file io
+
+    @classmethod
+    def generate(cls, key_path: str, state_path: str) -> "FilePV":
+        pv = cls(Ed25519PrivKey.generate(), key_path, state_path)
+        pv.save_key()
+        pv._save_state()
+        return pv
+
+    @classmethod
+    def load(cls, key_path: str, state_path: str) -> "FilePV":
+        with open(key_path) as f:
+            kd = json.load(f)
+        pv = cls(Ed25519PrivKey(bytes.fromhex(kd["priv_key"])), key_path,
+                 state_path)
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                sd = json.load(f)
+            pv.height = sd["height"]
+            pv.round = sd["round"]
+            pv.step = sd["step"]
+            pv.signature = bytes.fromhex(sd.get("signature", ""))
+            pv.sign_bytes = bytes.fromhex(sd.get("signbytes", ""))
+            pv.ext_signature = bytes.fromhex(sd.get("ext_signature", ""))
+        return pv
+
+    @classmethod
+    def load_or_generate(cls, key_path: str, state_path: str) -> "FilePV":
+        if os.path.exists(key_path):
+            return cls.load(key_path, state_path)
+        return cls.generate(key_path, state_path)
+
+    def save_key(self) -> None:
+        pub = self.priv_key.pub_key()
+        _atomic_write_json(self.key_path, {
+            "address": pub.address().hex(),
+            "pub_key": pub.bytes().hex(),
+            "priv_key": self.priv_key.bytes().hex(),
+        })
+
+    def _save_state(self) -> None:
+        """fsync'd BEFORE the signature leaves this process (file.go:332
+        'signature is saved to disk before it is returned')."""
+        _atomic_write_json(self.state_path, {
+            "height": self.height,
+            "round": self.round,
+            "step": self.step,
+            "signature": self.signature.hex(),
+            "signbytes": self.sign_bytes.hex(),
+            "ext_signature": self.ext_signature.hex(),
+        })
+
+    # ------------------------------------------------------------- signing
+
+    def get_pub_key(self) -> PubKey:
+        return self.priv_key.pub_key()
+
+    def _check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """file.go:100 CheckHRS: monotonic, returns True if same HRS."""
+        if self.height > height:
+            raise DoubleSignError(f"height regression {self.height}->{height}")
+        if self.height == height:
+            if self.round > round_:
+                raise DoubleSignError(
+                    f"round regression {self.round}->{round_} @ {height}")
+            if self.round == round_:
+                if self.step > step:
+                    raise DoubleSignError(
+                        f"step regression {self.step}->{step} "
+                        f"@ {height}/{round_}")
+                if self.step == step:
+                    if not self.sign_bytes:
+                        raise DoubleSignError("no sign bytes for same HRS")
+                    return True
+        return False
+
+    async def sign_vote(self, chain_id: str, vote: Vote,
+                        sign_extension: bool) -> None:
+        step = _VOTE_STEP[vote.type]
+        same_hrs = self._check_hrs(vote.height, vote.round, step)
+        sb = vote.sign_bytes(chain_id)
+        if same_hrs:
+            if sb == self.sign_bytes:
+                vote.signature = self.signature
+            else:
+                ts = _vote_ts_from_state(self, chain_id, vote)
+                if ts is None:
+                    raise DoubleSignError(
+                        "conflicting vote data for same height/round/step")
+                # identical modulo timestamp: reuse stored sig + timestamp
+                vote.timestamp_ns = ts
+                vote.signature = self.signature
+            if sign_extension:
+                vote.extension_signature = self.ext_signature
+            return
+        sig = self.priv_key.sign(sb)
+        ext_sig = b""
+        if sign_extension:
+            ext_sig = self.priv_key.sign(vote.extension_sign_bytes(chain_id))
+        self.height, self.round, self.step = vote.height, vote.round, step
+        self.signature, self.sign_bytes = sig, sb
+        self.ext_signature = ext_sig
+        self._save_state()
+        vote.signature = sig
+        if sign_extension:
+            vote.extension_signature = ext_sig
+
+    async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        same_hrs = self._check_hrs(proposal.height, proposal.round,
+                                   STEP_PROPOSE)
+        sb = proposal.sign_bytes(chain_id)
+        if same_hrs:
+            if sb == self.sign_bytes:
+                proposal.signature = self.signature
+                return
+            raise DoubleSignError(
+                "conflicting proposal data for same height/round")
+        sig = self.priv_key.sign(sb)
+        self.height, self.round, self.step = (proposal.height,
+                                              proposal.round, STEP_PROPOSE)
+        self.signature, self.sign_bytes = sig, sb
+        self.ext_signature = b""
+        self._save_state()
+        proposal.signature = sig
+
+
+def _vote_ts_from_state(pv: FilePV, chain_id: str, vote: Vote) -> int | None:
+    """If the new vote differs from the stored one ONLY by timestamp,
+    return the stored timestamp (file.go checkVotesOnlyDifferByTimestamp).
+    Probes by re-encoding the new vote with candidate timestamps."""
+    # cheap exact check: re-encode with every plausible stored ts is not
+    # possible (ts not stored separately), so compare canonical encodings
+    # with the new vote's ts substituted out
+    for probe_ts in _extract_ts_candidates(pv.sign_bytes):
+        cand = canonical_vote_sign_bytes(
+            chain_id, vote.type, vote.height, vote.round, vote.block_id,
+            probe_ts)
+        if cand == pv.sign_bytes:
+            return probe_ts
+    return None
+
+
+def _extract_ts_candidates(sign_bytes: bytes):
+    """Best-effort: decode the timestamp field from stored canonical vote
+    bytes.  The canonical encoding is deterministic, so substituting the
+    decoded ts must reproduce ``sign_bytes`` exactly for a match."""
+    from ..types import canonical
+
+    try:
+        yield canonical.decode_timestamp_from_vote(sign_bytes)
+    except Exception:
+        return
+
+
+def _atomic_write_json(path: str, obj: dict) -> None:
+    d = os.path.dirname(path) or "."
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -1,0 +1,151 @@
+"""Remote signer: keep the validator key in a separate process (HSM
+stand-in) and sign over a socket (reference: ``privval/signer_client.go:17``
+SignerClient, ``privval/signer_server.go`` SignerServer, message schema in
+``privval/msgs.go``).
+
+SignerServer listens on TCP/UNIX and serves a wrapped PrivValidator
+(normally a FilePV); SignerClient implements PrivValidator for the node
+side.  Messages are length-prefixed msgpack: PubKeyRequest/Response,
+SignVoteRequest/SignedVoteResponse, SignProposalRequest/
+SignedProposalResponse, Ping/Pong; errors travel as {"err": ...} replies
+(remoteSignerError)."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import msgpack
+
+from ..crypto.keys import Ed25519PubKey, PubKey
+from ..types import codec
+from ..types.priv_validator import PrivValidator
+from ..types.vote import Proposal, Vote
+
+_LEN = struct.Struct("<I")
+MAX_MSG = 1 << 20
+
+
+class RemoteSignerError(Exception):
+    pass
+
+
+async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
+    raw = msgpack.packb(obj, use_bin_type=True)
+    writer.write(_LEN.pack(len(raw)) + raw)
+    await writer.drain()
+
+
+async def _recv(reader: asyncio.StreamReader) -> dict:
+    hdr = await reader.readexactly(_LEN.size)
+    (ln,) = _LEN.unpack(hdr)
+    if ln > MAX_MSG:
+        raise RemoteSignerError(f"oversized signer message: {ln}")
+    return msgpack.unpackb(await reader.readexactly(ln), raw=False)
+
+
+class SignerServer:
+    """Serves a PrivValidator's signing operations to one or more nodes."""
+
+    def __init__(self, pv: PrivValidator):
+        self.pv = pv
+        self._server: asyncio.Server | None = None
+
+    async def listen(self, host: str = "127.0.0.1",
+                     port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        addr = self._server.sockets[0].getsockname()
+        return addr[0], addr[1]
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                req = await _recv(reader)
+                await _send(writer, await self._handle(req))
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _handle(self, req: dict) -> dict:
+        tag = req.get("@")
+        try:
+            if tag == "ping":
+                return {"@": "pong"}
+            if tag == "pubkey_req":
+                return {"@": "pubkey_res",
+                        "pub": self.pv.get_pub_key().bytes()}
+            if tag == "sign_vote_req":
+                vote: Vote = codec.from_dict(req["vote"])
+                await self.pv.sign_vote(req["chain_id"], vote,
+                                        sign_extension=req["ext"])
+                return {"@": "signed_vote_res", "vote": codec.to_dict(vote)}
+            if tag == "sign_proposal_req":
+                prop: Proposal = codec.from_dict(req["proposal"])
+                await self.pv.sign_proposal(req["chain_id"], prop)
+                return {"@": "signed_proposal_res",
+                        "proposal": codec.to_dict(prop)}
+            return {"@": "err", "msg": f"unknown request {tag!r}"}
+        except Exception as e:           # double-sign refusals ride back
+            return {"@": "err", "msg": f"{type(e).__name__}: {e}"}
+
+
+class SignerClient(PrivValidator):
+    """Node-side PrivValidator backed by a remote SignerServer."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, pub_key: PubKey):
+        self._reader = reader
+        self._writer = writer
+        self._pub_key = pub_key
+        self._lock = asyncio.Lock()      # one in-flight request at a time
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "SignerClient":
+        reader, writer = await asyncio.open_connection(host, port)
+        await _send(writer, {"@": "pubkey_req"})
+        res = await _recv(reader)
+        if res.get("@") != "pubkey_res":
+            raise RemoteSignerError(f"bad pubkey response: {res}")
+        return cls(reader, writer, Ed25519PubKey(res["pub"]))
+
+    async def close(self) -> None:
+        self._writer.close()
+
+    async def _round_trip(self, req: dict) -> dict:
+        async with self._lock:
+            await _send(self._writer, req)
+            res = await _recv(self._reader)
+        if res.get("@") == "err":
+            raise RemoteSignerError(res.get("msg", "remote signer error"))
+        return res
+
+    async def ping(self) -> None:
+        await self._round_trip({"@": "ping"})
+
+    def get_pub_key(self) -> PubKey:
+        return self._pub_key
+
+    async def sign_vote(self, chain_id: str, vote: Vote,
+                        sign_extension: bool) -> None:
+        res = await self._round_trip({
+            "@": "sign_vote_req", "chain_id": chain_id,
+            "vote": codec.to_dict(vote), "ext": sign_extension})
+        signed: Vote = codec.from_dict(res["vote"])
+        vote.signature = signed.signature
+        vote.timestamp_ns = signed.timestamp_ns
+        vote.extension_signature = signed.extension_signature
+
+    async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        res = await self._round_trip({
+            "@": "sign_proposal_req", "chain_id": chain_id,
+            "proposal": codec.to_dict(proposal)})
+        signed: Proposal = codec.from_dict(res["proposal"])
+        proposal.signature = signed.signature
+        proposal.timestamp_ns = signed.timestamp_ns

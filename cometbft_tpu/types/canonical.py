@@ -47,6 +47,52 @@ def canonical_vote_sign_bytes(chain_id: str, msg_type: int, height: int,
     return wire.length_prefixed(body)
 
 
+def _read_varint(buf: bytes, off: int) -> tuple[int, int]:
+    shift = v = 0
+    while True:
+        b = buf[off]
+        off += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, off
+        shift += 7
+
+
+def decode_timestamp_from_vote(sign_bytes: bytes) -> int:
+    """Extract the timestamp (ns) from canonical vote sign bytes — used by
+    FilePV to decide whether a re-sign request differs only by timestamp
+    (privval/file.go checkVotesOnlyDifferByTimestamp does the same via
+    proto decode)."""
+    ln, off = _read_varint(sign_bytes, 0)
+    end = off + ln
+    while off < end:
+        tag, off = _read_varint(sign_bytes, off)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            val, off = _read_varint(sign_bytes, off)
+        elif wt == 1:
+            val = int.from_bytes(sign_bytes[off:off + 8], "little")
+            off += 8
+        elif wt == 2:
+            ln2, off = _read_varint(sign_bytes, off)
+            val = sign_bytes[off:off + ln2]
+            off += ln2
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        if field == 5:                       # timestamp submessage
+            seconds = nanos = 0
+            o2 = 0
+            while o2 < len(val):
+                t2, o2 = _read_varint(val, o2)
+                v2, o2 = _read_varint(val, o2)
+                if t2 >> 3 == 1:
+                    seconds = v2
+                elif t2 >> 3 == 2:
+                    nanos = v2
+            return seconds * 1_000_000_000 + nanos
+    raise ValueError("no timestamp field in sign bytes")
+
+
 def canonical_proposal_sign_bytes(chain_id: str, height: int, round_: int,
                                   pol_round: int, block_id: BlockID,
                                   timestamp_ns: int) -> bytes:

@@ -1,6 +1,10 @@
 """PrivValidator interface + in-memory MockPV (reference:
 ``types/priv_validator.go``).  The production FilePV with double-sign
-protection lives in ``privval/``."""
+protection and the remote signer pair live in ``cometbft_tpu.privval``.
+
+The interface is async: a remote signer (privval/signer_client.go) does
+socket round-trips, and the consensus state machine awaits signing on its
+single-writer task."""
 
 from __future__ import annotations
 
@@ -15,12 +19,13 @@ class PrivValidator(ABC):
     def get_pub_key(self) -> PubKey: ...
 
     @abstractmethod
-    def sign_vote(self, chain_id: str, vote: Vote,
-                  sign_extension: bool) -> None:
+    async def sign_vote(self, chain_id: str, vote: Vote,
+                        sign_extension: bool) -> None:
         """Fills vote.signature (and extension_signature if requested)."""
 
     @abstractmethod
-    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None: ...
+    async def sign_proposal(self, chain_id: str,
+                            proposal: Proposal) -> None: ...
 
 
 class MockPV(PrivValidator):
@@ -36,13 +41,13 @@ class MockPV(PrivValidator):
     def get_pub_key(self) -> PubKey:
         return self.priv_key.pub_key()
 
-    def sign_vote(self, chain_id: str, vote: Vote,
-                  sign_extension: bool) -> None:
+    async def sign_vote(self, chain_id: str, vote: Vote,
+                        sign_extension: bool) -> None:
         vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
         if sign_extension:
             vote.extension_signature = self.priv_key.sign(
                 vote.extension_sign_bytes(chain_id))
 
-    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+    async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         proposal.signature = self.priv_key.sign(
             proposal.sign_bytes(chain_id))

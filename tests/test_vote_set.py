@@ -35,7 +35,9 @@ def make_vote(pv, vals, idx, bid, typ=PRECOMMIT_TYPE, height=3, round_=0,
     v = Vote(type=typ, height=height, round=round_, block_id=bid,
              timestamp_ns=ts, validator_address=pv.get_pub_key().address(),
              validator_index=idx)
-    pv.sign_vote(CHAIN_ID, v, sign_extension=False)
+    import asyncio
+
+    asyncio.run(pv.sign_vote(CHAIN_ID, v, sign_extension=False))
     return v
 
 
