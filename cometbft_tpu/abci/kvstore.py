@@ -30,6 +30,7 @@ class KVStoreApplication(Application):
         self.app_hash = self._compute_app_hash()
         self.validators: dict[bytes, int] = {}     # pubkey bytes -> power
         self.pending_updates: list[t.ValidatorUpdate] = []
+        self.misbehavior_seen: list[t.Misbehavior] = []   # punished offenders
         self.snapshots: dict[int, bytes] = {}      # height -> serialized
         self._restore_chunks: dict[int, bytes] = {}
         self._restoring: t.Snapshot | None = None
@@ -94,6 +95,7 @@ class KVStoreApplication(Application):
 
     async def finalize_block(self, req: t.FinalizeBlockRequest
                              ) -> t.FinalizeBlockResponse:
+        self.misbehavior_seen.extend(req.misbehavior)
         results, updates = [], []
         for tx in req.txs:
             parsed = self._parse_tx(tx)

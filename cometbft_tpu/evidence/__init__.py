@@ -1,0 +1,6 @@
+from .pool import EvidencePool
+from .reactor import EVIDENCE_CHANNEL, EvidenceReactor
+from .verify import verify_evidence
+
+__all__ = ["EvidencePool", "EvidenceReactor", "EVIDENCE_CHANNEL",
+           "verify_evidence"]

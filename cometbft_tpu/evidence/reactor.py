@@ -1,0 +1,58 @@
+"""Evidence reactor: gossip pending evidence to peers on channel 0x38
+(reference: ``internal/evidence/reactor.go``; channel id at
+``internal/evidence/reactor.go:17``).
+
+The reference walks the pool's clist per peer, sending one evidence at a
+time; with the pool's on_evidence_added hook and small evidence volumes,
+broadcasting on add + a full sync on peer connect covers the same
+delivery guarantees."""
+
+from __future__ import annotations
+
+import msgpack
+
+from ..types import codec
+from ..types.evidence import EvidenceError
+from ..p2p.reactor import ChannelDescriptor, Reactor
+from .pool import EvidencePool
+
+EVIDENCE_CHANNEL = 0x38
+PENDING_SYNC_MAX_BYTES = 1 << 20
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, pool: EvidencePool):
+        super().__init__()
+        self.pool = pool
+        pool.on_evidence_added = self._broadcast_evidence
+
+    def get_channels(self):
+        return [ChannelDescriptor(EVIDENCE_CHANNEL, priority=6,
+                                  send_queue_capacity=100, name="evidence")]
+
+    def add_peer(self, peer) -> None:
+        for ev in self.pool.pending_evidence(PENDING_SYNC_MAX_BYTES):
+            peer.send(EVIDENCE_CHANNEL, self._msg(ev))
+
+    def receive(self, channel_id: int, peer, msg: bytes) -> None:
+        d = msgpack.unpackb(msg, raw=False)
+        if d.get("@") != "ev":
+            return
+        try:
+            self.pool.add_evidence(codec.unpack(d["e"]))
+        except EvidenceError:
+            # invalid gossiped evidence: drop the peer (reactor.go Receive
+            # punishes the sender)
+            if self.switch is not None:
+                import asyncio
+
+                asyncio.ensure_future(self.switch.stop_peer_for_error(
+                    peer, "invalid evidence"))
+
+    def _msg(self, ev) -> bytes:
+        return msgpack.packb({"@": "ev", "e": codec.pack(ev)},
+                             use_bin_type=True)
+
+    def _broadcast_evidence(self, ev) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(EVIDENCE_CHANNEL, self._msg(ev))

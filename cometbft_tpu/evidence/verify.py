@@ -1,0 +1,104 @@
+"""Evidence verification (reference: ``internal/evidence/verify.go:19,110,164``).
+
+DuplicateVoteEvidence: both votes must be validly signed by the same
+validator, who must have been in the validator set at the evidence height;
+the recorded powers must match that historical set.  Age is checked against
+the consensus evidence params (expired evidence is invalid).
+
+LightClientAttackEvidence verification needs the conflicting block's commit
+checked against the common-height validator set with trusting semantics
+(``VerifyCommitLightTrustingAllSignatures``, the evidence-path hot-path
+call site) — done when the conflicting block payload is present."""
+
+from __future__ import annotations
+
+from fractions import Fraction
+
+from ..types.evidence import (DuplicateVoteEvidence, Evidence, EvidenceError,
+                              LightClientAttackEvidence)
+from ..types.validation import VerifyCommitLightTrustingAllSignatures
+
+
+def verify_evidence(ev: Evidence, state, state_store,
+                    backend: str | None = None, block_store=None) -> None:
+    """internal/evidence/verify.go:19 — dispatch + age check.
+    Raises EvidenceError on any failure.
+
+    When ``block_store`` is given, the evidence's claimed timestamp is
+    pinned to the committed block time at its height (verify.go:36-44) —
+    otherwise an attacker could stamp ancient evidence with a fresh time
+    and slide it past the duration half of the expiry check."""
+    err = ev.validate_basic()
+    if err:
+        raise EvidenceError(f"invalid evidence: {err}")
+
+    ev_time = ev.time_ns()
+    if block_store is not None:
+        blk = block_store.load_block(ev.height())
+        if blk is None:
+            raise EvidenceError(
+                f"no committed block at evidence height {ev.height()}")
+        if ev_time != blk.header.time_ns:
+            raise EvidenceError(
+                f"evidence time {ev_time} != block time "
+                f"{blk.header.time_ns} at height {ev.height()}")
+
+    height = state.last_block_height
+    ev_params = state.consensus_params.evidence
+    age_blocks = height - ev.height()
+    age_ns = state.last_block_time_ns - ev_time
+    if age_blocks > ev_params.max_age_num_blocks and \
+            age_ns > ev_params.max_age_duration_ns:
+        raise EvidenceError(
+            f"evidence from height {ev.height()} is too old "
+            f"({age_blocks} blocks, {age_ns} ns)")
+
+    if isinstance(ev, DuplicateVoteEvidence):
+        _verify_duplicate_vote(ev, state.chain_id, state_store)
+    elif isinstance(ev, LightClientAttackEvidence):
+        _verify_light_client_attack(ev, state.chain_id, state_store, backend)
+    else:
+        raise EvidenceError(f"unknown evidence type {type(ev).__name__}")
+
+
+def _verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
+                           state_store) -> None:
+    """verify.go:164 VerifyDuplicateVote."""
+    vals = state_store.load_validators(ev.height())
+    if vals is None:
+        raise EvidenceError(f"no validator set at height {ev.height()}")
+    idx, val = vals.get_by_address(ev.vote_a.validator_address)
+    if idx < 0:
+        raise EvidenceError("validator not in set at evidence height")
+    if ev.validator_power != val.voting_power:
+        raise EvidenceError(
+            f"validator power mismatch {ev.validator_power} != "
+            f"{val.voting_power}")
+    if ev.total_voting_power != vals.total_voting_power():
+        raise EvidenceError(
+            f"total power mismatch {ev.total_voting_power} != "
+            f"{vals.total_voting_power()}")
+    for v in (ev.vote_a, ev.vote_b):
+        if not val.pub_key.verify_signature(v.sign_bytes(chain_id),
+                                            v.signature):
+            raise EvidenceError("invalid vote signature in evidence")
+
+
+def _verify_light_client_attack(ev: LightClientAttackEvidence,
+                                chain_id: str, state_store,
+                                backend: str | None) -> None:
+    """verify.go:110 VerifyLightClientAttack (conflicting-block commit
+    check against the common-height set with 1/3 trust)."""
+    common_vals = state_store.load_validators(ev.common_height)
+    if common_vals is None:
+        raise EvidenceError(
+            f"no validator set at common height {ev.common_height}")
+    blk = ev.conflicting_block
+    if blk is None:
+        raise EvidenceError("missing conflicting block payload")
+    commit = getattr(blk, "commit", None)
+    if commit is None:
+        raise EvidenceError("conflicting block has no commit")
+    VerifyCommitLightTrustingAllSignatures(
+        chain_id, common_vals, commit, trust_level=Fraction(1, 3),
+        backend=backend)

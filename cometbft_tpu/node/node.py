@@ -14,6 +14,7 @@ import os
 from ..abci.application import Application
 from ..blocksync.reactor import BlocksyncReactor
 from ..config import Config, test_consensus_config
+from ..evidence import EvidencePool, EvidenceReactor
 from ..consensus.reactor import ConsensusReactor
 from ..consensus.replay import Handshaker
 from ..consensus.state import ConsensusState
@@ -50,6 +51,8 @@ class Node:
         self.consensus_reactor: ConsensusReactor | None = None
         self.mempool_reactor: MempoolReactor | None = None
         self.blocksync_reactor: BlocksyncReactor | None = None
+        self.evidence_pool: EvidencePool | None = None
+        self.evidence_reactor: EvidenceReactor | None = None
         self.fast_sync = False
         self.node_key: NodeKey | None = None
         self.transport: Transport | None = None
@@ -95,9 +98,19 @@ class Node:
             max_tx_bytes=cfg.mempool.max_tx_bytes,
             cache_size=cfg.mempool.cache_size,
             keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache)
+        if home is not None:
+            ev_db = LogDB(os.path.join(home, "data", "evidence.db"))
+        else:
+            ev_db = MemDB()
+        self.evidence_pool = EvidencePool(
+            ev_db, state_store=self.state_store,
+            block_store=self.block_store,
+            backend=cfg.base.signature_backend)
+        self.evidence_pool.state = state
         self.block_exec = BlockExecutor(
             self.state_store, self.block_store, self.app_conns.consensus,
-            self.mempool, event_bus=self.event_bus,
+            self.mempool, evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
             backend=cfg.base.signature_backend)
 
         state = await Handshaker(
@@ -108,6 +121,8 @@ class Node:
             cfg.consensus, state, self.block_exec, self.block_store,
             wal=wal, priv_validator=priv_validator,
             event_bus=self.event_bus, name=name)
+        self.consensus.on_conflicting_vote = \
+            self.evidence_pool.report_conflicting_votes
 
         gossip_sleep = cfg.consensus.peer_gossip_sleep_duration / 1e9
         self.consensus_reactor = ConsensusReactor(
@@ -127,9 +142,11 @@ class Node:
         self.node_key = node_key or NodeKey.generate()
         self.transport = Transport(self.node_key, self._node_info)
         self.switch = Switch(self.transport)
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
         return self
 
     async def _switch_to_consensus(self, state) -> None:

@@ -44,6 +44,68 @@ def dense_signature_batch(bsz: int, msg_len: int = 120, seed: int = 7,
     return (pubs, rs, ss, blocks, active), host_items
 
 
+def make_light_chain(n_blocks: int, n_vals: int = 4, *,
+                     chain_id: str = "light-chain", power: int = 10,
+                     rotate_every: int = 0, seed: bytes = b"lc",
+                     base_time_ns: int = 1_700_000_000_000_000_000,
+                     block_interval_ns: int = 1_000_000_000):
+    """Deterministic signed header chain for light-client tests/benches
+    (role of the reference's ``light/helpers_test.go`` genLightBlocks).
+
+    Returns ``list[LightBlock]`` for heights 1..n_blocks.  With
+    ``rotate_every=k`` one validator is replaced every k blocks, so long
+    skips eventually lose 1/3 overlap and force bisection."""
+    from .crypto.keys import Ed25519PrivKey
+    from .light.types import LightBlock
+    from .types.block_id import BlockID, PartSetHeader
+    from .types.canonical import canonical_vote_sign_bytes
+    from .types.commit import (BLOCK_ID_FLAG_COMMIT, Commit, CommitSig)
+    from .types.header import Header
+    from .types.validator_set import Validator, ValidatorSet
+    from .types.vote import PRECOMMIT_TYPE
+
+    privs = [Ed25519PrivKey.from_secret(seed + b"%d" % i)
+             for i in range(n_vals)]
+    by_addr = {p.pub_key().address(): p for p in privs}
+    vals = ValidatorSet([Validator(p.pub_key(), power) for p in privs])
+    next_fresh = n_vals
+
+    blocks: list[LightBlock] = []
+    prev_bid = BlockID()
+    for h in range(1, n_blocks + 1):
+        next_vals = vals.copy()
+        if rotate_every and h % rotate_every == 0:
+            # replace the lexically-first validator with a fresh key
+            new_priv = Ed25519PrivKey.from_secret(seed + b"%d" % next_fresh)
+            next_fresh += 1
+            by_addr[new_priv.pub_key().address()] = new_priv
+            old = next_vals.validators[0]
+            next_vals.update_with_change_set(
+                [Validator(old.pub_key, 0),
+                 Validator(new_priv.pub_key(), power)])
+        header = Header(
+            chain_id=chain_id, height=h,
+            time_ns=base_time_ns + h * block_interval_ns,
+            last_block_id=prev_bid,
+            validators_hash=vals.hash(),
+            next_validators_hash=next_vals.hash(),
+            proposer_address=vals.validators[0].address)
+        bid = BlockID(header.hash(), PartSetHeader(1, b"\x5a" * 32))
+        sigs = []
+        for v in vals.validators:
+            ts = header.time_ns + 1
+            sb = canonical_vote_sign_bytes(chain_id, PRECOMMIT_TYPE, h, 0,
+                                           bid, ts)
+            sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                                  by_addr[v.address].sign(sb)))
+        commit = Commit(h, 0, bid, sigs)
+        blocks.append(LightBlock(header=header, commit=commit,
+                                 validators=vals.copy()))
+        vals = next_vals
+        prev_bid = bid
+    return blocks
+
+
 @dataclass
 class InProcNode:
     name: str
@@ -197,6 +259,8 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
     doc.consensus_params.feature.vote_extensions_enable_height = \
         vote_extensions_height
 
+    from .evidence import EvidencePool
+
     nodes = []
     for i, pv in enumerate(pvs):
         app = app_factory()
@@ -206,7 +270,11 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
         sstore = StateStore(MemDB())
         mp = CListMempool(LocalClient(app))
         state = State.from_genesis(doc)
+        evpool = EvidencePool(state_store=sstore, block_store=bstore,
+                              backend=backend)
+        evpool.state = state
         execu = BlockExecutor(sstore, bstore, client, mp,
+                              evidence_pool=evpool,
                               event_bus=bus, backend=backend)
         # app InitChain
         from .abci import types as abci_t
@@ -220,6 +288,7 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
         cs = ConsensusState(cfg, state, execu, bstore, wal=wal,
                             priv_validator=pv, event_bus=bus,
                             name=f"node{i}")
+        cs.on_conflicting_vote = evpool.report_conflicting_votes
         nodes.append(InProcNode(
             name=f"node{i}", pv=pv, app=app, state=state, consensus=cs,
             block_store=bstore, state_store=sstore, mempool=mp,

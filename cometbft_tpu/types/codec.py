@@ -36,6 +36,8 @@ def to_dict(obj):
         return obj
     if isinstance(obj, (list, tuple)):
         return [to_dict(o) for o in obj]
+    if isinstance(obj, dict):                 # plain containers recurse
+        return {k: to_dict(v) for k, v in obj.items()}
     t = type(obj).__name__
     if isinstance(obj, PartSetHeader):
         return {"!": t, "total": obj.total, "hash": obj.hash}
@@ -97,7 +99,13 @@ def to_dict(obj):
         return {"!": t, "chh": obj.conflicting_header_hash,
                 "chht": obj.conflicting_height, "comh": obj.common_height,
                 "byz": [to_dict(v) for v in obj.byzantine_validators],
-                "tvp": obj.total_voting_power, "ts": obj.timestamp_ns}
+                "tvp": obj.total_voting_power, "ts": obj.timestamp_ns,
+                "cb": to_dict(obj.conflicting_block)}
+    from ..light.types import LightBlock  # lazy: light imports types
+
+    if isinstance(obj, LightBlock):
+        return {"!": "LightBlock", "h": to_dict(obj.header),
+                "c": to_dict(obj.commit), "v": to_dict(obj.validators)}
     raise TypeError(f"codec: unsupported type {t}")
 
 
@@ -107,6 +115,8 @@ def from_dict(d):
     if isinstance(d, list):
         return [from_dict(x) for x in d]
     t = d.get("!")
+    if t is None:                             # plain containers recurse
+        return {k: from_dict(v) for k, v in d.items()}
     if t == "PartSetHeader":
         return PartSetHeader(d["total"], d["hash"])
     if t == "BlockID":
@@ -147,9 +157,10 @@ def from_dict(d):
                      evidence=[from_dict(e) for e in d["ev"]],
                      last_commit=from_dict(d["lc"]))
     if t == "Validator":
-        if d["pk_type"] != "ed25519":
-            raise TypeError(f"unsupported pubkey type {d['pk_type']}")
-        return Validator(Ed25519PubKey(d["pk"]), d["power"], d["prio"])
+        from ..crypto.keys import pub_key_from_type_bytes
+
+        return Validator(pub_key_from_type_bytes(d["pk_type"], d["pk"]),
+                         d["power"], d["prio"])
     if t == "ValidatorSet":
         vs = ValidatorSet.__new__(ValidatorSet)
         vs.validators = [from_dict(v) for v in d["vals"]]
@@ -166,5 +177,11 @@ def from_dict(d):
         return LightClientAttackEvidence(
             d["chh"], d["chht"], d["comh"],
             byzantine_validators=[from_dict(v) for v in d.get("byz", [])],
-            total_voting_power=d["tvp"], timestamp_ns=d["ts"])
+            total_voting_power=d["tvp"], timestamp_ns=d["ts"],
+            conflicting_block=from_dict(d.get("cb")))
+    if t == "LightBlock":
+        from ..light.types import LightBlock
+
+        return LightBlock(header=from_dict(d["h"]), commit=from_dict(d["c"]),
+                          validators=from_dict(d["v"]))
     raise TypeError(f"codec: unknown tag {t!r}")

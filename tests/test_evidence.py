@@ -1,0 +1,190 @@
+"""Evidence subsystem: pool verification + the byzantine tier-1 test — a
+double-signing validator is detected, its equivocation becomes
+DuplicateVoteEvidence in a committed block, and the app is told via ABCI
+misbehavior (reference: ``internal/evidence/pool_test.go``,
+``internal/consensus/byzantine_test.go``)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.testing import make_inproc_network
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence, EvidenceError
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _conflicting_votes(pv, idx, height, round_=0):
+    addr = pv.get_pub_key().address()
+    a = Vote(type=PRECOMMIT_TYPE, height=height, round=round_,
+             block_id=BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32)),
+             timestamp_ns=1000, validator_address=addr, validator_index=idx)
+    b = Vote(type=PRECOMMIT_TYPE, height=height, round=round_,
+             block_id=BlockID(b"\x33" * 32, PartSetHeader(1, b"\x44" * 32)),
+             timestamp_ns=1001, validator_address=addr, validator_index=idx)
+    await pv.sign_vote("test-net", a, sign_extension=False)
+    await pv.sign_vote("test-net", b, sign_extension=False)
+    return a, b
+
+
+def test_pool_accepts_and_serves_valid_duplicate_vote_evidence():
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            await net.start()
+            await net.wait_for_height(3, timeout=60)
+            node = net.nodes[0]
+            pool: EvidencePool = node.consensus.block_exec.evidence_pool
+            pv = net.nodes[3].pv
+            a, b = await _conflicting_votes(pv, 3, height=2)
+            vals = node.state_store.load_validators(2)
+            ev_time = node.block_store.load_block(2).header.time_ns
+            ev = DuplicateVoteEvidence.from_votes(a, b, ev_time, vals)
+            assert pool.add_evidence(ev) is True
+            assert pool.is_pending(ev)
+            assert pool.add_evidence(ev) is False          # dedupe
+            assert ev in pool.pending_evidence(1 << 20)
+            # a tampered copy is rejected
+            bad = DuplicateVoteEvidence(
+                ev.vote_a, ev.vote_b, ev.total_voting_power + 1,
+                ev.validator_power, ev.timestamp_ns)
+            with pytest.raises(EvidenceError):
+                pool.add_evidence(bad)
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_pool_check_evidence_rejects_committed():
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            await net.start()
+            await net.wait_for_height(3, timeout=60)
+            node = net.nodes[0]
+            pool: EvidencePool = node.consensus.block_exec.evidence_pool
+            pv = net.nodes[3].pv
+            a, b = await _conflicting_votes(pv, 3, height=2)
+            vals = node.state_store.load_validators(2)
+            ev_time = node.block_store.load_block(2).header.time_ns
+            ev = DuplicateVoteEvidence.from_votes(a, b, ev_time, vals)
+            pool.check_evidence([ev])            # verifies fresh evidence
+            with pytest.raises(EvidenceError):
+                pool.check_evidence([ev, ev])    # duplicate in one block
+            pool.update(pool.state, [ev])        # mark committed
+            with pytest.raises(EvidenceError):
+                pool.check_evidence([ev])
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
+
+
+def test_byzantine_double_signer_is_punished():
+    """A forged conflicting precommit from validator 3 surfaces as
+    ConflictingVoteError in peers' vote sets, becomes evidence, rides in a
+    proposal, and reaches the app as ABCI misbehavior."""
+
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            await net.start()
+            await net.wait_for_height(2, timeout=60)
+            byz = net.nodes[3]
+            byz_addr = byz.pv.get_pub_key().address()
+            byz_idx, _ = net.nodes[0].consensus.state.validators \
+                .get_by_address(byz_addr)
+            # forge a second precommit for whatever height node0 is on
+            for _ in range(10):
+                h = net.nodes[0].consensus.rs.height
+                fake = Vote(
+                    type=PRECOMMIT_TYPE, height=h, round=0,
+                    block_id=BlockID(b"\x66" * 32,
+                                     PartSetHeader(1, b"\x77" * 32)),
+                    timestamp_ns=123456,
+                    validator_address=byz_addr, validator_index=byz_idx)
+                await byz.pv.sign_vote("test-net", fake,
+                                       sign_extension=False)
+                for node in net.nodes[:3]:
+                    node.consensus.feed_vote(fake, "byzantine")
+                # wait for the evidence to be committed in a block
+                try:
+                    await asyncio.wait_for(self_check(net, byz_addr), 5)
+                    break
+                except asyncio.TimeoutError:
+                    continue
+            else:
+                raise AssertionError("no misbehavior observed")
+        finally:
+            await net.stop()
+        return True
+
+    async def self_check(net, byz_addr):
+        while True:
+            for node in net.nodes:
+                for mb in node.app.misbehavior_seen:
+                    if mb.validator_address == byz_addr and \
+                            mb.type == "DUPLICATE_VOTE":
+                        return
+            await asyncio.sleep(0.05)
+
+    assert run(main())
+
+
+def test_committed_block_carries_evidence():
+    """The block that punishes the offender actually contains the
+    DuplicateVoteEvidence (proposal path pending_evidence -> block)."""
+
+    async def main():
+        net = await make_inproc_network(4)
+        try:
+            await net.start()
+            await net.wait_for_height(2, timeout=60)
+            byz = net.nodes[3]
+            byz_addr = byz.pv.get_pub_key().address()
+            byz_idx, _ = net.nodes[0].consensus.state.validators \
+                .get_by_address(byz_addr)
+            h = net.nodes[0].consensus.rs.height
+            fake = Vote(type=PRECOMMIT_TYPE, height=h, round=0,
+                        block_id=BlockID(b"\x88" * 32,
+                                         PartSetHeader(1, b"\x99" * 32)),
+                        timestamp_ns=7777,
+                        validator_address=byz_addr, validator_index=byz_idx)
+            await byz.pv.sign_vote("test-net", fake, sign_extension=False)
+            for node in net.nodes[:3]:
+                node.consensus.feed_vote(fake, "byzantine")
+
+            async def block_with_evidence():
+                while True:
+                    for node in net.nodes:
+                        for hh in range(1, node.block_store.height() + 1):
+                            blk = node.block_store.load_block(hh)
+                            for ev in blk.evidence:
+                                if isinstance(ev, DuplicateVoteEvidence) \
+                                        and ev.vote_a.validator_address \
+                                        == byz_addr:
+                                    return hh
+                    await asyncio.sleep(0.05)
+
+            hh = await asyncio.wait_for(block_with_evidence(), 30)
+            assert hh > 0
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
