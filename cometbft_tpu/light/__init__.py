@@ -1,0 +1,18 @@
+from .client import SEQUENTIAL, SKIPPING, Client, TrustOptions
+from .detector import DivergenceError
+from .provider import (ErrLightBlockNotFound, LocalNodeProvider, Provider,
+                       ProviderError)
+from .store import TrustedStore
+from .types import (ErrInvalidHeader, ErrNewValSetCantBeTrusted, LightBlock,
+                    LightClientError)
+from .verifier import (verify, verify_adjacent, verify_non_adjacent,
+                       verify_sequential_batched)
+
+__all__ = [
+    "Client", "TrustOptions", "SEQUENTIAL", "SKIPPING", "TrustedStore",
+    "Provider", "LocalNodeProvider", "ProviderError",
+    "ErrLightBlockNotFound", "LightBlock", "LightClientError",
+    "ErrInvalidHeader", "ErrNewValSetCantBeTrusted", "DivergenceError",
+    "verify", "verify_adjacent", "verify_non_adjacent",
+    "verify_sequential_batched",
+]

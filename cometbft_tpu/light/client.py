@@ -1,0 +1,190 @@
+"""Light client (reference: ``light/client.go:133`` Client).
+
+Tracks a trusted header chain from a trust anchor (height + hash inside
+the trusting period), fetching light blocks from a primary provider and
+cross-checking against witnesses (detector).  Verification is *skipping*
+with bisection by default (``light/client.go:702`` verifySkipping): jump
+straight to the target and only fill in intermediate headers when the
+trusted validator set has rotated too far (ErrNewValSetCantBeTrusted).
+Sequential mode uses the batched verifier — runs of same-valset headers
+become single device dispatches (BASELINE configs[3])."""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+
+from .detector import DivergenceError, detect_divergence
+from .provider import Provider
+from .store import TrustedStore
+from .types import (ErrNewValSetCantBeTrusted, LightBlock, LightClientError)
+from .verifier import (DEFAULT_TRUST_LEVEL, MAX_CLOCK_DRIFT_NS, verify,
+                       verify_adjacent, verify_non_adjacent,
+                       verify_sequential_batched)
+
+SEQUENTIAL = "sequential"
+SKIPPING = "skipping"
+
+
+class TrustOptions:
+    """Trust anchor (light.TrustOptions, light/client.go:60)."""
+
+    def __init__(self, period_ns: int, height: int, header_hash: bytes):
+        self.period_ns = period_ns
+        self.height = height
+        self.header_hash = header_hash
+
+
+class Client:
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 primary: Provider, witnesses: list[Provider] | None = None,
+                 store: TrustedStore | None = None,
+                 mode: str = SKIPPING,
+                 trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                 max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
+                 backend: str | None = None,
+                 now_ns=time.time_ns):
+        self.chain_id = chain_id
+        self.trust = trust_options
+        self.primary = primary
+        self.witnesses = list(witnesses or [])
+        self.store = store or TrustedStore()
+        self.mode = mode
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.backend = backend
+        self.now_ns = now_ns
+
+    # ------------------------------------------------------------ anchor
+
+    async def initialize(self) -> LightBlock:
+        """Fetch + pin the trust anchor (light/client.go initializeWithTrustOptions)."""
+        lb = await self.primary.light_block(self.trust.height)
+        if lb.header.hash() != self.trust.header_hash:
+            raise LightClientError(
+                "primary's header at trust height does not match the "
+                "trusted hash")
+        err = lb.validate_basic(self.chain_id)
+        if err:
+            raise LightClientError(f"invalid trust anchor: {err}")
+        self.store.save(lb)
+        return lb
+
+    def latest_trusted(self) -> LightBlock | None:
+        return self.store.latest()
+
+    # ------------------------------------------------------------ verify
+
+    async def verify_light_block_at_height(self, height: int,
+                                           now_ns: int | None = None
+                                           ) -> LightBlock:
+        """light/client.go:470 VerifyLightBlockAtHeight."""
+        now_ns = now_ns if now_ns is not None else self.now_ns()
+        got = self.store.get(height)
+        if got is not None:
+            return got
+        trusted = self.store.latest()
+        if trusted is None:
+            trusted = await self.initialize()
+        if height <= trusted.height:
+            return await self._verify_backwards_or_fetch(height, trusted,
+                                                         now_ns)
+        target = await self.primary.light_block(height)
+        verified = await self._verify_light_block(trusted, target, now_ns)
+        # cross-check BEFORE anything is persisted: a divergent target must
+        # never enter the trusted store (it would short-circuit future
+        # calls via the cache above and skew the detector's common height)
+        await self._cross_check(target, now_ns)
+        for lb in verified:
+            self.store.save(lb)
+        return target
+
+    async def update(self, now_ns: int | None = None) -> LightBlock | None:
+        """Verify the primary's latest header (light/client.go:432)."""
+        now_ns = now_ns if now_ns is not None else self.now_ns()
+        latest = await self.primary.light_block(0)
+        trusted = self.store.latest()
+        if trusted is not None and latest.height <= trusted.height:
+            return trusted
+        return await self.verify_light_block_at_height(latest.height,
+                                                       now_ns)
+
+    async def _verify_light_block(self, trusted: LightBlock,
+                                  target: LightBlock,
+                                  now_ns: int) -> list[LightBlock]:
+        """Returns the newly verified blocks WITHOUT persisting them — the
+        caller saves only after the witness cross-check passes."""
+        if self.mode == SEQUENTIAL:
+            return await self._verify_sequential(trusted, target, now_ns)
+        return await self._verify_skipping(trusted, target, now_ns)
+
+    async def _verify_sequential(self, trusted: LightBlock,
+                                 target: LightBlock,
+                                 now_ns: int) -> list[LightBlock]:
+        """Fetch every intermediate header, prove them in batched device
+        dispatches (client.go:609 verifySequential, TPU-redesigned)."""
+        chain = []
+        for h in range(trusted.height + 1, target.height):
+            chain.append(await self.primary.light_block(h))
+        chain.append(target)
+        verify_sequential_batched(self.chain_id, trusted, chain,
+                                  self.trust.period_ns, now_ns,
+                                  self.max_clock_drift_ns, self.backend)
+        return chain
+
+    async def _verify_skipping(self, trusted: LightBlock,
+                               target: LightBlock,
+                               now_ns: int) -> list[LightBlock]:
+        """client.go:702 verifySkipping: try the jump; on
+        ErrNewValSetCantBeTrusted bisect down until it verifies, then
+        continue up from the new pivot."""
+        verified = []
+        pivots = [target]
+        cur = trusted
+        while pivots:
+            candidate = pivots[-1]
+            try:
+                verify_non_adjacent(self.chain_id, cur, candidate,
+                                    self.trust.period_ns, now_ns,
+                                    self.trust_level,
+                                    self.max_clock_drift_ns, self.backend)
+            except ErrNewValSetCantBeTrusted:
+                mid = (cur.height + candidate.height) // 2
+                if mid in (cur.height, candidate.height):
+                    raise LightClientError(
+                        "bisection exhausted: adjacent header unverifiable")
+                pivots.append(await self.primary.light_block(mid))
+                continue
+            verified.append(candidate)
+            cur = candidate
+            pivots.pop()
+        return verified
+
+    async def _verify_backwards_or_fetch(self, height: int,
+                                         trusted: LightBlock,
+                                         now_ns: int) -> LightBlock:
+        """Historic header below the trusted head: fetch and hash-link
+        backwards (client.go backwards)."""
+        lb = await self.primary.light_block(height)
+        err = lb.validate_basic(self.chain_id)
+        if err:
+            raise LightClientError(f"invalid historic header: {err}")
+        # walk back from the closest trusted block above
+        cur = trusted
+        while cur.height > height + 1:
+            prev = await self.primary.light_block(cur.height - 1)
+            if cur.header.last_block_id.hash != prev.header.hash():
+                raise LightClientError(
+                    f"hash chain break at height {prev.height}")
+            cur = prev
+        if cur.header.last_block_id.hash != lb.header.hash():
+            raise LightClientError(
+                f"historic header {height} not linked to trusted chain")
+        self.store.save(lb)
+        return lb
+
+    # ---------------------------------------------------------- detector
+
+    async def _cross_check(self, lb: LightBlock, now_ns: int) -> None:
+        if self.witnesses:
+            await detect_divergence(self, lb, now_ns)

@@ -1,0 +1,76 @@
+"""Attack detection: compare the primary's newly verified header against
+every witness (reference: ``light/detector.go:28,121``).
+
+A witness that serves a DIFFERENT validly-signed header at the same height
+means either the primary or the witness is attacking: the divergence is
+surfaced as DivergenceError carrying LightClientAttackEvidence for both
+sides (the reference sends evidence to the respective honest parties)."""
+
+from __future__ import annotations
+
+from ..types.evidence import LightClientAttackEvidence
+from .provider import ErrLightBlockNotFound
+from .types import LightBlock, LightClientError
+
+
+class DivergenceError(LightClientError):
+    def __init__(self, witness_id: str, primary_block: LightBlock,
+                 witness_block: LightBlock, evidence):
+        self.witness_id = witness_id
+        self.primary_block = primary_block
+        self.witness_block = witness_block
+        self.evidence = evidence
+        super().__init__(
+            f"witness {witness_id} diverges at height "
+            f"{primary_block.height}: primary "
+            f"{primary_block.header.hash().hex()[:12]} vs witness "
+            f"{witness_block.header.hash().hex()[:12]}")
+
+
+async def detect_divergence(client, lb: LightBlock, now_ns: int) -> None:
+    """detector.go:28 detectDivergence: every witness must agree on the
+    header hash at lb.height.
+
+    A witness reply is only treated as a conflict if it is itself a
+    validly signed light block (detector.go compareNewHeaderWithWitness
+    verifies before examining) — otherwise one broken witness could DoS
+    the client with fabricated headers; such witnesses are dropped."""
+    from ..types.validation import CommitVerificationError, VerifyCommitLight
+
+    bad_witnesses = []
+    try:
+        for witness in client.witnesses:
+            try:
+                wlb = await witness.light_block(lb.height)
+            except ErrLightBlockNotFound:
+                continue             # witness lags; reference retries later
+            if wlb.header.hash() == lb.header.hash():
+                continue
+            err = wlb.validate_basic(client.chain_id)
+            if err is None:
+                try:
+                    VerifyCommitLight(client.chain_id, wlb.validators,
+                                      wlb.commit.block_id, wlb.height,
+                                      wlb.commit, backend=client.backend)
+                except CommitVerificationError as e:
+                    err = str(e)
+            if err is not None:
+                # not a real signed fork, just a broken/lying witness
+                bad_witnesses.append(witness)
+                continue
+            # validly signed conflicting header: an actual attack on one
+            # side (detector.go:121 handleConflictingHeaders)
+            trusted = client.store.latest()
+            common_height = trusted.height if trusted is not None \
+                else lb.height
+            ev = LightClientAttackEvidence(
+                conflicting_header_hash=wlb.header.hash(),
+                conflicting_height=wlb.height,
+                common_height=min(common_height, wlb.height),
+                total_voting_power=wlb.validators.total_voting_power(),
+                timestamp_ns=wlb.header.time_ns,
+                conflicting_block=wlb)
+            raise DivergenceError(witness.id(), lb, wlb, ev)
+    finally:
+        for w in bad_witnesses:
+            client.witnesses.remove(w)

@@ -1,0 +1,57 @@
+"""Light-block providers (reference: ``light/provider/provider.go``; the
+http provider is ``light/provider/http``).
+
+``LocalNodeProvider`` serves light blocks straight from a node's block and
+state stores (the in-process analogue of the reference's RPC provider —
+the RPC-backed provider plugs in the same interface once the RPC client
+exists)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from .types import LightBlock, LightClientError
+
+
+class ProviderError(LightClientError):
+    pass
+
+
+class ErrLightBlockNotFound(ProviderError):
+    pass
+
+
+class Provider(ABC):
+    @abstractmethod
+    async def light_block(self, height: int) -> LightBlock:
+        """Light block at height (0 = latest).  Raises
+        ErrLightBlockNotFound."""
+
+    def id(self) -> str:
+        return type(self).__name__
+
+
+class LocalNodeProvider(Provider):
+    def __init__(self, block_store, state_store, name: str = "local"):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.name = name
+
+    def id(self) -> str:
+        return self.name
+
+    async def light_block(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self.block_store.height()
+        block = self.block_store.load_block(height)
+        commit = self.block_store.load_block_commit(height)
+        if commit is None:
+            seen = self.block_store.load_seen_commit()
+            if seen is not None and seen.height == height:
+                commit = seen
+        vals = self.state_store.load_validators(height)
+        if block is None or commit is None or vals is None:
+            raise ErrLightBlockNotFound(
+                f"{self.name}: no light block at height {height}")
+        return LightBlock(header=block.header, commit=commit,
+                          validators=vals)
