@@ -29,6 +29,18 @@ ED25519_KEY_TYPE = "ed25519"
 SECP256K1_KEY_TYPE = "secp256k1"
 BLS12381_KEY_TYPE = "bls12_381"
 
+
+def pub_key_from_type_bytes(key_type: str, raw: bytes) -> "PubKey":
+    """Key-type registry dispatch (internal/keytypes/keytypes.go:14-33 +
+    crypto/encoding/codec.go)."""
+    if key_type == ED25519_KEY_TYPE:
+        return Ed25519PubKey(raw)
+    if key_type == SECP256K1_KEY_TYPE:
+        from .secp256k1 import Secp256k1PubKey
+
+        return Secp256k1PubKey(raw)
+    raise ValueError(f"unsupported pubkey type {key_type!r}")
+
 ADDRESS_SIZE = 20
 
 

@@ -1,0 +1,120 @@
+"""secp256k1 ECDSA keys (reference: ``crypto/secp256k1/secp256k1.go``).
+
+Semantics mirror the reference's dcrec-backed implementation:
+- address  = RIPEMD160(SHA256(33-byte compressed pubkey))
+  (``secp256k1.go:147-166``)
+- signature = 64-byte big-endian R || S over SHA256(msg), S normalized to
+  the lower half order on signing; verification REJECTS malleable (high-S)
+  signatures (``secp256k1.go Sign/VerifySignature``).
+
+The curve math rides on OpenSSL via the ``cryptography`` package — the
+same native-backend stance as the ed25519 CPU path (SURVEY §2.9: native
+where the reference is native).  secp256k1 never batches on device; in a
+mixed-key commit the TpuBatchVerifier routes these lanes to CPU while
+ed25519 lanes fill the device batch (BASELINE configs[5])."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature, encode_dss_signature)
+from cryptography.hazmat.primitives.serialization import (Encoding,
+                                                          PublicFormat)
+from cryptography.exceptions import InvalidSignature
+
+from .keys import SECP256K1_KEY_TYPE, PrivKey, PubKey
+
+# curve order (SEC2 v2)
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_HALF_N = _N // 2
+
+PUB_KEY_SIZE = 33          # compressed
+PRIV_KEY_SIZE = 32
+SIG_SIZE = 64
+
+
+class Secp256k1PubKey(PubKey):
+    SIZE = PUB_KEY_SIZE
+
+    def __init__(self, raw: bytes):
+        if len(raw) != self.SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {self.SIZE} bytes")
+        self._raw = bytes(raw)
+        self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256K1(), self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return SECP256K1_KEY_TYPE
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(pubkey)) — bitcoin-style, unlike ed25519's
+        truncated SHA256 (secp256k1.go:147-166)."""
+        sha = hashlib.sha256(self._raw).digest()
+        return hashlib.new("ripemd160", sha).digest()
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < _N and 1 <= s < _N):
+            return False
+        if s > _HALF_N:
+            return False            # reject malleable signatures
+        try:
+            self._pk.verify(encode_dss_signature(r, s), msg,
+                            ec.ECDSA(hashes.SHA256()))
+            return True
+        except InvalidSignature:
+            return False
+
+
+class Secp256k1PrivKey(PrivKey):
+    SIZE = PRIV_KEY_SIZE
+
+    def __init__(self, raw: bytes):
+        if len(raw) != self.SIZE:
+            raise ValueError(f"secp256k1 privkey must be {self.SIZE} bytes")
+        self._raw = bytes(raw)
+        self._sk = ec.derive_private_key(int.from_bytes(raw, "big"),
+                                         ec.SECP256K1())
+
+    @classmethod
+    def generate(cls) -> "Secp256k1PrivKey":
+        while True:
+            cand = os.urandom(32)
+            v = int.from_bytes(cand, "big")
+            if 1 <= v < _N:
+                return cls(cand)
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Secp256k1PrivKey":
+        """One-way derivation like GenPrivKeySecp256k1 (secp256k1.go:95):
+        sha256(secret), reduced into [1, n-1]."""
+        v = int.from_bytes(hashlib.sha256(secret).digest(), "big")
+        v = v % (_N - 1) + 1
+        return cls(v.to_bytes(32, "big"))
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return SECP256K1_KEY_TYPE
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > _HALF_N:
+            s = _N - s              # low-S normalization
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        return Secp256k1PubKey(self._sk.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint))

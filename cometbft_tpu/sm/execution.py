@@ -177,13 +177,21 @@ class BlockExecutor:
         next_vals = state.next_validators.copy()
         changed_height = state.last_height_validators_changed
         if resp.validator_updates:
+            from ..crypto.keys import pub_key_from_type_bytes
+
             changes = []
             for vu in resp.validator_updates:
-                if vu.pub_key_type != "ed25519":
+                allowed = state.consensus_params.validator.pub_key_types
+                if vu.pub_key_type not in allowed:
                     raise BlockValidationError(
-                        f"unsupported validator key type {vu.pub_key_type}")
-                changes.append(Validator(Ed25519PubKey(vu.pub_key_bytes),
-                                         vu.power))
+                        f"validator key type {vu.pub_key_type} not in "
+                        f"allowed {allowed}")
+                try:
+                    key = pub_key_from_type_bytes(vu.pub_key_type,
+                                                  vu.pub_key_bytes)
+                except ValueError as e:
+                    raise BlockValidationError(str(e)) from e
+                changes.append(Validator(key, vu.power))
             next_vals.update_with_change_set(changes)
             changed_height = height + 1
         next_vals.increment_proposer_priority(1)

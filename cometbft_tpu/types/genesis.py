@@ -118,13 +118,18 @@ class GenesisDoc:
         load_into(params.version, "version")
         load_into(params.feature, "feature")
         load_into(params.synchrony, "synchrony")
+        from ..crypto.keys import pub_key_from_type_bytes
+
         vals = []
         for v in d.get("validators", []):
-            if v["pub_key"]["type"] != "ed25519":
-                raise GenesisError("only ed25519 genesis validators supported")
-            vals.append(GenesisValidator(
-                Ed25519PubKey(base64.b64decode(v["pub_key"]["value"])),
-                int(v["power"]), v.get("name", "")))
+            try:
+                key = pub_key_from_type_bytes(
+                    v["pub_key"]["type"],
+                    base64.b64decode(v["pub_key"]["value"]))
+            except ValueError as e:
+                raise GenesisError(f"bad genesis validator key: {e}") from e
+            vals.append(GenesisValidator(key, int(v["power"]),
+                                         v.get("name", "")))
         doc = cls(chain_id=d["chain_id"],
                   genesis_time_ns=d.get("genesis_time_ns", 0),
                   initial_height=d.get("initial_height", 1),

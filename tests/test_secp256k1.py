@@ -1,0 +1,107 @@
+"""secp256k1 keys + mixed-key commit verification through the batch seam
+(reference: ``crypto/secp256k1/secp256k1_test.go``; mixed routing is the
+BASELINE configs[5] shape — where the reference REFUSES to batch mixed key
+types, the TpuBatchVerifier routes ed25519 lanes to the device and
+secp256k1 lanes to CPU)."""
+
+import pytest
+
+from cometbft_tpu.crypto.batch import create_batch_verifier
+from cometbft_tpu.crypto.keys import Ed25519PrivKey
+from cometbft_tpu.crypto.secp256k1 import (Secp256k1PrivKey, Secp256k1PubKey,
+                                           _HALF_N, _N)
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.validation import VerifyCommit
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+from test_types import CHAIN_ID, make_commit
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def test_sign_verify_roundtrip():
+    sk = Secp256k1PrivKey.generate()
+    pk = sk.pub_key()
+    sig = sk.sign(b"a message")
+    assert len(sig) == 64
+    assert pk.verify_signature(b"a message", sig)
+    assert not pk.verify_signature(b"another message", sig)
+    assert not pk.verify_signature(b"a message", sig[:-1] + b"\x00")
+
+
+def test_low_s_enforced_and_malleable_rejected():
+    sk = Secp256k1PrivKey.from_secret(b"malleable")
+    sig = sk.sign(b"msg")
+    s = int.from_bytes(sig[32:], "big")
+    assert s <= _HALF_N
+    # the complementary (high-S) signature verifies under plain ECDSA but
+    # must be REJECTED here
+    high = sig[:32] + (_N - s).to_bytes(32, "big")
+    assert not sk.pub_key().verify_signature(b"msg", high)
+
+
+def test_address_is_ripemd160_sha256():
+    import hashlib
+
+    pk = Secp256k1PrivKey.from_secret(b"addr").pub_key()
+    want = hashlib.new("ripemd160",
+                       hashlib.sha256(pk.bytes()).digest()).digest()
+    assert pk.address() == want
+    assert len(pk.address()) == 20
+
+
+def test_from_secret_deterministic():
+    a = Secp256k1PrivKey.from_secret(b"same")
+    b = Secp256k1PrivKey.from_secret(b"same")
+    assert a.bytes() == b.bytes()
+    assert a.pub_key().bytes() == b.pub_key().bytes()
+
+
+def test_pubkey_roundtrip_compressed():
+    pk = Secp256k1PrivKey.generate().pub_key()
+    again = Secp256k1PubKey(pk.bytes())
+    assert again.bytes() == pk.bytes()
+    assert pk.bytes()[0] in (2, 3) and len(pk.bytes()) == 33
+
+
+def _mixed_vals(n_ed, n_secp):
+    privs = [Ed25519PrivKey.from_secret(b"med%d" % i) for i in range(n_ed)]
+    privs += [Secp256k1PrivKey.from_secret(b"msec%d" % i)
+              for i in range(n_secp)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, by_addr
+
+
+def test_mixed_key_batch_verifier_routes_both():
+    vals, by_addr = _mixed_vals(6, 3)
+    bv = create_batch_verifier("jax")       # device-style verifier on CPU
+    import os
+
+    msgs = []
+    for i, v in enumerate(vals.validators):
+        msg = b"lane %d" % i
+        bv.add(v.pub_key, msg, by_addr[v.address].sign(msg))
+        msgs.append(msg)
+    ok, oks = bv.verify()
+    assert ok and all(oks) and len(oks) == 9
+
+
+def test_mixed_key_commit_verifies():
+    """A commit signed by both key families passes VerifyCommit through the
+    TPU-style verifier (the reference's shouldBatchVerify would bail to
+    one-by-one; here it is one call)."""
+    vals, by_addr = _mixed_vals(5, 3)
+    commit = make_commit(vals, by_addr, height=10, round_=0)
+    VerifyCommit(CHAIN_ID, vals, commit.block_id, 10, commit, backend="jax")
+    # and a corrupted secp lane is caught
+    secp_idx = next(i for i, v in enumerate(vals.validators)
+                    if v.pub_key.type() == "secp256k1")
+    commit2 = make_commit(vals, by_addr, height=10, round_=0,
+                          bad_at={secp_idx})
+    from cometbft_tpu.types.validation import ErrInvalidSignature
+
+    with pytest.raises(ErrInvalidSignature):
+        VerifyCommit(CHAIN_ID, vals, commit2.block_id, 10, commit2,
+                     backend="jax")
