@@ -23,7 +23,8 @@ from ..libs.pubsub import EventBus
 from ..mempool.clist_mempool import CListMempool
 from ..mempool.reactor import MempoolReactor
 from ..p2p import NodeInfo, NodeKey, Switch, Transport
-from ..proxy.multi_app_conn import AppConns, local_client_creator
+from ..proxy.multi_app_conn import (AppConns, local_client_creator,
+                                    socket_client_creator)
 from ..sm.execution import BlockExecutor
 from ..storage import BlockStore, LogDB, MemDB, State, StateStore
 from ..types.genesis import GenesisDoc
@@ -58,6 +59,8 @@ class Node:
         self.transport: Transport | None = None
         self.switch: Switch | None = None
         self.listen_addr: str | None = None
+        self.rpc_server = None
+        self.rpc_addr: tuple[str, int] | None = None
         self.name = "node"
         self._started = False
 
@@ -90,7 +93,17 @@ class Node:
 
         state = self.state_store.load() or State.from_genesis(genesis_doc)
 
-        self.app_conns = AppConns(local_client_creator(app))
+        if app is not None:
+            creator = local_client_creator(app)
+        elif cfg.base.abci == "socket":
+            # out-of-process app over the ABCI socket protocol
+            # (proxy/client.go remote creator)
+            shost, sport = _parse_laddr(cfg.base.proxy_app)
+            creator = socket_client_creator(shost, sport)
+        else:
+            raise ValueError("no application: pass app or configure "
+                             "base.abci='socket' with base.proxy_app addr")
+        self.app_conns = AppConns(creator)
         await self.app_conns.start()
         self.event_bus = EventBus()
         self.mempool = CListMempool(
@@ -172,12 +185,22 @@ class Node:
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)
         await self.switch.start()
+        if self.config.rpc.laddr:
+            from ..rpc import RPCServer
+
+            rhost, rport = _parse_laddr(self.config.rpc.laddr)
+            self.rpc_server = RPCServer(self)
+            self.rpc_addr = await self.rpc_server.listen(rhost, rport)
         if not self.fast_sync:
             # fast-sync defers consensus start to the blocksync handoff
             await self.consensus.start()
         self._started = True
 
     async def stop(self) -> None:
+        if self.rpc_server is not None:
+            await self.rpc_server.close()
+        if self.blocksync_reactor is not None:
+            await self.blocksync_reactor.stop()
         if self.consensus is not None:
             await self.consensus.stop()
         if self.switch is not None:

@@ -1,0 +1,6 @@
+from .client import HTTPClient, WSClient
+from .core import ROUTES, Environment, RPCError
+from .server import RPCServer, parse_query
+
+__all__ = ["RPCServer", "HTTPClient", "WSClient", "Environment", "ROUTES",
+           "RPCError", "parse_query"]

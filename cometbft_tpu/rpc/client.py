@@ -1,0 +1,141 @@
+"""JSON-RPC clients (reference: ``rpc/jsonrpc/client/{http_json_client,
+ws_client}.go``): an HTTP client for request/response routes and a
+WebSocket client for event subscriptions."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import os
+import struct
+
+from .core import RPCError
+
+
+class HTTPClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._id = 0
+
+    async def call(self, method: str, **params):
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": params}).encode()
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(
+                b"POST / HTTP/1.1\r\nHost: rpc\r\n"
+                b"Content-Type: application/json\r\n"
+                b"Content-Length: " + str(len(body)).encode() +
+                b"\r\nConnection: close\r\n\r\n" + body)
+            await writer.drain()
+            status = await reader.readline()
+            if b"200" not in status:
+                raise RPCError(-32000, f"http error: {status.decode()!r}")
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            raw = await reader.readexactly(int(headers["content-length"]))
+        finally:
+            writer.close()
+        resp = json.loads(raw)
+        if "error" in resp:
+            err = resp["error"]
+            raise RPCError(err.get("code", -1), err.get("message", ""),
+                           err.get("data", ""))
+        return resp["result"]
+
+
+class WSClient:
+    """Minimal RFC6455 client for subscribe/notification flows."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self._id = 0
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "WSClient":
+        reader, writer = await asyncio.open_connection(host, port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        writer.write((
+            f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n").encode())
+        await writer.drain()
+        status = await reader.readline()
+        if b"101" not in status:
+            raise RPCError(-32000, f"ws upgrade failed: {status.decode()!r}")
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        return cls(reader, writer)
+
+    async def close(self) -> None:
+        self.writer.close()
+
+    async def send(self, method: str, **params) -> None:
+        self._id += 1
+        await self._send_frame(1, json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method,
+             "params": params}).encode())
+
+    async def recv(self) -> dict:
+        while True:
+            op, payload = await self._read_frame()
+            if op == 8:
+                raise ConnectionError("ws closed")
+            if op == 9:
+                await self._send_frame(10, payload)
+                continue
+            if op in (1, 2):
+                return json.loads(payload)
+
+    async def subscribe(self, query: str) -> None:
+        await self.send("subscribe", query=query)
+        resp = await self.recv()
+        if "error" in resp:
+            raise RPCError(-32000, str(resp["error"]))
+
+    async def next_event(self, timeout: float = 10.0) -> dict:
+        while True:
+            resp = await asyncio.wait_for(self.recv(), timeout)
+            if resp.get("id") is None and "result" in resp:
+                return resp["result"]
+
+    async def _send_frame(self, op: int, payload: bytes) -> None:
+        mask = os.urandom(4)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        ln = len(payload)
+        if ln < 126:
+            hdr = bytes([0x80 | op, 0x80 | ln])
+        elif ln < (1 << 16):
+            hdr = bytes([0x80 | op, 0x80 | 126]) + struct.pack(">H", ln)
+        else:
+            hdr = bytes([0x80 | op, 0x80 | 127]) + struct.pack(">Q", ln)
+        self.writer.write(hdr + mask + masked)
+        await self.writer.drain()
+
+    async def _read_frame(self) -> tuple[int, bytes]:
+        hdr = await self.reader.readexactly(2)
+        op = hdr[0] & 0x0F
+        masked = hdr[1] & 0x80
+        ln = hdr[1] & 0x7F
+        if ln == 126:
+            (ln,) = struct.unpack(">H", await self.reader.readexactly(2))
+        elif ln == 127:
+            (ln,) = struct.unpack(">Q", await self.reader.readexactly(8))
+        mask = await self.reader.readexactly(4) if masked else None
+        data = bytearray(await self.reader.readexactly(ln))
+        if mask:
+            for i in range(len(data)):
+                data[i] ^= mask[i % 4]
+        return op, bytes(data)

@@ -1,0 +1,439 @@
+"""RPC core routes (reference: ``rpc/core/routes.go:15-62`` and the
+handler files ``rpc/core/{status,blocks,mempool,consensus,abci,net,
+evidence}.go``).
+
+``Environment`` carries the node internals every handler reads
+(``rpc/core/env.go``); ``ROUTES`` maps method name -> handler coroutine.
+Handlers return plain JSON-able dicts (domain objects projected through
+``rpc.json.jsonable``)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..mempool.clist_mempool import TxRejectedError
+from ..types import events as ev
+from ..types.evidence import EvidenceError
+from .json import from_jsonable, jsonable
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        self.code = code
+        self.data = data
+        super().__init__(message)
+        self.message = message
+
+
+class Environment:
+    """rpc/core/env.go Environment: what routes need from the node."""
+
+    def __init__(self, node):
+        self.node = node
+
+    @property
+    def block_store(self):
+        return self.node.block_store
+
+    @property
+    def state_store(self):
+        return self.node.state_store
+
+
+def _height_or_latest(env: Environment, height) -> int:
+    if height in (None, 0, "0", ""):
+        return env.block_store.height()
+    h = int(height)
+    if h < env.block_store.base() or h > env.block_store.height():
+        raise RPCError(-32603, f"height {h} is not available "
+                       f"(base {env.block_store.base()}, "
+                       f"height {env.block_store.height()})")
+    return h
+
+
+# ------------------------------------------------------------------ info
+
+async def health(env: Environment) -> dict:
+    return {}
+
+
+async def status(env: Environment) -> dict:
+    """rpc/core/status.go Status."""
+    node = env.node
+    h = env.block_store.height()
+    meta = env.block_store.load_block_meta(h) if h else None
+    pv = node.consensus.priv_validator if node.consensus else None
+    return {
+        "node_info": {
+            "id": node.node_key.id if node.node_key else "",
+            "listen_addr": node.listen_addr or "",
+            "network": node.genesis.chain_id,
+            "moniker": node.name,
+        },
+        "sync_info": {
+            "latest_block_height": h,
+            "latest_block_hash": meta.block_id.hash.hex() if meta else "",
+            "latest_block_time_ns":
+                env.block_store.load_block(h).header.time_ns if h else 0,
+            "earliest_block_height": env.block_store.base(),
+            "catching_up": not (node.blocksync_reactor is None
+                                or node.blocksync_reactor.synced.is_set()),
+        },
+        "validator_info": {
+            "address": pv.get_pub_key().address().hex() if pv else "",
+            "pub_key": pv.get_pub_key().bytes().hex() if pv else "",
+        },
+    }
+
+
+async def net_info(env: Environment) -> dict:
+    sw = env.node.switch
+    peers = []
+    if sw is not None:
+        for p in sw.peers.values():
+            peers.append({"node_id": p.id, "moniker": p.node_info.moniker,
+                          "outbound": p.outbound})
+    return {"listening": env.node.listen_addr is not None,
+            "listen_addr": env.node.listen_addr or "",
+            "n_peers": len(peers), "peers": peers}
+
+
+async def genesis(env: Environment) -> dict:
+    import json as _json
+
+    return {"genesis": _json.loads(env.node.genesis.to_json())}
+
+
+# ---------------------------------------------------------------- blocks
+
+async def block(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    blk = env.block_store.load_block(h)
+    meta = env.block_store.load_block_meta(h)
+    if blk is None:
+        raise RPCError(-32603, f"no block at height {h}")
+    return {"block_id": jsonable(meta.block_id), "block": jsonable(blk)}
+
+
+async def block_by_hash(env: Environment, hash=None) -> dict:
+    want = bytes.fromhex(hash) if isinstance(hash, str) else hash
+    bs = env.block_store
+    for h in range(bs.height(), bs.base() - 1, -1):
+        meta = bs.load_block_meta(h)
+        if meta is not None and meta.block_id.hash == want:
+            return await block(env, h)
+    raise RPCError(-32603, f"block with hash {want.hex()} not found")
+
+
+async def header(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    blk = env.block_store.load_block(h)
+    if blk is None:
+        raise RPCError(-32603, f"no block at height {h}")
+    return {"header": jsonable(blk.header)}
+
+
+async def commit(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    cmt = env.block_store.load_block_commit(h)
+    canonical = True
+    if cmt is None:
+        seen = env.block_store.load_seen_commit()
+        if seen is not None and seen.height == h:
+            cmt, canonical = seen, False
+    if cmt is None:
+        raise RPCError(-32603, f"no commit for height {h}")
+    blk = env.block_store.load_block(h)
+    return {"header": jsonable(blk.header) if blk else None,
+            "commit": jsonable(cmt), "canonical": canonical}
+
+
+async def blockchain(env: Environment, min_height=None,
+                     max_height=None) -> dict:
+    """rpc/core/blocks.go BlockchainInfo: metas for a height range,
+    newest first, capped at 20."""
+    bs = env.block_store
+    maxh = int(max_height) if max_height else bs.height()
+    maxh = min(maxh, bs.height())
+    minh = int(min_height) if min_height else max(bs.base(), maxh - 19)
+    minh = max(minh, bs.base(), maxh - 19)
+    metas = []
+    for h in range(maxh, minh - 1, -1):
+        m = bs.load_block_meta(h)
+        if m is not None:
+            metas.append({"block_id": jsonable(m.block_id),
+                          "header_height": m.header_height,
+                          "num_txs": m.num_txs,
+                          "block_size": m.block_size})
+    return {"last_height": bs.height(), "block_metas": metas}
+
+
+async def block_results(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    raw = env.state_store.load_finalize_block_response(h)
+    if raw is None:
+        raise RPCError(-32603, f"no results for height {h}")
+    from ..sm.execution import unpack_finalize_response
+
+    resp = unpack_finalize_response(raw)
+    return {
+        "height": h,
+        "tx_results": [{"code": r.code, "data": r.data.hex(),
+                        "log": r.log, "gas_used": r.gas_used}
+                       for r in resp.tx_results],
+        "validator_updates": [{"pub_key_type": u.pub_key_type,
+                               "pub_key": u.pub_key_bytes.hex(),
+                               "power": u.power}
+                              for u in resp.validator_updates],
+        "app_hash": resp.app_hash.hex(),
+    }
+
+
+async def validators(env: Environment, height=None, page=1,
+                     per_page=30) -> dict:
+    h = _height_or_latest(env, height)
+    vals = env.state_store.load_validators(h)
+    if vals is None:
+        raise RPCError(-32603, f"no validator set at height {h}")
+    page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
+    start = (page - 1) * per_page
+    sel = vals.validators[start:start + per_page]
+    return {"block_height": h,
+            "validators": [{"address": v.address.hex(),
+                            "pub_key_type": v.pub_key.type(),
+                            "pub_key": v.pub_key.bytes().hex(),
+                            "voting_power": v.voting_power,
+                            "proposer_priority": v.proposer_priority}
+                           for v in sel],
+            "count": len(sel), "total": vals.size()}
+
+
+async def consensus_params(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    params = env.state_store.load_params(h)
+    if params is None:
+        raise RPCError(-32603, f"no consensus params at height {h}")
+    return {"block_height": h, "consensus_params": {
+        "block": {"max_bytes": params.block.max_bytes,
+                  "max_gas": params.block.max_gas},
+        "evidence": {"max_age_num_blocks":
+                     params.evidence.max_age_num_blocks,
+                     "max_age_duration_ns":
+                     params.evidence.max_age_duration_ns,
+                     "max_bytes": params.evidence.max_bytes},
+        "validator": {"pub_key_types": params.validator.pub_key_types},
+        "feature": {"vote_extensions_enable_height":
+                    params.feature.vote_extensions_enable_height,
+                    "pbts_enable_height":
+                    params.feature.pbts_enable_height},
+    }}
+
+
+# ------------------------------------------------------------- consensus
+
+async def consensus_state(env: Environment) -> dict:
+    """Compact round-state view (rpc/core/consensus.go ConsensusState)."""
+    cs = env.node.consensus
+    rs = cs.rs
+    return {"round_state": {
+        "height": rs.height, "round": rs.round, "step": rs.step,
+        "proposal": rs.proposal is not None,
+        "proposal_block": rs.proposal_block is not None,
+        "locked_round": rs.locked_round,
+        "valid_round": rs.valid_round,
+        "fatal_error": repr(cs.fatal_error) if cs.fatal_error else None,
+    }}
+
+
+async def dump_consensus_state(env: Environment) -> dict:
+    cs = env.node.consensus
+    rs = cs.rs
+    out = await consensus_state(env)
+    votes = []
+    if rs.votes is not None:
+        for r in range(rs.round + 1):
+            pv_ = rs.votes.prevotes(r)
+            pc = rs.votes.precommits(r)
+            votes.append({
+                "round": r,
+                "prevotes": str(pv_.bit_array()) if pv_ else None,
+                "precommits": str(pc.bit_array()) if pc else None,
+            })
+        out["round_state"]["height_vote_set"] = votes
+    peers = []
+    if env.node.switch is not None:
+        for p in env.node.switch.peers.values():
+            ps = p.get("cons_peer_state")
+            if ps is not None:
+                peers.append({"node_id": p.id, "height": ps.height,
+                              "round": ps.round, "step": ps.step})
+    out["peers"] = peers
+    return out
+
+
+# --------------------------------------------------------------- mempool
+
+async def unconfirmed_txs(env: Environment, limit=30) -> dict:
+    mp = env.node.mempool
+    txs = mp.contents()[:min(100, int(limit))]
+    return {"n_txs": len(txs), "total": mp.size(),
+            "total_bytes": mp.size_bytes(),
+            "txs": [t.hex() for t in txs]}
+
+
+async def num_unconfirmed_txs(env: Environment) -> dict:
+    mp = env.node.mempool
+    return {"n_txs": mp.size(), "total": mp.size(),
+            "total_bytes": mp.size_bytes()}
+
+
+def _tx_bytes(tx) -> bytes:
+    if isinstance(tx, str):
+        return bytes.fromhex(tx)
+    return bytes(tx)
+
+
+async def broadcast_tx_async(env: Environment, tx=None) -> dict:
+    raw = _tx_bytes(tx)
+
+    async def _fire_and_forget():
+        try:
+            await env.node.mempool.check_tx(raw)
+        except TxRejectedError:
+            pass                 # async mode: rejection is not reported
+
+    asyncio.ensure_future(_fire_and_forget())
+    from ..mempool.mempool import TxKey
+
+    return {"hash": TxKey(raw).hex(), "code": 0}
+
+
+async def broadcast_tx_sync(env: Environment, tx=None) -> dict:
+    """CheckTx ran, result returned (rpc/core/mempool.go)."""
+    raw = _tx_bytes(tx)
+    from ..mempool.mempool import TxKey
+
+    try:
+        await env.node.mempool.check_tx(raw)
+    except TxRejectedError as e:
+        return {"hash": TxKey(raw).hex(), "code": e.code, "log": e.log}
+    return {"hash": TxKey(raw).hex(), "code": 0, "log": ""}
+
+
+async def broadcast_tx_commit(env: Environment, tx=None,
+                              timeout_s: float = 30.0) -> dict:
+    """Submit and wait for the tx to land in a block (rpc/core/mempool.go
+    BroadcastTxCommit; the reference subscribes to EventTx)."""
+    raw = _tx_bytes(tx)
+    from ..mempool.mempool import TxKey
+
+    key = TxKey(raw).hex()
+    sub_id = f"rpc-commit-{key}-{id(raw)}"
+    sub = env.node.event_bus.subscribe(
+        sub_id, {"tm.event": ev.EVENT_TX, ev.TX_HASH_KEY: key})
+    try:
+        try:
+            await env.node.mempool.check_tx(raw)
+        except TxRejectedError as e:
+            return {"hash": key, "check_tx": {"code": e.code, "log": e.log}}
+        msg = await asyncio.wait_for(sub.queue.get(), timeout_s)
+        res = msg.data["result"]
+        return {"hash": key, "check_tx": {"code": 0},
+                "tx_result": {"code": res.code, "log": res.log,
+                              "data": res.data.hex()},
+                "height": msg.data["height"]}
+    except asyncio.TimeoutError:
+        raise RPCError(-32603,
+                       "timed out waiting for tx to be included in a block")
+    finally:
+        env.node.event_bus.unsubscribe(sub_id)
+
+
+# ------------------------------------------------------------------ abci
+
+async def abci_info(env: Environment) -> dict:
+    resp = await env.node.app_conns.query.info()
+    return {"response": {"data": resp.data, "version": resp.version,
+                         "app_version": resp.app_version,
+                         "last_block_height": resp.last_block_height,
+                         "last_block_app_hash":
+                         resp.last_block_app_hash.hex()}}
+
+
+async def abci_query(env: Environment, path="", data=None, height=0,
+                     prove=False) -> dict:
+    raw = _tx_bytes(data) if data else b""
+    resp = await env.node.app_conns.query.query(path, raw, int(height),
+                                                bool(prove))
+    return {"response": {"code": resp.code, "log": resp.log,
+                         "key": resp.key.hex(), "value": resp.value.hex(),
+                         "height": resp.height}}
+
+
+# -------------------------------------------------------------- evidence
+
+async def broadcast_evidence(env: Environment, evidence=None) -> dict:
+    ev_obj = from_jsonable(evidence)
+    try:
+        env.node.evidence_pool.add_evidence(ev_obj)
+    except EvidenceError as e:
+        raise RPCError(-32603, f"invalid evidence: {e}")
+    return {"hash": ev_obj.hash().hex()}
+
+
+# --------------------------------------------------------------- indexer
+
+async def tx(env: Environment, hash=None, prove=False) -> dict:
+    indexer = getattr(env.node, "tx_indexer", None)
+    if indexer is None:
+        raise RPCError(-32603, "transaction indexing is disabled")
+    want = bytes.fromhex(hash) if isinstance(hash, str) else hash
+    res = indexer.get(want)
+    if res is None:
+        raise RPCError(-32603, f"tx {want.hex()} not found")
+    return res
+
+
+async def tx_search(env: Environment, query="", page=1,
+                    per_page=30) -> dict:
+    indexer = getattr(env.node, "tx_indexer", None)
+    if indexer is None:
+        raise RPCError(-32603, "transaction indexing is disabled")
+    return indexer.search(query, int(page), int(per_page))
+
+
+async def block_search(env: Environment, query="", page=1,
+                       per_page=30) -> dict:
+    indexer = getattr(env.node, "block_indexer", None)
+    if indexer is None:
+        raise RPCError(-32603, "block indexing is disabled")
+    return indexer.search(query, int(page), int(per_page))
+
+
+ROUTES = {
+    "health": health,
+    "status": status,
+    "net_info": net_info,
+    "genesis": genesis,
+    "block": block,
+    "block_by_hash": block_by_hash,
+    "header": header,
+    "commit": commit,
+    "blockchain": blockchain,
+    "block_results": block_results,
+    "validators": validators,
+    "consensus_params": consensus_params,
+    "consensus_state": consensus_state,
+    "dump_consensus_state": dump_consensus_state,
+    "unconfirmed_txs": unconfirmed_txs,
+    "num_unconfirmed_txs": num_unconfirmed_txs,
+    "broadcast_tx_async": broadcast_tx_async,
+    "broadcast_tx_sync": broadcast_tx_sync,
+    "broadcast_tx_commit": broadcast_tx_commit,
+    "abci_info": abci_info,
+    "abci_query": abci_query,
+    "broadcast_evidence": broadcast_evidence,
+    "tx": tx,
+    "tx_search": tx_search,
+    "block_search": block_search,
+}

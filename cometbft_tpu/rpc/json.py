@@ -1,0 +1,40 @@
+"""JSON projection of domain objects for the RPC surface.
+
+The reference emits proto-JSON (``rpc/jsonrpc``); this framework's RPC is
+only required to interop with its own clients (SURVEY §7 codec stance), so
+the projection is the storage codec's dict form with bytes rendered as
+hex — stable, self-describing, and round-trippable via ``from_json``."""
+
+from __future__ import annotations
+
+from ..types import codec
+
+
+def jsonable(obj):
+    """codec dict form with bytes -> hex strings (tagged for round-trip)."""
+    return _hexify(codec.to_dict(obj))
+
+
+def from_jsonable(data):
+    """Inverse of :func:`jsonable`."""
+    return codec.from_dict(_unhexify(data))
+
+
+def _hexify(v):
+    if isinstance(v, bytes):
+        return {"~b": v.hex()}
+    if isinstance(v, list):
+        return [_hexify(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _hexify(x) for k, x in v.items()}
+    return v
+
+
+def _unhexify(v):
+    if isinstance(v, dict):
+        if set(v.keys()) == {"~b"}:
+            return bytes.fromhex(v["~b"])
+        return {k: _unhexify(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_unhexify(x) for x in v]
+    return v

@@ -1,0 +1,354 @@
+"""JSON-RPC 2.0 server over HTTP + WebSocket subscriptions (reference:
+``rpc/jsonrpc/server/{http_json_handler,http_uri_handler,ws_handler}.go``,
+``WebsocketManager`` at ``ws_handler.go:32``).
+
+Three access styles, like the reference:
+- POST ``/`` with a JSON-RPC body ``{"jsonrpc":"2.0","id":..,"method":..,
+  "params":{..}}``
+- GET ``/<method>?param=value`` (URI style; ints, ``0x..`` hex and quoted
+  strings are coerced)
+- GET ``/websocket`` upgraded to a WebSocket carrying JSON-RPC frames,
+  where ``subscribe``/``unsubscribe`` manage event-bus subscriptions with
+  the ``tm.event='NewBlock' AND tx.hash='..'`` query syntax
+  (``libs/pubsub/query``), and matching events are pushed as
+  notifications.
+
+The HTTP layer is hand-rolled on asyncio streams — no external web
+framework exists in this image, and the surface needed (HTTP/1.1 POST/GET
++ RFC6455 text frames) is small."""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import struct
+from urllib.parse import parse_qsl, unquote, urlsplit
+
+from .core import ROUTES, Environment, RPCError
+from .json import jsonable
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+MAX_BODY = 10 << 20
+
+
+def parse_query(q: str) -> dict[str, str]:
+    """``tm.event='NewBlock' AND tx.hash='AB12'`` -> dict (the equality
+    subset of libs/pubsub/query — the only part the reference's own event
+    system uses for subscriptions)."""
+    out = {}
+    for clause in q.split(" AND "):
+        clause = clause.strip()
+        if not clause:
+            continue
+        if "=" not in clause:
+            raise RPCError(-32602, f"bad query clause {clause!r}")
+        k, v = clause.split("=", 1)
+        out[k.strip()] = v.strip().strip("'\"")
+    return out
+
+
+def _coerce(v: str):
+    v = unquote(v)
+    if v.startswith('"') and v.endswith('"'):
+        return v[1:-1]
+    if v.startswith("0x"):
+        return bytes.fromhex(v[2:])
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+class RPCServer:
+    def __init__(self, node):
+        self.env = Environment(node)
+        self._server: asyncio.Server | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._ws_counter = 0
+
+    async def listen(self, host: str = "127.0.0.1",
+                     port: int = 0) -> tuple[str, int]:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        addr = self._server.sockets[0].getsockname()
+        return addr[0], addr[1]
+
+    async def close(self) -> None:
+        # cancel every live connection handler: Server.wait_closed() on
+        # 3.12+ waits for them all, and an idle keep-alive client would
+        # otherwise block shutdown forever
+        for t in list(self._conn_tasks):
+            t.cancel()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------- http
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        self._conn_tasks.add(task)
+        try:
+            while True:
+                req_line = await reader.readline()
+                if not req_line:
+                    return
+                try:
+                    method, target, _version = \
+                        req_line.decode().strip().split(" ", 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+
+                if headers.get("upgrade", "").lower() == "websocket":
+                    await self._websocket(reader, writer, headers)
+                    return
+
+                body = b""
+                try:
+                    ln = int(headers.get("content-length", 0))
+                except ValueError:
+                    return          # unparseable framing: drop connection
+                if ln:
+                    if ln > MAX_BODY:
+                        return
+                    body = await reader.readexactly(ln)
+
+                if method == "POST":
+                    resp = await self._handle_jsonrpc_body(body)
+                elif method == "GET":
+                    resp = await self._handle_uri(target)
+                else:
+                    resp = _rpc_error(None, -32600,
+                                      f"unsupported method {method}")
+                raw = json.dumps(resp).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(raw)).encode() +
+                    b"\r\nConnection: keep-alive\r\n\r\n" + raw)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self._conn_tasks.discard(task)
+            writer.close()
+
+    async def _handle_jsonrpc_body(self, body: bytes) -> dict:
+        try:
+            req = json.loads(body)
+        except json.JSONDecodeError as e:
+            return _rpc_error(None, -32700, f"parse error: {e}")
+        return await self._dispatch(req.get("id"), req.get("method", ""),
+                                    req.get("params") or {})
+
+    async def _handle_uri(self, target: str) -> dict:
+        parts = urlsplit(target)
+        method = parts.path.strip("/")
+        if not method:
+            return {"jsonrpc": "2.0", "id": -1,
+                    "result": {"routes": sorted(ROUTES)}}
+        try:
+            params = {k: _coerce(v) for k, v in parse_qsl(parts.query)}
+        except ValueError as e:       # e.g. odd-length 0x hex
+            return _rpc_error(-1, -32602, f"bad parameter: {e}")
+        return await self._dispatch(-1, method, params)
+
+    async def _dispatch(self, rid, method: str, params: dict) -> dict:
+        handler = ROUTES.get(method)
+        if handler is None:
+            return _rpc_error(rid, -32601, f"method {method!r} not found")
+        try:
+            result = await handler(self.env, **params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RPCError as e:
+            return _rpc_error(rid, e.code, e.message, e.data)
+        except TypeError as e:
+            return _rpc_error(rid, -32602, f"invalid params: {e}")
+        except Exception as e:       # noqa: BLE001 — route bugs become errors
+            return _rpc_error(rid, -32603, f"{type(e).__name__}: {e}")
+
+    # -------------------------------------------------------- websocket
+
+    async def _websocket(self, reader, writer, headers) -> None:
+        key = headers.get("sec-websocket-key", "")
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_GUID).encode()).digest()).decode()
+        writer.write((
+            "HTTP/1.1 101 Switching Protocols\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Accept: {accept}\r\n\r\n").encode())
+        await writer.drain()
+        session = _WsSession(self, reader, writer)
+        try:
+            await session.run()
+        finally:
+            session.cleanup()
+
+
+class _WsSession:
+    """One WebSocket connection: JSON-RPC requests in, responses and
+    subscription notifications out (ws_handler.go wsConnection)."""
+
+    def __init__(self, server: RPCServer, reader, writer):
+        self.server = server
+        self.reader = reader
+        self.writer = writer
+        server._ws_counter += 1
+        self.sid = f"ws-{server._ws_counter}"
+        self.subs: dict[str, asyncio.Task] = {}   # query -> pump task
+
+    def cleanup(self) -> None:
+        bus = self.server.env.node.event_bus
+        for query, task in self.subs.items():
+            task.cancel()
+            bus.unsubscribe(f"{self.sid}:{query}")
+        self.subs.clear()
+
+    async def run(self) -> None:
+        try:
+            while True:
+                op, payload = await self._read_frame()
+                if op == 8:                       # close
+                    return
+                if op == 9:                       # ping -> pong
+                    await self._send_frame(10, payload)
+                    continue
+                if op not in (1, 2):
+                    continue
+                try:
+                    req = json.loads(payload)
+                except json.JSONDecodeError:
+                    await self._send_json(_rpc_error(None, -32700,
+                                                     "parse error"))
+                    continue
+                await self._handle(req)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self.writer.close()
+
+    async def _handle(self, req: dict) -> None:
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        if method == "subscribe":
+            await self._subscribe(rid, params.get("query", ""))
+        elif method == "unsubscribe":
+            self._unsubscribe(params.get("query", ""))
+            await self._send_json({"jsonrpc": "2.0", "id": rid,
+                                   "result": {}})
+        elif method == "unsubscribe_all":
+            for q in list(self.subs):
+                self._unsubscribe(q)
+            await self._send_json({"jsonrpc": "2.0", "id": rid,
+                                   "result": {}})
+        else:
+            await self._send_json(await self.server._dispatch(
+                rid, method, params))
+
+    async def _subscribe(self, rid, query: str) -> None:
+        try:
+            qdict = parse_query(query)
+        except RPCError as e:
+            await self._send_json(_rpc_error(rid, e.code, e.message))
+            return
+        if query in self.subs:
+            await self._send_json(_rpc_error(rid, -32603,
+                                             "already subscribed"))
+            return
+        bus = self.server.env.node.event_bus
+        sub = bus.subscribe(f"{self.sid}:{query}", qdict)
+        self.subs[query] = asyncio.create_task(self._pump(query, sub))
+        await self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+
+    def _unsubscribe(self, query: str) -> None:
+        task = self.subs.pop(query, None)
+        if task is not None:
+            task.cancel()
+        self.server.env.node.event_bus.unsubscribe(f"{self.sid}:{query}")
+
+    async def _pump(self, query: str, sub) -> None:
+        """Push matching events as JSON-RPC notifications."""
+        try:
+            while True:
+                msg = await sub.queue.get()
+                await self._send_json({
+                    "jsonrpc": "2.0", "id": None,
+                    "result": {"query": query,
+                               "data": {"type": msg.event_type,
+                                        "value": _event_value(msg)},
+                               "events": msg.attrs}})
+        except (asyncio.CancelledError, ConnectionError):
+            pass
+
+    @staticmethod
+    def _decode_len(b: int) -> int:
+        return b & 0x7F
+
+    async def _read_frame(self) -> tuple[int, bytes]:
+        hdr = await self.reader.readexactly(2)
+        op = hdr[0] & 0x0F
+        masked = hdr[1] & 0x80
+        ln = hdr[1] & 0x7F
+        if ln == 126:
+            (ln,) = struct.unpack(">H", await self.reader.readexactly(2))
+        elif ln == 127:
+            (ln,) = struct.unpack(">Q", await self.reader.readexactly(8))
+        if ln > MAX_BODY:
+            raise ConnectionError(f"oversized ws frame ({ln} bytes)")
+        mask = await self.reader.readexactly(4) if masked else b"\x00" * 4
+        data = bytearray(await self.reader.readexactly(ln))
+        if masked:
+            for i in range(len(data)):
+                data[i] ^= mask[i % 4]
+        return op, bytes(data)
+
+    async def _send_frame(self, op: int, payload: bytes) -> None:
+        ln = len(payload)
+        if ln < 126:
+            hdr = bytes([0x80 | op, ln])
+        elif ln < (1 << 16):
+            hdr = bytes([0x80 | op, 126]) + struct.pack(">H", ln)
+        else:
+            hdr = bytes([0x80 | op, 127]) + struct.pack(">Q", ln)
+        self.writer.write(hdr + payload)
+        await self.writer.drain()
+
+    async def _send_json(self, obj: dict) -> None:
+        await self._send_frame(1, json.dumps(obj).encode())
+
+
+def _event_value(msg):
+    """Project event payloads to JSON-able form."""
+    data = msg.data
+    if isinstance(data, dict):
+        out = {}
+        for k, v in data.items():
+            try:
+                out[k] = jsonable(v)
+            except TypeError:
+                out[k] = repr(v)
+        return out
+    try:
+        return jsonable(data)
+    except TypeError:
+        return repr(data)
+
+
+def _rpc_error(rid, code: int, message: str, data: str = "") -> dict:
+    return {"jsonrpc": "2.0", "id": rid,
+            "error": {"code": code, "message": message, "data": data}}

@@ -85,6 +85,7 @@ def test_fast_sync_over_tcp():
     def cfg():
         c = Config(consensus=test_consensus_config())
         c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
         return c
 
     async def main():

@@ -38,6 +38,7 @@ def _genesis(n: int, chain_id="tcp-net"):
 def _config() -> Config:
     cfg = Config(consensus=make_test_consensus_config())
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
     return cfg
 
 

@@ -1,0 +1,218 @@
+"""RPC surface: JSON-RPC over HTTP, URI-style GET, WebSocket
+subscriptions, driven against a live 4-node TCP testnet (reference:
+``rpc/jsonrpc/jsonrpc_test.go``, ``rpc/core``)."""
+
+import asyncio
+import json
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.config import test_consensus_config as _tcc
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p import NodeKey
+from cometbft_tpu.rpc import HTTPClient, RPCError, WSClient, parse_query
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+
+pytestmark = pytest.mark.timeout(150)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _config() -> Config:
+    cfg = Config(consensus=_tcc())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    return cfg
+
+
+async def _net(n=4):
+    pvs = [MockPV.from_secret(b"rpcnode%d" % i) for i in range(n)]
+    doc = GenesisDoc(chain_id="rpc-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+    nodes = []
+    for i, pv in enumerate(pvs):
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv, config=_config(),
+            node_key=NodeKey.from_secret(b"rk%d" % i), name=f"rpc{i}")
+        nodes.append(node)
+        await node.start()
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            await a.dial_peer(b.listen_addr, persistent=True)
+    return nodes
+
+
+async def _stop(nodes):
+    for n in nodes:
+        try:
+            await n.stop()
+        except Exception:
+            pass
+
+
+def test_query_language_subset():
+    q = parse_query("tm.event='NewBlock' AND tx.hash='AB12'")
+    assert q == {"tm.event": "NewBlock", "tx.hash": "AB12"}
+    with pytest.raises(RPCError):
+        parse_query("junk clause")
+
+
+def test_rpc_full_surface_over_http():
+    async def main():
+        nodes = await _net(4)
+        try:
+            cli = HTTPClient(*nodes[0].rpc_addr)
+
+            # submit a tx and wait until it is committed
+            res = await cli.call("broadcast_tx_commit", tx=b"rk=rv".hex())
+            assert res["tx_result"]["code"] == 0
+            committed_h = res["height"]
+
+            st = await cli.call("status")
+            assert st["sync_info"]["latest_block_height"] >= committed_h
+            assert st["node_info"]["network"] == "rpc-net"
+
+            # health / net_info
+            assert await cli.call("health") == {}
+            ni = await cli.call("net_info")
+            assert ni["n_peers"] == 3
+
+            blk = await cli.call("block", height=committed_h)
+            assert blk["block"]["hdr"]["h"] == committed_h
+            txs = blk["block"]["data"]["txs"]
+            assert {"~b": b"rk=rv".hex()} in txs
+
+            # block_by_hash round-trips
+            bh = blk["block_id"]["hash"]["~b"]
+            blk2 = await cli.call("block_by_hash", hash=bh)
+            assert blk2["block"]["hdr"]["h"] == committed_h
+
+            cm = await cli.call("commit", height=committed_h)
+            assert cm["commit"]["h"] == committed_h
+
+            bi = await cli.call("blockchain")
+            assert bi["last_height"] >= committed_h
+            assert len(bi["block_metas"]) >= 1
+
+            br = await cli.call("block_results", height=committed_h)
+            assert any(r["code"] == 0 for r in br["tx_results"])
+
+            vals = await cli.call("validators")
+            assert vals["total"] == 4 and len(vals["validators"]) == 4
+
+            cp = await cli.call("consensus_params")
+            assert cp["consensus_params"]["validator"]["pub_key_types"]
+
+            cs = await cli.call("consensus_state")
+            assert cs["round_state"]["height"] >= committed_h
+
+            dcs = await cli.call("dump_consensus_state")
+            assert len(dcs["peers"]) == 3
+
+            ab = await cli.call("abci_info")
+            assert ab["response"]["last_block_height"] >= 1
+
+            # kvstore app query for the committed key
+            q = await cli.call("abci_query", path="/key",
+                               data=b"rk".hex())
+            assert bytes.fromhex(q["response"]["value"]) == b"rv"
+
+            gen = await cli.call("genesis")
+            assert gen["genesis"]["chain_id"] == "rpc-net"
+
+            nut = await cli.call("num_unconfirmed_txs")
+            assert nut["n_txs"] >= 0
+
+            # sync-path broadcast
+            r2 = await cli.call("broadcast_tx_sync", tx=b"k2=v2".hex())
+            assert r2["code"] == 0
+
+            # indexing is not enabled on this node: explicit error
+            with pytest.raises(RPCError):
+                await cli.call("tx", hash="00" * 32)
+            with pytest.raises(RPCError):
+                await cli.call("nonexistent_method")
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
+def test_rpc_uri_style_get():
+    async def main():
+        nodes = await _net(4)
+        try:
+            host, port = nodes[0].rpc_addr
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(b"GET /status HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            status_line = await reader.readline()
+            assert b"200" in status_line
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = await reader.readexactly(int(headers["content-length"]))
+            writer.close()
+            resp = json.loads(body)
+            assert resp["result"]["node_info"]["network"] == "rpc-net"
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
+def test_websocket_subscription_streams_blocks():
+    async def main():
+        nodes = await _net(4)
+        try:
+            ws = await WSClient.connect(*nodes[0].rpc_addr)
+            await ws.subscribe("tm.event='NewBlock'")
+            ev1 = await ws.next_event(timeout=30)
+            ev2 = await ws.next_event(timeout=30)
+            h1 = ev1["data"]["value"]["block"]["hdr"]["h"]
+            h2 = ev2["data"]["value"]["block"]["hdr"]["h"]
+            assert h2 == h1 + 1
+            await ws.close()
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
+def test_websocket_tx_subscription():
+    async def main():
+        nodes = await _net(4)
+        try:
+            from cometbft_tpu.mempool.mempool import TxKey
+
+            tx = b"wsk=wsv"
+            key = TxKey(tx).hex()
+            ws = await WSClient.connect(*nodes[1].rpc_addr)
+            await ws.subscribe(f"tm.event='Tx' AND tx.hash='{key}'")
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            await cli.call("broadcast_tx_sync", tx=tx.hex())
+            evt = await ws.next_event(timeout=30)
+            assert evt["events"]["tx.hash"] == key
+            await ws.close()
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
