@@ -1,0 +1,5 @@
+import sys
+
+from .cmd import main
+
+sys.exit(main())

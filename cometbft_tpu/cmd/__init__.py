@@ -1,0 +1,317 @@
+"""Command-line interface (reference: ``cmd/cometbft/main.go:16-46`` and
+``cmd/cometbft/commands/``): init, start, testnet, key tooling, reset and
+rollback — argparse instead of cobra, same command surface.
+
+Run as ``python -m cometbft_tpu <command>``."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import signal
+import sys
+
+VERSION = "0.2.0"        # framework version (version/version.go analogue)
+
+
+# ------------------------------------------------------------ home layout
+
+def _cfg_path(home: str) -> str:
+    return os.path.join(home, "config", "config.toml")
+
+
+def _load_home(home: str):
+    from ..config import Config
+
+    cfg = Config.load(_cfg_path(home))
+    cfg.base.root_dir = home
+    return cfg
+
+
+def _join(home: str, rel: str) -> str:
+    return rel if os.path.isabs(rel) else os.path.join(home, rel)
+
+
+# ---------------------------------------------------------------- commands
+
+def cmd_init(args) -> int:
+    """commands/init.go InitFilesCmd: config + genesis + keys."""
+    from ..config import Config
+    from ..p2p import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+
+    home = args.home
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+
+    cfg = Config()
+    cfg.base.moniker = args.moniker
+    if not os.path.exists(_cfg_path(home)):
+        cfg.save(_cfg_path(home))
+
+    nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
+    pv = FilePV.load_or_generate(
+        _join(home, cfg.base.priv_validator_key_file),
+        _join(home, cfg.base.priv_validator_state_file))
+
+    gen_path = _join(home, cfg.base.genesis_file)
+    if not os.path.exists(gen_path):
+        import time
+
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{nk.id[:6]}",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10,
+                                         cfg.base.moniker)])
+        doc.save(gen_path)
+    print(f"Initialized node in {home} (node id {nk.id})")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """commands/run_node.go: assemble and run the node."""
+    return asyncio.run(_start_async(args))
+
+
+async def _start_async(args) -> int:
+    from ..abci.kvstore import KVStoreApplication
+    from ..node import Node
+    from ..p2p import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc
+
+    home = args.home
+    cfg = _load_home(home)
+    doc = GenesisDoc.load(_join(home, cfg.base.genesis_file))
+    nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
+    pv = FilePV.load_or_generate(
+        _join(home, cfg.base.priv_validator_key_file),
+        _join(home, cfg.base.priv_validator_state_file))
+
+    app = None
+    if cfg.base.abci == "builtin":
+        if cfg.base.proxy_app not in ("kvstore", ""):
+            print(f"unknown builtin app {cfg.base.proxy_app!r}",
+                  file=sys.stderr)
+            return 1
+        app = KVStoreApplication()
+
+    node = await Node.create(doc, app, priv_validator=pv, config=cfg,
+                             node_key=nk, home=home,
+                             fast_sync=cfg.blocksync.enable,
+                             name=cfg.base.moniker)
+    await node.start()
+    print(f"Node {nk.id} started: p2p {node.listen_addr}, "
+          f"rpc {node.rpc_addr}", flush=True)
+
+    async def dial_with_retry(addr: str) -> None:
+        # peers boot in any order: keep trying (switch.go persistent-peer
+        # reconnect semantics for the initial dial)
+        delay = 0.5
+        for _ in range(30):
+            try:
+                await node.dial_peer(addr, persistent=True)
+                return
+            except Exception as e:
+                if "duplicate peer" in str(e):
+                    return          # they dialed us first
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 5.0)
+        print(f"giving up dialing {addr}", file=sys.stderr)
+
+    dial_tasks = [asyncio.create_task(dial_with_retry(a.strip()))
+                  for a in cfg.p2p.persistent_peers.split(",") if a.strip()]
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    print("shutting down...", flush=True)
+    for t in dial_tasks:
+        t.cancel()
+    await node.stop()
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go: N wired node homes under one directory."""
+    from ..config import Config
+    from ..p2p import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+
+    n = args.v
+    base = args.output_dir
+    keys, pvs = [], []
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        cfg = Config()
+        nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
+        pv = FilePV.load_or_generate(
+            _join(home, cfg.base.priv_validator_key_file),
+            _join(home, cfg.base.priv_validator_state_file))
+        keys.append(nk)
+        pvs.append(pv)
+
+    import time
+
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "testnet",
+        genesis_time_ns=time.time_ns(),
+        validators=[GenesisValidator(pv.get_pub_key(), 10, f"node{i}")
+                    for i, pv in enumerate(pvs)])
+
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        cfg = Config()
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.base_port + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.base_port + 2 * i + 1}"
+        cfg.p2p.persistent_peers = ",".join(
+            f"tcp://127.0.0.1:{args.base_port + 2 * j}"
+            for j in range(n) if j != i)
+        cfg.save(_cfg_path(home))
+        doc.save(_join(home, cfg.base.genesis_file))
+    print(f"Generated {n}-node testnet in {base} "
+          f"(ports {args.base_port}..{args.base_port + 2 * n - 1})")
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from ..crypto.keys import Ed25519PrivKey
+
+    sk = Ed25519PrivKey.generate()
+    print(json.dumps({
+        "address": sk.pub_key().address().hex(),
+        "pub_key": sk.pub_key().bytes().hex(),
+        "priv_key": sk.bytes().hex()}, indent=2))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from ..p2p import NodeKey
+
+    path = os.path.join(args.home, "config", "node_key.json")
+    nk = NodeKey.load_or_gen(path)
+    print(nk.id)
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    cfg = _load_home(args.home)
+    from ..p2p import NodeKey
+
+    nk = NodeKey.load(_join(args.home, cfg.base.node_key_file))
+    print(nk.id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    cfg = _load_home(args.home)
+    from ..privval import FilePV
+
+    pv = FilePV.load(_join(args.home, cfg.base.priv_validator_key_file),
+                     _join(args.home, cfg.base.priv_validator_state_file))
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.type(), "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go: wipe data, keep keys; reset signer state."""
+    home = args.home
+    data = os.path.join(home, "data")
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+    os.makedirs(data, exist_ok=True)
+    cfg = _load_home(home)
+    state_file = _join(home, cfg.base.priv_validator_state_file)
+    key_file = _join(home, cfg.base.priv_validator_key_file)
+    if os.path.exists(key_file):
+        from ..privval import FilePV
+
+        pv = FilePV.load(key_file, state_file)
+        pv.height = pv.round = pv.step = 0
+        pv.signature = pv.sign_bytes = pv.ext_signature = b""
+        pv._save_state()
+    print(f"Reset {data} (node + validator keys kept)")
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """commands/rollback.go: undo the latest state transition."""
+    from ..storage import BlockStore, LogDB, StateStore
+    from ..storage.statestore import rollback_state
+
+    home = args.home
+    bs_db = LogDB(os.path.join(home, "data", "blockstore.db"))
+    ss_db = LogDB(os.path.join(home, "data", "state.db"))
+    try:
+        new_state = rollback_state(StateStore(ss_db), BlockStore(bs_db),
+                                   remove_block=args.hard)
+    except Exception as e:
+        print(f"rollback failed: {e}", file=sys.stderr)
+        return 1
+    print(f"Rolled back state to height {new_state.last_block_height} "
+          f"app_hash {new_state.app_hash.hex()}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+# ------------------------------------------------------------------- main
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cometbft_tpu",
+        description="BFT state-machine replication with a TPU-accelerated "
+                    "signature-verification hot path")
+    p.add_argument("--home", default=os.environ.get(
+        "CMTHOME", os.path.expanduser("~/.cometbft_tpu")),
+        help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize config/genesis/keys")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--moniker", default="node")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("testnet", help="generate wired node homes")
+    sp.add_argument("--v", type=int, default=4, help="validator count")
+    sp.add_argument("--output-dir", default="./mytestnet")
+    sp.add_argument("--base-port", type=int, default=26656)
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_testnet)
+
+    for name, fn in (("gen-validator", cmd_gen_validator),
+                     ("gen-node-key", cmd_gen_node_key),
+                     ("show-node-id", cmd_show_node_id),
+                     ("show-validator", cmd_show_validator),
+                     ("unsafe-reset-all", cmd_unsafe_reset_all),
+                     ("version", cmd_version)):
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("rollback", help="undo the latest block state")
+    sp.add_argument("--hard", action="store_true",
+                    help="also remove the block itself")
+    sp.set_defaults(fn=cmd_rollback)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)

@@ -138,3 +138,80 @@ class Config:
     storage: StorageConfig = field(default_factory=StorageConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
+
+    # ------------------------------------------------------- TOML persistence
+    # (reference: config/toml.go — viper-loaded config.toml; here the file
+    # is plain TOML read with the stdlib tomllib and written by a minimal
+    # emitter, since only flat [section] key=value forms are needed)
+
+    def to_toml(self) -> str:
+        import dataclasses
+
+        lines = ["# cometbft_tpu node configuration", ""]
+        for section_name in ("base", "consensus", "mempool", "p2p", "rpc",
+                             "blocksync", "statesync", "storage",
+                             "instrumentation"):
+            section = getattr(self, section_name)
+            lines.append(f"[{section_name}]")
+            for f_ in dataclasses.fields(section):
+                v = getattr(section, f_.name)
+                lines.append(f"{f_.name} = {_toml_value(v)}")
+            lines.append("")
+        return "\n".join(lines)
+
+    def save(self, path: str) -> None:
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            f.write(self.to_toml())
+
+    @classmethod
+    def load(cls, path: str) -> "Config":
+        import tomllib
+
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        cfg = cls()
+        for section_name, values in doc.items():
+            section = getattr(cfg, section_name, None)
+            if section is None:
+                raise ConfigError(f"unknown config section {section_name!r}")
+            for k, v in values.items():
+                if not hasattr(section, k):
+                    raise ConfigError(
+                        f"unknown config key {section_name}.{k}")
+                setattr(section, k, v)
+        cfg.validate()
+        return cfg
+
+    def validate(self) -> None:
+        """Per-section sanity (config/config.go ValidateBasic)."""
+        if self.base.abci not in ("builtin", "socket"):
+            raise ConfigError(f"base.abci must be builtin|socket, "
+                              f"got {self.base.abci!r}")
+        if self.base.signature_backend not in ("auto", "tpu", "jax", "cpu"):
+            raise ConfigError(
+                f"bad base.signature_backend {self.base.signature_backend!r}")
+        for name in ("timeout_propose", "timeout_prevote",
+                     "timeout_precommit", "timeout_commit"):
+            if getattr(self.consensus, name) <= 0:
+                raise ConfigError(f"consensus.{name} must be positive")
+        if self.mempool.size <= 0:
+            raise ConfigError("mempool.size must be positive")
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_value(x) for x in v) + "]"
+    raise ConfigError(f"cannot emit TOML for {type(v).__name__}")

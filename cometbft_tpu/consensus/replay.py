@@ -17,7 +17,7 @@ from __future__ import annotations
 from dataclasses import replace as dc_replace
 
 from ..abci import types as abci
-from ..crypto.keys import Ed25519PubKey
+
 from ..proxy.multi_app_conn import AppConns
 from ..sm.execution import BlockExecutor
 from ..storage.blockstore import BlockStore
@@ -81,7 +81,8 @@ class Handshaker:
 
     async def _init_chain(self, state: State, app_conns: AppConns) -> State:
         """InitChain + genesis-response overrides (replay.go:310)."""
-        vals = [abci.ValidatorUpdate("ed25519", v.pub_key.bytes(), v.power)
+        vals = [abci.ValidatorUpdate(v.pub_key.type(), v.pub_key.bytes(),
+                                     v.power)
                 for v in self.genesis.validators]
         resp = await app_conns.consensus.init_chain(abci.InitChainRequest(
             chain_id=self.genesis.chain_id,
@@ -91,8 +92,12 @@ class Handshaker:
             app_state_bytes=self.genesis.app_state,
             consensus_params=self.genesis.consensus_params))
         if resp.validators:
+            from ..crypto.keys import pub_key_from_type_bytes
+
             new_vals = ValidatorSet(
-                [Validator(Ed25519PubKey(vu.pub_key_bytes), vu.power)
+                [Validator(pub_key_from_type_bytes(vu.pub_key_type,
+                                                   vu.pub_key_bytes),
+                           vu.power)
                  for vu in resp.validators])
             state = dc_replace(
                 state, validators=new_vals,

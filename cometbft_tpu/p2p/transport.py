@@ -61,7 +61,7 @@ class Transport:
     # --------------------------------------------------------------- dial
 
     async def dial(self, addr: str) -> tuple[SecretConnection, NodeInfo]:
-        host, port = addr.rsplit(":", 1)
+        host, port = addr.removeprefix("tcp://").rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
             return await asyncio.wait_for(

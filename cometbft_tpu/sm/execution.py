@@ -9,7 +9,7 @@ from dataclasses import replace
 
 from ..abci import types as abci
 from ..abci.client import ABCIClient
-from ..crypto.keys import Ed25519PubKey
+
 from ..libs.pubsub import EventBus
 from ..mempool.mempool import Mempool
 from ..storage.blockstore import BlockStore

@@ -139,3 +139,16 @@ class BlockStore:
         self._base = retain_height
         self._save_bookkeeping()
         return pruned
+
+    def remove_tip(self) -> None:
+        """Delete the highest block (rollback --hard support; the
+        reference pairs state/rollback.go with store.DeleteLatestBlock)."""
+        if self._height == 0:
+            raise ValueError("empty block store")
+        h = self._height
+        for prefix in (K_BLOCK, K_META, K_COMMIT, K_EXT_COMMIT):
+            self.db.delete(_hkey(prefix, h))
+        self._height = h - 1
+        if self._height < self._base:
+            self._base = self._height
+        self._save_bookkeeping()

@@ -215,3 +215,61 @@ def _params_from_dict(d: dict) -> ConsensusParams:
      p.feature.pbts_enable_height) = d["feature"]
     p.synchrony.precision_ns, p.synchrony.message_delay_ns = d["synchrony"]
     return p
+
+
+def rollback_state(state_store: "StateStore", block_store,
+                   remove_block: bool = False):
+    """Undo the latest state transition (reference: ``state/rollback.go``):
+    reconstruct the post-(h-1) state from the stores — the block at h
+    carries app_hash/last_results_hash as of h-1, and the per-height
+    validator/params records supply the rotated sets — then persist it.
+    The ABCI application must be rolled back to the same height separately
+    (same caveat as the reference's rollback command)."""
+    state = state_store.load()
+    if state is None:
+        raise ValueError("no state to roll back")
+    h = state.last_block_height
+    if h <= 0:
+        raise ValueError("state is at genesis; nothing to roll back")
+    if block_store.height() != h:
+        raise ValueError(
+            f"block store height {block_store.height()} != state height {h}"
+            " (cannot roll back)")
+
+    block = block_store.load_block(h)
+    prev_meta = block_store.load_block_meta(h - 1)
+    vals_h = state_store.load_validators(h)
+    vals_h1 = state_store.load_validators(h + 1)
+    vals_prev = state_store.load_validators(h - 1)
+    params = state_store.load_params(h)
+    if block is None or vals_h is None or vals_h1 is None:
+        raise ValueError(f"missing records to roll back height {h}")
+
+    from dataclasses import replace as _replace
+
+    prev_block = block_store.load_block(h - 1)
+    rolled = _replace(
+        state,
+        last_block_height=h - 1,
+        last_block_id=prev_meta.block_id if prev_meta is not None
+        else type(state.last_block_id)(),
+        last_block_time_ns=prev_block.header.time_ns
+        if prev_block is not None else state.last_block_time_ns,
+        validators=vals_h,
+        next_validators=vals_h1,
+        last_validators=vals_prev if vals_prev is not None else None,
+        # clamp to h+1, not h: the rolled-back state still carries the
+        # next_validators that take effect at h+1 (state/rollback.go)
+        last_height_validators_changed=min(
+            state.last_height_validators_changed, h + 1),
+        consensus_params=params if params is not None
+        else state.consensus_params,
+        last_height_params_changed=min(state.last_height_params_changed,
+                                       h + 1),
+        app_hash=block.header.app_hash,
+        last_results_hash=block.header.last_results_hash,
+    )
+    state_store.save(rolled)
+    if remove_block:
+        block_store.remove_tip()
+    return rolled

@@ -6,7 +6,7 @@ import base64
 import json
 from dataclasses import dataclass, field
 
-from ..crypto.keys import Ed25519PubKey, PubKey
+from ..crypto.keys import PubKey
 from .params import ConsensusParams, default_consensus_params
 from .validator_set import Validator, ValidatorSet
 

@@ -1,0 +1,152 @@
+"""CLI + multi-process e2e: `testnet` generates wired homes, `start` runs
+real node processes, RPC drives them — the reference's e2e tier
+(``test/e2e/README.md``) on one machine, and VERDICT item 9's bar:
+"the tier-2 testnet driven through the CLI + RPC instead of test harness
+internals"."""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.timeout(150)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 28600
+
+
+def _run_cli(*args, home=None):
+    cmd = [sys.executable, "-m", "cometbft_tpu"]
+    if home:
+        cmd += ["--home", home]
+    cmd += list(args)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(cmd, capture_output=True, text=True, env=env,
+                          cwd=REPO, timeout=60)
+
+
+def test_cli_init_and_key_commands(tmp_path):
+    home = str(tmp_path / "node")
+    res = _run_cli("init", "--chain-id", "cli-chain", "--moniker", "m0",
+                   home=home)
+    assert res.returncode == 0, res.stderr
+    assert os.path.exists(f"{home}/config/config.toml")
+    assert os.path.exists(f"{home}/config/genesis.json")
+    assert os.path.exists(f"{home}/config/node_key.json")
+    assert os.path.exists(f"{home}/config/priv_validator_key.json")
+
+    rid = _run_cli("show-node-id", home=home)
+    assert rid.returncode == 0 and len(rid.stdout.strip()) == 40
+
+    rv = _run_cli("show-validator", home=home)
+    assert rv.returncode == 0
+    assert json.loads(rv.stdout)["type"] == "ed25519"
+
+    rgv = _run_cli("gen-validator", home=home)
+    assert rgv.returncode == 0
+    assert "priv_key" in json.loads(rgv.stdout)
+
+    rver = _run_cli("version", home=home)
+    assert rver.returncode == 0 and rver.stdout.strip()
+
+    # config round-trips through the TOML loader
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(f"{home}/config/config.toml")
+    assert cfg.base.moniker == "m0"
+
+    rr = _run_cli("unsafe-reset-all", home=home)
+    assert rr.returncode == 0, rr.stderr
+
+
+def test_cli_testnet_multiprocess_commits_blocks(tmp_path):
+    """4 real OS processes, launched by the CLI, commit blocks; txs and
+    queries flow through RPC only."""
+    base = str(tmp_path / "net")
+    res = _run_cli("testnet", "--v", "4", "--output-dir", base,
+                   "--base-port", str(BASE_PORT), "--chain-id", "proc-net")
+    assert res.returncode == 0, res.stderr
+
+    # shrink consensus timeouts for test speed
+    from cometbft_tpu.config import Config
+
+    for i in range(4):
+        cfgp = f"{base}/node{i}/config/config.toml"
+        cfg = Config.load(cfgp)
+        cfg.consensus.timeout_propose = 300_000_000
+        cfg.consensus.timeout_propose_delta = 100_000_000
+        cfg.consensus.timeout_prevote = 150_000_000
+        cfg.consensus.timeout_prevote_delta = 50_000_000
+        cfg.consensus.timeout_precommit = 150_000_000
+        cfg.consensus.timeout_precommit_delta = 50_000_000
+        cfg.consensus.timeout_commit = 100_000_000
+        cfg.base.signature_backend = "cpu"
+        cfg.save(cfgp)
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    procs = []
+    try:
+        for i in range(4):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu",
+                 "--home", f"{base}/node{i}", "start"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, env=env, cwd=REPO))
+
+        asyncio.run(_drive_rpc())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def _drive_rpc():
+    sys.path.insert(0, REPO)
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+
+    clients = [HTTPClient("127.0.0.1", BASE_PORT + 2 * i + 1)
+               for i in range(4)]
+
+    async def wait_rpc(cli, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                return await cli.call("status")
+            except (OSError, RPCError, asyncio.TimeoutError):
+                await asyncio.sleep(0.3)
+        raise TimeoutError("rpc never came up")
+
+    for cli in clients:
+        await wait_rpc(cli)
+
+    # a tx submitted to node0 must commit (gossip to whoever proposes)
+    res = await clients[0].call("broadcast_tx_commit", tx=b"pk=pv".hex())
+    assert res["tx_result"]["code"] == 0
+    h = res["height"]
+
+    # every node reaches that height and agrees on the block hash
+    hashes = set()
+    for cli in clients:
+        deadline = time.monotonic() + 60
+        while True:
+            st = await cli.call("status")
+            if st["sync_info"]["latest_block_height"] >= h:
+                break
+            assert time.monotonic() < deadline, "node stuck"
+            await asyncio.sleep(0.3)
+        blk = await cli.call("block", height=h)
+        hashes.add(blk["block_id"]["hash"]["~b"])
+    assert len(hashes) == 1, f"fork: {hashes}"
+
+    # the app state is queryable through any node
+    q = await clients[3].call("abci_query", path="/key", data=b"pk".hex())
+    assert bytes.fromhex(q["response"]["value"]) == b"pv"
