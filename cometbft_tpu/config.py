@@ -108,6 +108,11 @@ class StorageConfig:
 
 
 @dataclass
+class TxIndexConfig:
+    indexer: str = "kv"               # kv | null
+
+
+@dataclass
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
@@ -136,6 +141,7 @@ class Config:
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
 
@@ -149,7 +155,7 @@ class Config:
 
         lines = ["# cometbft_tpu node configuration", ""]
         for section_name in ("base", "consensus", "mempool", "p2p", "rpc",
-                             "blocksync", "statesync", "storage",
+                             "blocksync", "statesync", "storage", "tx_index",
                              "instrumentation"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
@@ -199,6 +205,10 @@ class Config:
                 raise ConfigError(f"consensus.{name} must be positive")
         if self.mempool.size <= 0:
             raise ConfigError("mempool.size must be positive")
+        if self.tx_index.indexer not in ("kv", "null"):
+            raise ConfigError(
+                f"tx_index.indexer must be kv|null, "
+                f"got {self.tx_index.indexer!r}")
 
 
 class ConfigError(Exception):

@@ -23,6 +23,8 @@ import time
 from typing import Callable
 
 from ..config import ConsensusConfig
+from ..libs import log as tmlog
+from ..libs import metrics
 from ..libs.pubsub import EventBus
 from ..sm.execution import BlockExecutor
 from ..sm.validation import BlockValidationError
@@ -60,6 +62,19 @@ class ConsensusState:
         self.event_bus = event_bus or block_exec.event_bus
         self.now_ns = now_ns
         self.name = name
+        self.log = tmlog.logger("consensus", node=name)
+        # metrics.gen.go analogues for the consensus subsystem
+        self.m_height = metrics.gauge(
+            "consensus_height", "committed chain height")
+        self.m_rounds = metrics.histogram(
+            "consensus_rounds", "rounds needed per committed height",
+            buckets=(0, 1, 2, 3, 5, 10, 20))
+        self.m_block_interval = metrics.histogram(
+            "consensus_block_interval_seconds",
+            "wall time between commits",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30))
+        self.m_errors = metrics.counter(
+            "consensus_handler_errors_total", "recovered handler errors")
 
         self.rs = RoundState()
         self.state: State | None = None
@@ -144,16 +159,19 @@ class ConsensusState:
                 raise
             except Exception as e:       # recoverable: log and continue
                 import traceback
-                traceback.print_exc()
-                print(f"[{self.name}] consensus error on {kind}: {e!r}")
+
+                self.log.error("consensus handler error", kind=kind,
+                               err=repr(e),
+                               trace=traceback.format_exc(limit=4))
+                self.m_errors.inc()
                 consecutive_errors += 1
                 if consecutive_errors >= self.MAX_CONSECUTIVE_ERRORS:
                     # fatal: stop processing so the failure is observable
                     # (the reference dies and relies on WAL recovery)
                     self.fatal_error = e
                     self.ticker.stop()
-                    print(f"[{self.name}] HALT: {consecutive_errors} "
-                          "consecutive consensus errors")
+                    self.log.error("HALT: consecutive consensus errors",
+                                   count=consecutive_errors)
                     return
 
     async def _handle(self, kind: str, payload, peer: str,
@@ -357,7 +375,7 @@ class ConsensusState:
         except Exception as e:
             # a refusing signer skips the proposal, it does not crash the
             # round (defaultDecideProposal logs and returns on sign error)
-            print(f"[{self.name}] sign_proposal refused: {e!r}")
+            self.log.warn("sign_proposal refused", err=repr(e))
             return
         # own proposal: deliver to self (WAL-synced) + broadcast
         await self._handle("proposal", proposal, "", replay=False)
@@ -644,6 +662,18 @@ class ConsensusState:
             self.state, bid, block, verified=True)
 
         self._update_to_state(new_state)
+        if not self._replaying:       # replayed commits would pollute stats
+            now = self.now_ns()
+            self.m_height.set(height, node=self.name)
+            self.m_rounds.observe(rs.commit_round, node=self.name)
+            last_wall = getattr(self, "_last_commit_wall_ns", 0)
+            if last_wall:
+                self.m_block_interval.observe(
+                    max(now - last_wall, 0) / 1e9, node=self.name)
+            self._last_commit_wall_ns = now
+            self.log.debug("committed block", height=height,
+                           round=rs.commit_round, hash=block.hash(),
+                           n_txs=len(block.data.txs))
         self.decided.set()
         self.decided = asyncio.Event()
         self.decided_height = height
@@ -678,7 +708,7 @@ class ConsensusState:
             # a refusing signer (double-sign protection) must not crash the
             # state machine: skip the vote like the reference (state.go
             # signAddVote logs and returns on sign error)
-            print(f"[{self.name}] sign_vote refused: {e!r}")
+            self.log.warn("sign_vote refused", err=repr(e))
             return
         await self._handle("vote", vote, "", replay=False)
         if not self._replaying:

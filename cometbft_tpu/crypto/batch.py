@@ -66,7 +66,14 @@ class CpuBatchVerifier(BatchVerifier):
         return len(self._items)
 
     def verify(self):
+        import time as _time
+
+        hist, lanes, calls = _metrics()
+        t0 = _time.perf_counter()
         oks = [p.verify_signature(m, s) for p, m, s in self._items]
+        hist.observe(_time.perf_counter() - t0, backend="cpu")
+        lanes.inc(len(oks), route="cpu")
+        calls.inc(backend="cpu")
         return all(oks) and len(oks) > 0, oks
 
 
@@ -142,6 +149,20 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     return np.asarray(fn(*args))[:b]
 
 
+@functools.cache
+def _metrics():
+    """Registered once; cached so the hot verify path pays a dict hit."""
+    from ..libs import metrics as m
+
+    return (
+        m.histogram("crypto_batch_verify_seconds",
+                    "wall time of one BatchVerifier.verify() call"),
+        m.counter("crypto_batch_lanes_total",
+                  "signature lanes verified, by route (device/cpu)"),
+        m.counter("crypto_batch_calls_total", "BatchVerifier.verify calls"),
+    )
+
+
 class TpuBatchVerifier(BatchVerifier):
     """Device-backed batch verifier behind the ``crypto.BatchVerifier`` seam.
 
@@ -164,13 +185,27 @@ class TpuBatchVerifier(BatchVerifier):
         return len(self._items)
 
     def verify(self):
+        import time as _time
+
+        hist, lanes, calls = _metrics()
+        t0 = _time.perf_counter()
+        try:
+            return self._verify()
+        finally:
+            hist.observe(_time.perf_counter() - t0, backend="device")
+            calls.inc(backend="device")
+
+    def _verify(self):
         n = len(self._items)
         if n == 0:
             return False, []
+        _, lanes, _ = _metrics()
         ed_idx = [i for i, (p, _, s) in enumerate(self._items)
                   if p.type() == ED25519_KEY_TYPE and len(s) == 64]
         ed_set = set(ed_idx)
         oks = [False] * n
+        lanes.inc(len(ed_idx), route="device")
+        lanes.inc(n - len(ed_idx), route="cpu")
         for i, (p, m, s) in enumerate(self._items):
             if i not in ed_set:
                 oks[i] = p.verify_signature(m, s)
@@ -196,7 +231,17 @@ class TpuBatchVerifier(BatchVerifier):
 
 
 def _accelerator_device():
-    """First non-CPU jax device, or None (config-free auto-detection)."""
+    """First non-CPU jax device, or None (config-free auto-detection).
+
+    When the environment pins CPU (``JAX_PLATFORMS=cpu``), return None
+    WITHOUT touching jax: backend discovery probes every registered
+    plugin, and on this image a wedged axon relay can make that probe
+    hang forever (the same hazard jaxenv.force_cpu_backend defends
+    against) — a node configured for CPU must never block on it."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return None
     try:
         import jax
 

@@ -1,0 +1,5 @@
+from .block import BlockIndexer
+from .service import IndexerService
+from .tx import TxIndexer
+
+__all__ = ["TxIndexer", "BlockIndexer", "IndexerService"]

@@ -1,0 +1,51 @@
+"""Block indexer (reference: ``state/indexer/block/kv``): postings from
+block-level app events to heights, for ``block_search``."""
+
+from __future__ import annotations
+
+from ..storage.db import KVStore, MemDB
+
+K_HEIGHT = b"bi/"          # K_HEIGHT + height8 -> b"" (block indexed)
+K_ATTR = b"ba/"            # K_ATTR + key + 0 + value + 0 + height8
+
+
+class BlockIndexer:
+    def __init__(self, db: KVStore | None = None):
+        self.db = db or MemDB()
+
+    def index(self, height: int, events: list) -> None:
+        batch = {K_HEIGHT + height.to_bytes(8, "big"): b""}
+        postings = [("block.height", str(height))]
+        for e in events:
+            for a in e.attributes:
+                if getattr(a, "index", True):
+                    postings.append((f"{e.type}.{a.key}", str(a.value)))
+        for k, v in postings:
+            batch[(K_ATTR + k.encode() + b"\x00" + v.encode() + b"\x00"
+                   + height.to_bytes(8, "big"))] = b""
+        self.db.set_batch(batch)
+
+    def has(self, height: int) -> bool:
+        return self.db.get(K_HEIGHT + height.to_bytes(8, "big")) is not None
+
+    def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+        from ..rpc.server import parse_query
+
+        clauses = parse_query(query)
+        clauses.pop("tm.event", None)
+        heights: set[int] | None = None
+        for k, v in clauses.items():
+            prefix = (K_ATTR + k.encode() + b"\x00" + v.encode() + b"\x00")
+            found = {int.from_bytes(key[-8:], "big")
+                     for key, _ in self.db.iterate(prefix,
+                                                   prefix + b"\xff" * 9)}
+            heights = found if heights is None else heights & found
+        if heights is None:
+            heights = {int.from_bytes(k[len(K_HEIGHT):], "big")
+                       for k, _ in self.db.iterate(
+                           K_HEIGHT, K_HEIGHT + b"\xff" * 9)}
+        ordered = sorted(heights)
+        page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
+        start = (page - 1) * per_page
+        return {"heights": ordered[start:start + per_page],
+                "total_count": len(ordered)}

@@ -1,0 +1,92 @@
+"""Transaction indexer (reference: ``state/txindex/kv/kv.go``): primary
+record by tx hash plus secondary postings for every indexed app-event
+attribute, so ``tx_search`` can answer ``app.key='v' AND tx.height=5``."""
+
+from __future__ import annotations
+
+import msgpack
+
+from ..storage.db import KVStore, MemDB
+
+K_TX = b"ti/"              # K_TX + hash -> record
+K_ATTR = b"ta/"            # K_ATTR + key + 0 + value + 0 + height8 + hash
+
+
+class TxIndexer:
+    def __init__(self, db: KVStore | None = None):
+        self.db = db or MemDB()
+
+    def index(self, height: int, idx: int, tx: bytes, result,
+              attrs: dict[str, str]) -> None:
+        from ..mempool.mempool import TxKey
+
+        h = TxKey(tx)
+        record = {
+            "height": height, "index": idx, "tx": tx,
+            "code": result.code, "log": result.log, "data": result.data,
+            "gas_used": result.gas_used,
+            "events": [(e.type, [(a.key, a.value) for a in e.attributes])
+                       for e in result.events],
+        }
+        batch: dict[bytes, bytes] = {K_TX + h: msgpack.packb(
+            record, use_bin_type=True)}
+        # one posting PER OCCURRENCE: repeated attribute keys (two
+        # transfer events with different recipients) must all be findable
+        postings = [(k, v) for k, v in attrs.items()]
+        postings.append(("tx.height", str(height)))
+        for e in result.events:
+            for a in e.attributes:
+                if getattr(a, "index", True):
+                    postings.append((f"{e.type}.{a.key}", str(a.value)))
+        for k, v in postings:
+            batch[_attr_key(k, v, height, h)] = b""
+        self.db.set_batch(batch)
+
+    def get(self, tx_hash: bytes) -> dict | None:
+        raw = self.db.get(K_TX + tx_hash)
+        if raw is None:
+            return None
+        d = msgpack.unpackb(raw, raw=False)
+        return {
+            "hash": tx_hash.hex(), "height": d["height"],
+            "index": d["index"], "tx": d["tx"].hex(),
+            "tx_result": {"code": d["code"], "log": d["log"],
+                          "data": d["data"].hex(),
+                          "gas_used": d["gas_used"]},
+        }
+
+    def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+        """Equality-clause search (the subset the event system itself
+        emits); clauses are intersected."""
+        from ..rpc.server import parse_query
+
+        clauses = parse_query(query)
+        clauses.pop("tm.event", None)        # implied: these are all txs
+        result_hashes: set[bytes] | None = None
+        for k, v in clauses.items():
+            found = set()
+            prefix = _attr_prefix(k, v)
+            for key, _ in self.db.iterate(prefix, prefix + b"\xff" * 9):
+                found.add(key[-32:])
+            result_hashes = found if result_hashes is None \
+                else result_hashes & found
+        if result_hashes is None:
+            result_hashes = {k[len(K_TX):]
+                             for k, _ in self.db.iterate(
+                                 K_TX, K_TX + b"\xff" * 33)}
+        records = sorted(
+            (self.get(h) for h in result_hashes),
+            key=lambda r: (r["height"], r["index"]))
+        page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
+        start = (page - 1) * per_page
+        return {"txs": records[start:start + per_page],
+                "total_count": len(records)}
+
+
+def _attr_key(key: str, value: str, height: int, tx_hash: bytes) -> bytes:
+    return (K_ATTR + key.encode() + b"\x00" + value.encode() + b"\x00"
+            + height.to_bytes(8, "big") + tx_hash)
+
+
+def _attr_prefix(key: str, value: str) -> bytes:
+    return K_ATTR + key.encode() + b"\x00" + value.encode() + b"\x00"

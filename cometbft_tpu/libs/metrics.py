@@ -1,0 +1,209 @@
+"""Metrics: counters, gauges, histograms with labels + Prometheus text
+exposition (reference: ``libs/metrics/metrics.go`` wrapping go-kit, and
+the generated per-subsystem ``metrics.gen.go`` files).
+
+A process-wide default registry keeps wiring cheap: subsystems construct
+their metric sets against it, the RPC server exposes ``GET /metrics``."""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_right
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, "_Metric"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: "_Metric") -> "_Metric":
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def collect(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.TYPE}")
+            out.extend(m.expose())
+        return "\n".join(out) + "\n"
+
+
+DEFAULT = Registry()
+
+
+def _escape(v) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _label_str(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    TYPE = "untyped"
+
+    def __init__(self, name: str, help_: str = "",
+                 registry: Registry | None = None):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+
+    def expose(self) -> list[str]:
+        return []
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self):
+        with self._lock:
+            return [f"{self.name}{_label_str(dict(k))} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def __init__(self, name, help_="", registry=None):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[tuple(sorted(labels.items()))] = float(value)
+
+    def add(self, amount: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def expose(self):
+        with self._lock:
+            return [f"{self.name}{_label_str(dict(k))} {v}"
+                    for k, v in sorted(self._values.items())]
+
+
+DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                   0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    TYPE = "histogram"
+
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS,
+                 registry=None):
+        super().__init__(name, help_)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1))
+            # cumulative-bucket semantics: le is inclusive
+            idx = bisect_right(self.buckets, value)
+            if idx > 0 and self.buckets[idx - 1] == value:
+                idx -= 1
+            counts[min(idx, len(self.buckets))] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        """Context manager measuring seconds."""
+        return _Timer(self, labels)
+
+    def percentile(self, q: float, **labels) -> float:
+        """Approximate percentile from bucket midpoints (tests/metrics)."""
+        key = tuple(sorted(labels.items()))
+        counts = self._counts.get(key)
+        if not counts:
+            return 0.0
+        total = sum(counts)
+        want = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= want:
+                return self.buckets[i] if i < len(self.buckets) \
+                    else self.buckets[-1]
+        return self.buckets[-1]
+
+    def expose(self):
+        out = []
+        with self._lock:
+            for key in sorted(self._counts):
+                labels = dict(key)
+                acc = 0
+                for i, b in enumerate(self.buckets):
+                    acc += self._counts[key][i]
+                    lb = dict(labels, le=str(b))
+                    out.append(f"{self.name}_bucket{_label_str(lb)} {acc}")
+                lb = dict(labels, le="+Inf")
+                out.append(f"{self.name}_bucket{_label_str(lb)} "
+                           f"{self._totals[key]}")
+                out.append(f"{self.name}_sum{_label_str(labels)} "
+                           f"{self._sums[key]}")
+                out.append(f"{self.name}_count{_label_str(labels)} "
+                           f"{self._totals[key]}")
+        return out
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0, **self.labels)
+
+
+def counter(name: str, help_: str = "",
+            registry: Registry | None = None) -> Counter:
+    return (registry or DEFAULT).register(Counter(name, help_))
+
+
+def gauge(name: str, help_: str = "",
+          registry: Registry | None = None) -> Gauge:
+    return (registry or DEFAULT).register(Gauge(name, help_))
+
+
+def histogram(name: str, help_: str = "", buckets=DEFAULT_BUCKETS,
+              registry: Registry | None = None) -> Histogram:
+    return (registry or DEFAULT).register(Histogram(name, help_, buckets))

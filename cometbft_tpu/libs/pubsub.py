@@ -24,6 +24,7 @@ class Message:
 class Subscription:
     query: dict[str, str]                # attr -> required value; "" matches
     queue: asyncio.Queue = field(default_factory=lambda: asyncio.Queue(256))
+    unbuffered: bool = False             # guaranteed delivery (indexer)
 
     def matches(self, msg: Message) -> bool:
         for k, want in self.query.items():
@@ -43,9 +44,14 @@ class EventBus:
     def __init__(self):
         self._subs: dict[str, Subscription] = {}
 
-    def subscribe(self, subscriber: str,
-                  query: dict[str, str]) -> Subscription:
-        sub = Subscription(query)
+    def subscribe(self, subscriber: str, query: dict[str, str],
+                  unbuffered: bool = False) -> Subscription:
+        """``unbuffered=True`` gives an unbounded queue with no drop — for
+        consumers that must see every event (the indexer; the reference's
+        SubscribeUnbuffered in types/event_bus.go)."""
+        sub = Subscription(query, unbuffered=unbuffered)
+        if unbuffered:
+            sub.queue = asyncio.Queue()
         self._subs[subscriber] = sub
         return sub
 
@@ -57,7 +63,7 @@ class EventBus:
         msg = Message(event_type, data, attrs or {})
         for sub in self._subs.values():
             if sub.matches(msg):
-                if sub.queue.full():
+                if not sub.unbuffered and sub.queue.full():
                     try:
                         sub.queue.get_nowait()
                     except asyncio.QueueEmpty:

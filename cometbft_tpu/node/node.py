@@ -61,6 +61,9 @@ class Node:
         self.listen_addr: str | None = None
         self.rpc_server = None
         self.rpc_addr: tuple[str, int] | None = None
+        self.tx_indexer = None
+        self.block_indexer = None
+        self.indexer_service = None
         self.name = "node"
         self._started = False
 
@@ -155,6 +158,20 @@ class Node:
         self.node_key = node_key or NodeKey.generate()
         self.transport = Transport(self.node_key, self._node_info)
         self.switch = Switch(self.transport)
+        if cfg.tx_index.indexer == "kv":
+            from ..indexer import BlockIndexer, IndexerService, TxIndexer
+
+            if home is not None:
+                ti_db = LogDB(os.path.join(home, "data", "tx_index.db"))
+                bi_db = LogDB(os.path.join(home, "data", "block_index.db"))
+            else:
+                ti_db, bi_db = MemDB(), MemDB()
+            self.tx_indexer = TxIndexer(ti_db)
+            self.block_indexer = BlockIndexer(bi_db)
+            self.indexer_service = IndexerService(
+                self.event_bus, self.tx_indexer, self.block_indexer,
+                name=f"{name}.idx")
+
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
@@ -185,6 +202,8 @@ class Node:
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)
         await self.switch.start()
+        if self.indexer_service is not None:
+            await self.indexer_service.start()
         if self.config.rpc.laddr:
             from ..rpc import RPCServer
 
@@ -199,6 +218,8 @@ class Node:
     async def stop(self) -> None:
         if self.rpc_server is not None:
             await self.rpc_server.close()
+        if self.indexer_service is not None:
+            await self.indexer_service.stop()
         if self.blocksync_reactor is not None:
             await self.blocksync_reactor.stop()
         if self.consensus is not None:

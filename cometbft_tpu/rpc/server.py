@@ -124,6 +124,22 @@ class RPCServer:
                         return
                     body = await reader.readexactly(ln)
 
+                if method == "GET" and urlsplit(target).path == "/metrics":
+                    # Prometheus text exposition (the reference serves this
+                    # on the instrumentation port; here it rides the RPC
+                    # listener)
+                    from ..libs import metrics as _metrics
+
+                    text = _metrics.DEFAULT.collect().encode()
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4\r\n"
+                        b"Content-Length: " + str(len(text)).encode() +
+                        b"\r\nConnection: keep-alive\r\n\r\n" + text)
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        return
+                    continue
                 if method == "POST":
                     resp = await self._handle_jsonrpc_body(body)
                 elif method == "GET":

@@ -1,0 +1,201 @@
+"""Observability: metrics registry + exposition, structured logger,
+tx/block indexers and their RPC routes (reference: ``libs/metrics``,
+``libs/log``, ``state/txindex``)."""
+
+import asyncio
+import io
+import json
+
+import pytest
+
+from cometbft_tpu.libs import log as tmlog
+from cometbft_tpu.libs.metrics import Counter, Gauge, Histogram, Registry
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_metrics_counter_gauge_histogram_exposition():
+    reg = Registry()
+    c = reg.register(Counter("test_total", "a counter"))
+    g = reg.register(Gauge("test_gauge", "a gauge"))
+    h = reg.register(Histogram("test_seconds", "a histogram",
+                               buckets=(0.1, 1.0, 10.0)))
+    c.inc()
+    c.inc(2, route="device")
+    g.set(42, node="n0")
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100.0)
+    text = reg.collect()
+    assert "# TYPE test_total counter" in text
+    assert "test_total 1.0" in text
+    assert 'test_total{route="device"} 2.0' in text
+    assert 'test_gauge{node="n0"} 42.0' in text
+    assert 'test_seconds_bucket{le="0.1"} 1' in text
+    assert 'test_seconds_bucket{le="1.0"} 2' in text
+    assert 'test_seconds_bucket{le="+Inf"} 3' in text
+    assert "test_seconds_count 3" in text
+    # registering the same name returns the same instance
+    assert reg.register(Counter("test_total")) is c
+
+
+def test_structured_logger_levels_and_format():
+    buf = io.StringIO()
+    tmlog.set_sink(buf)
+    try:
+        lg = tmlog.logger("testmod", node="n1")
+        tmlog.set_level("testmod", "warn")
+        lg.info("should not appear")
+        lg.warn("warned", height=5)
+        tmlog.set_level("testmod", "debug")
+        lg.debug("now visible")
+        out = buf.getvalue()
+        assert "should not appear" not in out
+        assert "warned" in out and "height=5" in out and "node=n1" in out
+        assert "now visible" in out
+        # json format
+        buf2 = io.StringIO()
+        tmlog.set_sink(buf2)
+        tmlog.set_format("json")
+        lg.error("boom", code=7)
+        rec = json.loads(buf2.getvalue())
+        assert rec["level"] == "error" and rec["code"] == 7
+    finally:
+        tmlog.set_format("plain")
+        tmlog.set_sink(__import__("sys").stderr)
+        tmlog.set_level("testmod", "info")
+
+
+def test_tx_indexer_index_get_search():
+    from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+    from cometbft_tpu.indexer import TxIndexer
+    from cometbft_tpu.mempool.mempool import TxKey
+
+    ti = TxIndexer()
+    res = ExecTxResult(code=0, data=b"ok", log="",
+                       events=[Event("transfer",
+                                     [EventAttribute("sender", "alice")])])
+    ti.index(5, 0, b"tx-one", res, {"tx.hash": TxKey(b"tx-one").hex()})
+    ti.index(6, 0, b"tx-two", ExecTxResult(), {})
+
+    got = ti.get(TxKey(b"tx-one"))
+    assert got["height"] == 5 and bytes.fromhex(got["tx"]) == b"tx-one"
+
+    r = ti.search("transfer.sender='alice'")
+    assert r["total_count"] == 1
+    assert r["txs"][0]["height"] == 5
+
+    r2 = ti.search("tx.height='6'")
+    assert r2["total_count"] == 1 and r2["txs"][0]["height"] == 6
+
+    # intersection of clauses
+    r3 = ti.search("transfer.sender='alice' AND tx.height='6'")
+    assert r3["total_count"] == 0
+
+
+def test_block_indexer_search():
+    from cometbft_tpu.abci.types import Event, EventAttribute
+    from cometbft_tpu.indexer import BlockIndexer
+
+    bi = BlockIndexer()
+    bi.index(3, [Event("epoch", [EventAttribute("id", "9")])])
+    bi.index(4, [])
+    assert bi.has(3) and bi.has(4) and not bi.has(5)
+    assert bi.search("epoch.id='9'")["heights"] == [3]
+    assert bi.search("block.height='4'")["heights"] == [4]
+
+
+def test_node_indexes_and_serves_tx_routes():
+    """Live node: a committed tx becomes queryable via tx / tx_search /
+    block_search, and /metrics exposes consensus gauges."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.config import test_consensus_config as tcc
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    def cfg():
+        c = Config(consensus=tcc())
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        return c
+
+    async def main():
+        pvs = [MockPV.from_secret(b"obs%d" % i) for i in range(4)]
+        doc = GenesisDoc(chain_id="obs-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            n = await Node.create(doc, KVStoreApplication(),
+                                  priv_validator=pv, config=cfg(),
+                                  node_key=NodeKey.from_secret(b"ok%d" % i),
+                                  name=f"obs{i}")
+            nodes.append(n)
+            await n.start()
+        for i, a in enumerate(nodes):
+            for b in nodes[i + 1:]:
+                await a.dial_peer(b.listen_addr, persistent=True)
+        try:
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            res = await cli.call("broadcast_tx_commit", tx=b"ik=iv".hex())
+            h = res["height"]
+            txh = res["hash"]
+            # the indexer consumes events asynchronously: poll briefly
+            for _ in range(100):
+                try:
+                    got = await cli.call("tx", hash=txh)
+                    break
+                except Exception:
+                    await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("tx never indexed")
+            assert got["height"] == h
+            sr = await cli.call("tx_search", query=f"tx.height='{h}'")
+            assert sr["total_count"] >= 1
+            br = await cli.call("block_search", query=f"block.height='{h}'")
+            assert h in br["heights"]
+
+            # commit-verification metrics need a block with a last commit
+            while nodes[0].height() < 3:
+                await asyncio.sleep(0.05)
+
+            # /metrics exposition over the RPC port
+            reader, writer = await asyncio.open_connection(
+                *nodes[0].rpc_addr)
+            writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await writer.drain()
+            status = await reader.readline()
+            assert b"200" in status
+            headers = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            text = (await reader.readexactly(
+                int(headers["content-length"]))).decode()
+            writer.close()
+            assert "consensus_height{" in text
+            assert "crypto_batch_verify_seconds" in text
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
