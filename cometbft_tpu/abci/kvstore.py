@@ -144,8 +144,9 @@ class KVStoreApplication(Application):
 
     async def commit(self) -> t.CommitResponse:
         self.snapshots[self.height] = self._serialize_state()
-        # keep only the 4 most recent snapshots
-        for h in sorted(self.snapshots)[:-4]:
+        # retention must outlive a statesyncer's offer->fetch window even
+        # on fast test chains
+        for h in sorted(self.snapshots)[:-16]:
             del self.snapshots[h]
         return t.CommitResponse(retain_height=0)
 

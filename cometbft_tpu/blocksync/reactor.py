@@ -56,6 +56,7 @@ class BlocksyncReactor(Reactor):
         self.pool: BlockPool | None = None
         self._tasks: list[asyncio.Task] = []
         self.synced = asyncio.Event()
+        self.hold = False        # statesync runs first; node releases us
         if not fast_sync:
             self.synced.set()
 
@@ -67,8 +68,13 @@ class BlocksyncReactor(Reactor):
     # ----------------------------------------------------------- lifecycle
 
     async def start(self) -> None:
-        if not self.fast_sync:
+        if not self.fast_sync or self.hold:
             return
+        await self.start_sync()
+
+    async def start_sync(self) -> None:
+        """Launch the pool + apply loop (deferred when statesync runs
+        first — reference node startup order statesync -> blocksync)."""
         self.pool = BlockPool(
             self.block_store.height() + 1
             if self.block_store.height() else self.state.initial_height,

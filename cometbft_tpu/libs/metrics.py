@@ -57,8 +57,7 @@ def _label_str(labels: dict[str, str]) -> str:
 class _Metric:
     TYPE = "untyped"
 
-    def __init__(self, name: str, help_: str = "",
-                 registry: Registry | None = None):
+    def __init__(self, name: str, help_: str = ""):
         self.name = name
         self.help = help_
         self._lock = threading.Lock()
@@ -70,7 +69,7 @@ class _Metric:
 class Counter(_Metric):
     TYPE = "counter"
 
-    def __init__(self, name, help_="", registry=None):
+    def __init__(self, name, help_=""):
         super().__init__(name, help_)
         self._values: dict[tuple, float] = {}
 
@@ -91,7 +90,7 @@ class Counter(_Metric):
 class Gauge(_Metric):
     TYPE = "gauge"
 
-    def __init__(self, name, help_="", registry=None):
+    def __init__(self, name, help_=""):
         super().__init__(name, help_)
         self._values: dict[tuple, float] = {}
 
@@ -120,8 +119,7 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 class Histogram(_Metric):
     TYPE = "histogram"
 
-    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS,
-                 registry=None):
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_)
         self.buckets = tuple(sorted(buckets))
         self._counts: dict[tuple, list[int]] = {}

@@ -9,6 +9,7 @@ starts services in dependency order) is kept; RPC attaches on top via
 
 from __future__ import annotations
 
+import asyncio
 import os
 
 from ..abci.application import Application
@@ -64,6 +65,10 @@ class Node:
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
+        self.statesync_reactor = None
+        self.syncer = None
+        self.statesync_done = None
+        self.statesync_error = None
         self.name = "node"
         self._started = False
 
@@ -76,10 +81,11 @@ class Node:
                      node_key: NodeKey | None = None,
                      home: str | None = None,
                      fast_sync: bool = False,
+                     state_sync_provider=None,
                      name: str = "node") -> "Node":
         self = cls()
         self.name = name
-        self.fast_sync = fast_sync
+        self.fast_sync = fast_sync or state_sync_provider is not None
         cfg = config or Config(consensus=test_consensus_config())
         self.config = cfg
         self.genesis = genesis_doc
@@ -129,9 +135,15 @@ class Node:
             event_bus=self.event_bus,
             backend=cfg.base.signature_backend)
 
-        state = await Handshaker(
-            self.state_store, self.block_store, genesis_doc).handshake(
-            state, self.app_conns, self.block_exec)
+        self._state_syncing = (state_sync_provider is not None
+                               and self.block_store.height() == 0)
+        if not self._state_syncing:
+            # statesync replaces the handshake: the app gets its state
+            # from the snapshot, not InitChain/replay (node/node.go note
+            # "the Handshaker is not used when state syncing")
+            state = await Handshaker(
+                self.state_store, self.block_store, genesis_doc).handshake(
+                state, self.app_conns, self.block_exec)
 
         self.consensus = ConsensusState(
             cfg.consensus, state, self.block_exec, self.block_store,
@@ -148,12 +160,22 @@ class Node:
 
         self.blocksync_reactor = BlocksyncReactor(
             self.block_exec, self.block_store, state,
-            fast_sync=fast_sync,
+            fast_sync=self.fast_sync,
             switch_to_consensus=self._switch_to_consensus,
             backend=cfg.base.signature_backend,
             name=f"{name}.bs")
-        if fast_sync:
+        if self.fast_sync:
             self.consensus_reactor.wait_sync = True
+
+        from ..statesync import StatesyncReactor, Syncer
+
+        self.statesync_reactor = StatesyncReactor(self.app_conns,
+                                                  name=f"{name}.ss")
+        if self._state_syncing:
+            self.syncer = Syncer(self.app_conns, state_sync_provider,
+                                 reactor=self.statesync_reactor, name=name)
+            self.statesync_reactor.syncer = self.syncer
+            self.blocksync_reactor.hold = True
 
         self.node_key = node_key or NodeKey.generate()
         self.transport = Transport(self.node_key, self._node_info)
@@ -177,7 +199,46 @@ class Node:
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
+        self.switch.add_reactor("statesync", self.statesync_reactor)
         return self
+
+    async def _run_statesync(self) -> None:
+        """node.go OnStart startStateSync: snapshot restore -> bootstrap
+        stores -> hand off to blocksync."""
+        from ..libs import log as tmlog
+
+        lg = tmlog.logger("statesync", node=self.name)
+        try:
+            state, commit = await self.syncer.sync()
+            self.state_store.bootstrap(state)
+            self.block_store.bootstrap_statesync(state.last_block_height,
+                                                 commit)
+            self.evidence_pool.state = state
+            self.blocksync_reactor.state = state
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # fall back to syncing from genesis (InitChain was skipped in
+            # anticipation of the snapshot: run the handshake now).  If
+            # the app already restored part of a snapshot the handshake
+            # itself fails — that is unrecoverable without a reset, but
+            # it must be LOUD, not a silently-dead task.
+            lg.error("statesync failed; falling back to blocksync",
+                     err=repr(e))
+            try:
+                state = State.from_genesis(self.genesis)
+                state = await Handshaker(
+                    self.state_store, self.block_store,
+                    self.genesis).handshake(
+                    state, self.app_conns, self.block_exec)
+                self.blocksync_reactor.state = state
+            except Exception as e2:
+                self.statesync_error = e2
+                lg.error("statesync fallback failed; node needs "
+                         "unsafe-reset-all", err=repr(e2))
+                return
+        self.blocksync_reactor.hold = False
+        await self.blocksync_reactor.start_sync()
 
     async def _switch_to_consensus(self, state) -> None:
         """Blocksync caught up: adopt the synced state and start consensus
@@ -210,12 +271,19 @@ class Node:
             rhost, rport = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server = RPCServer(self)
             self.rpc_addr = await self.rpc_server.listen(rhost, rport)
+        if self.syncer is not None:
+            import asyncio
+
+            self.statesync_done = asyncio.create_task(
+                self._run_statesync())
         if not self.fast_sync:
             # fast-sync defers consensus start to the blocksync handoff
             await self.consensus.start()
         self._started = True
 
     async def stop(self) -> None:
+        if self.statesync_done is not None:
+            self.statesync_done.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.close()
         if self.indexer_service is not None:

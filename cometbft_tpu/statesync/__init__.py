@@ -1,0 +1,6 @@
+from .reactor import CHUNK_CHANNEL, SNAPSHOT_CHANNEL, StatesyncReactor
+from .stateprovider import StateProvider
+from .syncer import StatesyncError, Syncer
+
+__all__ = ["StatesyncReactor", "StateProvider", "Syncer", "StatesyncError",
+           "SNAPSHOT_CHANNEL", "CHUNK_CHANNEL"]

@@ -1,0 +1,97 @@
+"""Statesync reactor: snapshot/chunk exchange over p2p (reference:
+``statesync/reactor.go:66,109,266``; channels 0x60/0x61 from
+``statesync/reactor.go:23-25``).
+
+Serving side answers from the local app's snapshot connection; the
+syncing side accumulates offers/chunks into the Syncer."""
+
+from __future__ import annotations
+
+import asyncio
+
+import msgpack
+
+from ..abci.types import Snapshot
+from ..p2p.reactor import ChannelDescriptor, Reactor
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+
+def _pack(tag: str, **fields) -> bytes:
+    fields["@"] = tag
+    return msgpack.packb(fields, use_bin_type=True)
+
+
+class StatesyncReactor(Reactor):
+    def __init__(self, app_conns, syncer=None, name: str = "ss"):
+        super().__init__()
+        self.app_conns = app_conns
+        self.syncer = syncer          # set when this node is syncing
+        self.name = name
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(SNAPSHOT_CHANNEL, priority=5,
+                              send_queue_capacity=10, name="snapshot"),
+            ChannelDescriptor(CHUNK_CHANNEL, priority=3,
+                              send_queue_capacity=20, name="chunk"),
+        ]
+
+    def add_peer(self, peer) -> None:
+        if self.syncer is not None:
+            peer.send(SNAPSHOT_CHANNEL, _pack("sreq"))
+
+    def remove_peer(self, peer, reason=None) -> None:
+        if self.syncer is not None:
+            self.syncer.remove_peer(peer.id)
+
+    def receive(self, channel_id: int, peer, msg: bytes) -> None:
+        d = msgpack.unpackb(msg, raw=False)
+        tag = d.get("@")
+        if channel_id == SNAPSHOT_CHANNEL:
+            if tag == "sreq":
+                asyncio.ensure_future(self._serve_snapshots(peer))
+            elif tag == "sres" and self.syncer is not None:
+                self.syncer.add_snapshot(peer.id, Snapshot(
+                    height=d["h"], format=d["f"], chunks=d["c"],
+                    hash=d["hash"], metadata=d.get("m", b"")))
+        elif channel_id == CHUNK_CHANNEL:
+            if tag == "creq":
+                asyncio.ensure_future(self._serve_chunk(peer, d))
+            elif tag == "cres" and self.syncer is not None:
+                self.syncer.add_chunk(peer.id, d["h"], d["f"], d["i"],
+                                      d["chunk"], d.get("sh", b""))
+
+    async def _serve_snapshots(self, peer) -> None:
+        """reactor.go Receive(SnapshotRequest) -> recentSnapshots."""
+        try:
+            snaps = await self.app_conns.snapshot.list_snapshots()
+        except Exception:
+            return
+        for s in snaps[-10:]:
+            peer.send(SNAPSHOT_CHANNEL, _pack(
+                "sres", h=s.height, f=s.format, c=s.chunks, hash=s.hash,
+                m=s.metadata))
+
+    async def _serve_chunk(self, peer, d) -> None:
+        try:
+            chunk = await self.app_conns.snapshot.load_snapshot_chunk(
+                d["h"], d["f"], d["i"])
+        except Exception:
+            return
+        peer.send(CHUNK_CHANNEL, _pack(
+            "cres", h=d["h"], f=d["f"], i=d["i"], chunk=chunk,
+            sh=d.get("sh", b"")))
+
+    def request_chunk(self, peer_id: str, height: int, format_: int,
+                      index: int, snapshot_hash: bytes = b"") -> bool:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return False
+        return peer.send(CHUNK_CHANNEL, _pack(
+            "creq", h=height, f=format_, i=index, sh=snapshot_hash))
+
+    def broadcast_snapshot_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(SNAPSHOT_CHANNEL, _pack("sreq"))

@@ -1,0 +1,54 @@
+"""State provider: trusted ``sm.State`` + ``Commit`` for a snapshot height
+via the light client (reference: ``statesync/stateprovider.go:38-79``
+lightClientStateProvider).
+
+The state AFTER block h needs light blocks h, h+1 and h+2: the app hash
+and last-results hash as of h live in header h+1, and the validator sets
+rotate one height ahead (State.validators is the set for the NEXT
+block)."""
+
+from __future__ import annotations
+
+from ..light.client import Client
+from ..storage.statestore import State
+from ..types.commit import Commit
+
+
+class StateProvider:
+    def __init__(self, light_client: Client, genesis_doc):
+        self.client = light_client
+        self.genesis = genesis_doc
+
+    async def app_hash(self, height: int) -> bytes:
+        """App hash AFTER block ``height`` (stateprovider.go AppHash —
+        header at height+1 carries it)."""
+        nxt = await self.client.verify_light_block_at_height(height + 1)
+        return nxt.header.app_hash
+
+    async def commit(self, height: int) -> Commit:
+        lb = await self.client.verify_light_block_at_height(height)
+        return lb.commit
+
+    async def state(self, height: int) -> State:
+        """stateprovider.go State(): assemble the post-``height`` state."""
+        cur = await self.client.verify_light_block_at_height(height)
+        nxt = await self.client.verify_light_block_at_height(height + 1)
+        nxt2 = await self.client.verify_light_block_at_height(height + 2)
+        from ..types.block_id import BlockID
+
+        return State(
+            chain_id=self.genesis.chain_id,
+            initial_height=self.genesis.initial_height,
+            last_block_height=height,
+            last_block_id=BlockID(cur.header.hash(),
+                                  nxt.header.last_block_id.part_set_header),
+            last_block_time_ns=cur.header.time_ns,
+            validators=nxt.validators,
+            next_validators=nxt2.validators,
+            last_validators=cur.validators,
+            last_height_validators_changed=height + 1,
+            consensus_params=self.genesis.consensus_params,
+            last_height_params_changed=self.genesis.initial_height,
+            last_results_hash=nxt.header.last_results_hash,
+            app_hash=nxt.header.app_hash,
+        )

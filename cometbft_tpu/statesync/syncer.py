@@ -1,0 +1,200 @@
+"""Statesync syncer: bootstrap a fresh node from an application snapshot
+instead of replaying the chain (reference: ``statesync/syncer.go:53,144,
+240,321,357`` + ``chunks.go`` + ``snapshots.go``).
+
+Flow (syncer.go SyncAny):
+1. discover snapshots from peers;
+2. verify the snapshot height against the light client (trusted app
+   hash from header h+1) and OfferSnapshot to the local app;
+3. fetch chunks from the peers advertising the snapshot, ApplySnapshotChunk;
+4. ABCI Info must land on (height, app_hash);
+5. bootstrap the state store from the light-client state and record the
+   trusted commit so consensus/blocksync can continue from h."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..abci import types as abci
+from ..libs import log as tmlog
+from .stateprovider import StateProvider
+
+CHUNK_TIMEOUT = 10.0
+DISCOVERY_TIME = 0.5
+
+
+class StatesyncError(Exception):
+    pass
+
+
+class _PendingSnapshot:
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self.peers: list[str] = []
+
+
+class Syncer:
+    def __init__(self, app_conns, state_provider: StateProvider,
+                 reactor=None, name: str = "syncer"):
+        self.app_conns = app_conns
+        self.provider = state_provider
+        self.reactor = reactor
+        self.log = tmlog.logger("statesync", node=name)
+        self._snapshots: dict[tuple, _PendingSnapshot] = {}
+        self._chunks: dict[int, bytes] = {}
+        self._chunk_event = asyncio.Event()
+        self._current = None
+
+    # ------------------------------------------------ reactor callbacks
+
+    def add_snapshot(self, peer_id: str, snapshot) -> None:
+        key = (snapshot.height, snapshot.format, snapshot.hash)
+        pending = self._snapshots.setdefault(key,
+                                             _PendingSnapshot(snapshot))
+        if peer_id not in pending.peers:
+            pending.peers.append(peer_id)
+
+    def add_chunk(self, peer_id: str, height: int, format_: int,
+                  index: int, chunk: bytes, snapshot_hash: bytes = b""
+                  ) -> None:
+        cur = self._current
+        if cur is None or cur.snapshot.height != height or \
+                cur.snapshot.format != format_ or \
+                snapshot_hash != cur.snapshot.hash:
+            return      # stale response from another snapshot: drop
+        self._chunks[index] = chunk
+        self._chunk_event.set()
+
+    def remove_peer(self, peer_id: str) -> None:
+        for pending in self._snapshots.values():
+            if peer_id in pending.peers:
+                pending.peers.remove(peer_id)
+
+    # ------------------------------------------------------------- sync
+
+    async def sync(self, discovery_time: float = DISCOVERY_TIME,
+                   rounds: int = 5):
+        """syncer.go SyncAny: returns (state, commit) for the restored
+        height.  Raises StatesyncError when no snapshot can be restored.
+
+        Discovery repeats per round with a FRESH offer pool: peers prune
+        old snapshots as the chain advances, so offers must be recent
+        relative to the fetch or the chunks will be gone by the time they
+        are requested (the reference's retryHook re-requests snapshots
+        for the same reason)."""
+        for round_ in range(rounds):
+            self._snapshots.clear()
+            if self.reactor is not None:
+                self.reactor.broadcast_snapshot_request()
+            await asyncio.sleep(discovery_time)
+            tried: set = set()
+            while True:
+                best = self._best_snapshot(tried)
+                if best is None:
+                    break                    # pool exhausted: re-discover
+                tried.add((best.snapshot.height, best.snapshot.format,
+                           best.snapshot.hash))
+                try:
+                    return await self._restore(best)
+                except StatesyncError as e:
+                    self.log.warn("snapshot restore failed; trying next",
+                                  height=best.snapshot.height, err=str(e))
+        raise StatesyncError(f"no viable snapshots after {rounds} rounds")
+
+    def _best_snapshot(self, tried: set) -> _PendingSnapshot | None:
+        candidates = [p for k, p in self._snapshots.items()
+                      if k not in tried and p.peers]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda p: p.snapshot.height)
+
+    async def _restore(self, pending: _PendingSnapshot):
+        snapshot = pending.snapshot
+        h = snapshot.height
+        self.log.info("restoring snapshot", height=h,
+                      chunks=snapshot.chunks)
+
+        # trusted app hash from the light client (syncer.go verifyApp prep)
+        try:
+            trusted_app_hash = await self.provider.app_hash(h)
+        except Exception as e:
+            raise StatesyncError(f"cannot verify snapshot height: {e}")
+
+        resp = await self.app_conns.snapshot.offer_snapshot(
+            snapshot, trusted_app_hash)
+        if resp != abci.OFFER_SNAPSHOT_ACCEPT:
+            raise StatesyncError(f"app rejected snapshot ({resp})")
+
+        self._current = pending
+        self._chunks = {}
+        try:
+            await self._fetch_and_apply(pending)
+        finally:
+            self._current = None
+
+        # the app must now report the snapshot height + trusted hash
+        # (syncer.go verifyApp)
+        info = await self.app_conns.query.info()
+        if info.last_block_height != h:
+            raise StatesyncError(
+                f"app restored to height {info.last_block_height}, "
+                f"expected {h}")
+        if info.last_block_app_hash != trusted_app_hash:
+            raise StatesyncError("app hash mismatch after restore")
+
+        state = await self.provider.state(h)
+        commit = await self.provider.commit(h)
+        self.log.info("snapshot restored", height=h)
+        return state, commit
+
+    MAX_CHUNK_RETRIES = 3
+
+    async def _fetch_and_apply(self, pending) -> None:
+        import time as _time
+
+        snapshot = pending.snapshot
+        applied: set[int] = set()
+        requested: dict[int, float] = {}     # chunk -> last request time
+        retries: dict[int, int] = {}
+        next_peer = 0
+        while len(applied) < snapshot.chunks:
+            # request chunks that were never requested or whose request
+            # timed out — NOT everything missing on every wakeup, which
+            # would re-transfer in-flight chunks O(n^2)
+            now = _time.monotonic()
+            for i in range(snapshot.chunks):
+                if i in self._chunks or i in applied:
+                    continue
+                if now - requested.get(i, -1e9) < CHUNK_TIMEOUT / 2:
+                    continue
+                if not pending.peers:
+                    raise StatesyncError("no peers serving the snapshot")
+                peer = pending.peers[next_peer % len(pending.peers)]
+                next_peer += 1
+                requested[i] = now
+                if self.reactor is not None:
+                    self.reactor.request_chunk(peer, snapshot.height,
+                                               snapshot.format, i,
+                                               snapshot.hash)
+            try:
+                await asyncio.wait_for(self._chunk_event.wait(),
+                                       CHUNK_TIMEOUT)
+            except asyncio.TimeoutError:
+                raise StatesyncError("timed out fetching chunks")
+            self._chunk_event.clear()
+
+            for i in sorted(set(self._chunks) - applied):
+                resp = await self.app_conns.snapshot.apply_snapshot_chunk(
+                    i, self._chunks[i], "")
+                if resp == abci.APPLY_CHUNK_ACCEPT:
+                    applied.add(i)
+                elif resp == abci.APPLY_CHUNK_RETRY:
+                    self._chunks.pop(i, None)
+                    requested.pop(i, None)
+                    retries[i] = retries.get(i, 0) + 1
+                    if retries[i] > self.MAX_CHUNK_RETRIES:
+                        raise StatesyncError(
+                            f"chunk {i} refused {retries[i]} times")
+                else:
+                    raise StatesyncError(
+                        f"app aborted on chunk {i} ({resp})")

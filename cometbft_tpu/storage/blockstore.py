@@ -152,3 +152,19 @@ class BlockStore:
         if self._height < self._base:
             self._base = self._height
         self._save_bookkeeping()
+
+    def bootstrap_statesync(self, height: int, seen_commit: Commit) -> None:
+        """Install statesync bookkeeping: the store holds no blocks below
+        ``height`` but knows the trusted commit for it, so consensus can
+        propose at height+1 and blocksync serves nothing older
+        (store/store.go SaveSeenCommit + base/height bootstrap used by
+        statesync)."""
+        if self._height != 0:
+            raise ValueError("cannot bootstrap a non-empty block store")
+        self._base = height
+        self._height = height
+        self.db.set_batch({
+            K_SEEN_COMMIT: codec.pack(seen_commit),
+            K_STATE: msgpack.packb({"base": self._base,
+                                    "height": self._height}),
+        })
