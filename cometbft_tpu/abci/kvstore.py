@@ -201,7 +201,9 @@ class KVStoreApplication(Application):
             raw = b"".join(self._restore_chunks[i]
                            for i in range(self._restoring.chunks))
             if hashlib.sha256(raw).digest() != self._restoring.hash:
-                self._restoring = None
+                # keep _restoring: the syncer refetches and re-applies —
+                # dropping it here would turn the retry into an abort
+                self._restore_chunks = {}
                 return t.APPLY_CHUNK_RETRY
             d = msgpack.unpackb(raw, raw=False)
             self.state = dict(d["state"])

@@ -129,6 +129,11 @@ class BaseConfig:
     abci: str = "builtin"             # builtin | socket
     proxy_app: str = "kvstore"
     signature_backend: str = "auto"   # auto | tpu | jax | cpu  <- TPU seam
+    # batches below this verify on CPU even with a device (dispatch
+    # latency dominates tiny batches); device warmup pre-compiles the
+    # hot bucket shapes at node start
+    min_device_lanes: int = 64
+    device_warmup: bool = True
 
 
 @dataclass

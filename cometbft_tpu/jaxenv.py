@@ -32,6 +32,29 @@ def enable_compile_cache(cache_dir: str | None = None,
                       min_compile_secs)
 
 
+def harden_cpu_pinned_env() -> None:
+    """If the process is pinned to CPU (``JAX_PLATFORMS=cpu``), deregister
+    the accelerator backend factories before first init: with the axon
+    relay wedged, even CPU-pinned backend discovery can hang while the
+    plugin registers.  No-op when an accelerator is wanted or a backend
+    already initialized."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        return
+    try:
+        import jax
+        from jax._src import xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            return               # too late; whatever happened happened
+        # the env var alone is not enough: the accelerator site hooks can
+        # pin jax_platforms via config, which overrides the environment
+        jax.config.update("jax_platforms", "cpu")
+        _xb._backend_factories.pop("axon", None)
+        _xb._backend_factories.pop("tpu", None)
+    except Exception:
+        pass
+
+
 def force_cpu_backend(min_devices: int | None = None) -> None:
     """Force jax onto the CPU backend, optionally with >= min_devices
     virtual devices, before any backend init.

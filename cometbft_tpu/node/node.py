@@ -271,9 +271,26 @@ class Node:
             rhost, rport = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server = RPCServer(self)
             self.rpc_addr = await self.rpc_server.listen(rhost, rport)
-        if self.syncer is not None:
-            import asyncio
+        from ..crypto import batch as cryptobatch
 
+        cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
+        if self.config.base.device_warmup and \
+                self.config.base.signature_backend in ("tpu", "jax",
+                                                       "auto"):
+            # pre-compile hot bucket shapes off the event loop so the
+            # first commit verification doesn't stall consensus; under
+            # "auto" the device probe itself runs in the executor too
+            # (it may block on accelerator discovery)
+            backend = self.config.base.signature_backend
+
+            def _warm():
+                if backend == "auto" and \
+                        cryptobatch._accelerator_device() is None:
+                    return          # CPU-only: nothing to pre-compile
+                cryptobatch.warmup_device()
+
+            asyncio.get_running_loop().run_in_executor(None, _warm)
+        if self.syncer is not None:
             self.statesync_done = asyncio.create_task(
                 self._run_statesync())
         if not self.fast_sync:

@@ -142,8 +142,14 @@ class Syncer:
         if info.last_block_app_hash != trusted_app_hash:
             raise StatesyncError("app hash mismatch after restore")
 
-        state = await self.provider.state(h)
-        commit = await self.provider.commit(h)
+        try:
+            state = await self.provider.state(h)
+            commit = await self.provider.commit(h)
+        except Exception as e:
+            # e.g. the chain hasn't reached h+2 yet so the light client
+            # cannot assemble the post-h state: a retryable condition,
+            # not a fatal one
+            raise StatesyncError(f"cannot build state at {h}: {e}")
         self.log.info("snapshot restored", height=h)
         return state, commit
 
@@ -183,18 +189,26 @@ class Syncer:
                 raise StatesyncError("timed out fetching chunks")
             self._chunk_event.clear()
 
-            for i in sorted(set(self._chunks) - applied):
+            # apply in STRICT index order (the ABCI restore contract —
+            # reference chunks.Next() blocks for the next sequential
+            # index); later chunks wait in self._chunks until their turn
+            while len(applied) in self._chunks:
+                i = len(applied)
                 resp = await self.app_conns.snapshot.apply_snapshot_chunk(
                     i, self._chunks[i], "")
                 if resp == abci.APPLY_CHUNK_ACCEPT:
                     applied.add(i)
                 elif resp == abci.APPLY_CHUNK_RETRY:
-                    self._chunks.pop(i, None)
-                    requested.pop(i, None)
+                    # the app discarded its accumulated restore progress
+                    # (e.g. whole-snapshot hash mismatch): refetch all
+                    applied.clear()
+                    self._chunks.clear()
+                    requested.clear()
                     retries[i] = retries.get(i, 0) + 1
                     if retries[i] > self.MAX_CHUNK_RETRIES:
                         raise StatesyncError(
                             f"chunk {i} refused {retries[i]} times")
+                    break
                 else:
                     raise StatesyncError(
                         f"app aborted on chunk {i} ({resp})")
