@@ -76,6 +76,7 @@ class P2PConfig:
     send_rate: int = 5 * 1024 * 1024
     recv_rate: int = 5 * 1024 * 1024
     pex: bool = True
+    pex_interval_seconds: float = 30.0     # ensurePeersPeriod
     addr_book_path: str = "config/addrbook.json"
 
 

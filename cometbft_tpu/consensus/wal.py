@@ -1,11 +1,18 @@
-"""Consensus write-ahead log (reference: ``internal/consensus/wal.go``).
+"""Consensus write-ahead log (reference: ``internal/consensus/wal.go`` on
+top of ``internal/autofile/group.go`` rotating file groups).
 
 Every message (peer msg, own msg, timeout) is logged *before* processing;
 own votes/proposals are fsync'd before they can be sent (the double-sign
 safety argument, ``internal/consensus/state.go:843``).  Records are
 ``crc32(body) | len | body`` with msgpack bodies; a height sentinel
 (``EndHeightMessage``, wal.go:43) marks each committed height so replay
-starts after the last one.  Torn tails are truncated on open."""
+starts after the last one.
+
+Like the reference's autofile group, the log rotates into fixed-size
+segments (``<path>``, ``<path>.001``, ``<path>.002`` ...) so one
+long-running validator never grows a single unbounded file, and segments
+wholly behind the latest EndHeight sentinel are pruned (group head
+checkpointing).  Torn tails are truncated on open."""
 
 from __future__ import annotations
 
@@ -16,7 +23,8 @@ import zlib
 import msgpack
 
 _HDR = struct.Struct("<II")
-MAX_BODY = 1 << 20          # 1 MB cap, like the reference's maxMsgSizeBytes
+MAX_BODY = 1 << 20            # 1 MB cap, like the reference's maxMsgSizeBytes
+DEFAULT_SEGMENT_BYTES = 4 << 20
 
 
 class WALError(Exception):
@@ -24,16 +32,62 @@ class WALError(Exception):
 
 
 class WAL:
-    def __init__(self, path: str):
+    def __init__(self, path: str,
+                 max_segment_bytes: int = DEFAULT_SEGMENT_BYTES):
         self.path = path
+        self.max_segment_bytes = max_segment_bytes
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._truncate_torn_tail()
-        self._f = open(path, "ab")
+        segs = self._segments()
+        if not segs:
+            segs = [path]
+        self._truncate_torn_tail(segs[-1])
+        self._cur_path = segs[-1]
+        self._f = open(self._cur_path, "ab")
+        # segment holding the PREVIOUS EndHeight sentinel: the safe prune
+        # boundary (see prune note below).  Unknown after reopen -> prune
+        # nothing until two sentinels have been written in this process.
+        self._prev_sentinel_seg: str | None = None
 
-    def _truncate_torn_tail(self) -> None:
-        if not os.path.exists(self.path):
+    # ------------------------------------------------------------ segments
+
+    def _segments(self) -> list[str]:
+        """Existing segment paths in write order (directory scan: pruning
+        may leave index gaps)."""
+        d = os.path.dirname(self.path) or "."
+        base = os.path.basename(self.path)
+        found = []          # (index, path); the bare path is index 0
+        try:
+            names = os.listdir(d)
+        except OSError:
+            names = []
+        for name in names:
+            if name == base:
+                found.append((0, self.path))
+            elif name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    found.append((int(suffix), os.path.join(d, name)))
+        return [p for _, p in sorted(found)]
+
+    def _next_segment_path(self) -> str:
+        segs = self._segments()
+        if not segs or segs[-1] == self.path:
+            return f"{self.path}.001"
+        idx = int(segs[-1].rsplit(".", 1)[1])
+        return f"{self.path}.{idx + 1:03d}"
+
+    def _maybe_rotate(self) -> None:
+        if self._f.tell() < self.max_segment_bytes:
             return
-        with open(self.path, "rb") as f:
+        self.flush_and_sync()
+        self._f.close()
+        self._cur_path = self._next_segment_path()
+        self._f = open(self._cur_path, "ab")
+
+    def _truncate_torn_tail(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
             raw = f.read()
         off = 0
         good = 0
@@ -46,14 +100,44 @@ class WAL:
                 break
             off = good = end
         if good < len(raw):
-            with open(self.path, "r+b") as f:
+            with open(path, "r+b") as f:
                 f.truncate(good)
+
+    def prune_completed_segments(self) -> int:
+        """Drop whole segments strictly older than the segment holding the
+        PREVIOUS EndHeight sentinel (autofile group head checkpointing).
+
+        The previous sentinel — not the latest — is the safe boundary:
+        the latest EndHeight(h) is written BEFORE the state for h is
+        persisted (state.go:1899 ordering), so a crash right after it
+        still replays from EndHeight(h-1).  Everything strictly before
+        EndHeight(h-1)'s segment is unreachable by any replay.  Tracked
+        in memory at sentinel-write time, so pruning never re-reads the
+        log (no file scans on the commit path); after a reopen the
+        boundary is unknown and nothing is pruned until two sentinels
+        have been written.  Returns segments removed."""
+        boundary = self._prev_sentinel_seg
+        if boundary is None:
+            return 0
+        segs = self._segments()
+        if boundary not in segs:
+            return 0
+        removed = 0
+        for path in segs:
+            if path == boundary or path == self._cur_path:
+                break
+            os.unlink(path)
+            removed += 1
+        return removed
+
+    # -------------------------------------------------------------- write
 
     def write(self, record: dict) -> None:
         body = msgpack.packb(record, use_bin_type=True)
         if len(body) > MAX_BODY:
             raise WALError(f"record too big: {len(body)}")
         self._f.write(_HDR.pack(zlib.crc32(body), len(body)) + body)
+        self._maybe_rotate()
 
     def write_sync(self, record: dict) -> None:
         self.write(record)
@@ -61,25 +145,56 @@ class WAL:
 
     def write_end_height(self, height: int) -> None:
         """fsync'd height sentinel (wal.go:202 EndHeightMessage)."""
+        sentinel_seg = self._cur_path
         self.write_sync({"#": "endheight", "h": height})
+        try:
+            self.prune_completed_segments()
+        except OSError:
+            pass
+        self._prev_sentinel_seg = sentinel_seg
 
     def flush_and_sync(self) -> None:
         self._f.flush()
         os.fsync(self._f.fileno())
 
-    def iter_records(self):
-        """All intact records from the start (corruption already truncated)."""
-        self.flush_and_sync()
-        with open(self.path, "rb") as f:
-            raw = f.read()
+    # --------------------------------------------------------------- read
+
+    def _iter_segment(self, path: str):
+        """Yields records; final item is the sentinel True when the whole
+        segment decoded cleanly, False when it ended in corruption."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            yield False
+            return
         off = 0
         while off + _HDR.size <= len(raw):
             crc, ln = _HDR.unpack_from(raw, off)
             end = off + _HDR.size + ln
-            if end > len(raw) or zlib.crc32(raw[off + _HDR.size:end]) != crc:
+            if ln > MAX_BODY or end > len(raw) or \
+                    zlib.crc32(raw[off + _HDR.size:end]) != crc:
+                yield off == len(raw)
                 return
             yield msgpack.unpackb(raw[off + _HDR.size:end], raw=False)
             off = end
+        yield True
+
+    def iter_records(self):
+        """All intact records across segments, oldest first.  Stops at the
+        first corruption: continuing into later segments would hand replay
+        a record stream with a silent hole (the single-file WAL's
+        truncate-at-corruption semantics, generalized)."""
+        self.flush_and_sync()
+        for path in self._segments():
+            clean = False
+            for item in self._iter_segment(path):
+                if isinstance(item, bool):
+                    clean = item
+                    break
+                yield item
+            if not clean:
+                return
 
     def records_after_height(self, height: int) -> list[dict]:
         """Records following the EndHeight(h) sentinel for h == height

@@ -23,7 +23,7 @@ from ..consensus.wal import WAL
 from ..libs.pubsub import EventBus
 from ..mempool.clist_mempool import CListMempool
 from ..mempool.reactor import MempoolReactor
-from ..p2p import NodeInfo, NodeKey, Switch, Transport
+from ..p2p import AddrBook, NodeInfo, NodeKey, PexReactor, Switch, Transport
 from ..proxy.multi_app_conn import (AppConns, local_client_creator,
                                     socket_client_creator)
 from ..sm.execution import BlockExecutor
@@ -66,6 +66,9 @@ class Node:
         self.block_indexer = None
         self.indexer_service = None
         self.statesync_reactor = None
+        self.addr_book = None
+        self.pex_reactor = None
+        self.pruner = None
         self.syncer = None
         self.statesync_done = None
         self.statesync_error = None
@@ -129,11 +132,14 @@ class Node:
             block_store=self.block_store,
             backend=cfg.base.signature_backend)
         self.evidence_pool.state = state
+        from ..sm.pruner import Pruner
+
+        self.pruner = Pruner(self.state_store, self.block_store, name=name)
         self.block_exec = BlockExecutor(
             self.state_store, self.block_store, self.app_conns.consensus,
             self.mempool, evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
-            backend=cfg.base.signature_backend)
+            backend=cfg.base.signature_backend, pruner=self.pruner)
 
         self._state_syncing = (state_sync_provider is not None
                                and self.block_store.height() == 0)
@@ -200,6 +206,18 @@ class Node:
         self.switch.add_reactor("blocksync", self.blocksync_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("statesync", self.statesync_reactor)
+        if cfg.p2p.pex:
+            book_path = None
+            if home is not None:
+                book_path = os.path.join(home, cfg.p2p.addr_book_path) \
+                    if not os.path.isabs(cfg.p2p.addr_book_path) \
+                    else cfg.p2p.addr_book_path
+            self.addr_book = AddrBook(book_path)
+            self.pex_reactor = PexReactor(
+                self.addr_book, self.node_key.id,
+                max_outbound=cfg.p2p.max_num_outbound_peers,
+                request_interval=cfg.p2p.pex_interval_seconds)
+            self.switch.add_reactor("pex", self.pex_reactor)
         return self
 
     async def _run_statesync(self) -> None:
@@ -265,6 +283,8 @@ class Node:
         await self.switch.start()
         if self.indexer_service is not None:
             await self.indexer_service.start()
+        if self.pruner is not None:
+            await self.pruner.start()
         if self.config.rpc.laddr:
             from ..rpc import RPCServer
 
@@ -305,6 +325,8 @@ class Node:
             await self.rpc_server.close()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
+        if self.pruner is not None:
+            await self.pruner.stop()
         if self.blocksync_reactor is not None:
             await self.blocksync_reactor.stop()
         if self.consensus is not None:

@@ -6,6 +6,7 @@ an MConnection channel multiplexer, and a Switch owning peers + reactors.
 """
 
 from .key import NodeKey
+from .pex import AddrBook, PexReactor
 from .node_info import NodeInfo
 from .peer import Peer
 from .reactor import ChannelDescriptor, Reactor
@@ -13,4 +14,4 @@ from .switch import Switch
 from .transport import Transport
 
 __all__ = ["NodeKey", "NodeInfo", "Peer", "ChannelDescriptor", "Reactor",
-           "Switch", "Transport"]
+           "Switch", "Transport", "AddrBook", "PexReactor"]

@@ -1,0 +1,190 @@
+"""Peer exchange (PEX) + address book (reference: ``p2p/pex/pex_reactor.go``
+and ``p2p/pex/addrbook.go``; channel 0x00 from ``pex_reactor.go:22``).
+
+The address book persists known ``node_id -> dialable address`` entries as
+JSON (the reference's old/new bucket machinery guards against address
+poisoning at internet scale; this book keeps the same interface —
+add/pick/mark good/bad — with a flat store and ban-on-bad semantics).
+The reactor asks peers for addresses when connectivity is low and dials
+newly learned peers, so a node bootstraps the full mesh from one seed."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+
+import msgpack
+
+from ..libs import log as tmlog
+from .reactor import ChannelDescriptor, Reactor
+
+PEX_CHANNEL = 0x00
+REQUEST_INTERVAL = 30.0          # ensurePeersPeriod (pex_reactor.go)
+MAX_ADDRS_PER_RESPONSE = 32
+MAX_BOOK_SIZE = 1000
+
+
+class AddrBook:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._addrs: dict[str, str] = {}       # node_id -> "host:port"
+        self._banned: set[str] = set()
+        if path and os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+            self._addrs = dict(d.get("addrs", {}))
+            self._banned = set(d.get("banned", []))
+        except (OSError, json.JSONDecodeError):
+            self._addrs = {}
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"addrs": self._addrs,
+                       "banned": sorted(self._banned)}, f, indent=2)
+        os.replace(tmp, self.path)
+
+    def add(self, node_id: str, addr: str, persist: bool = True) -> bool:
+        """``persist=False`` defers the disk write — callers processing a
+        batch (a PEX response) save once at the end, not per address."""
+        if not addr or node_id in self._banned:
+            return False
+        if self._addrs.get(node_id) == addr:
+            return False
+        if node_id not in self._addrs and len(self._addrs) >= MAX_BOOK_SIZE:
+            return False
+        self._addrs[node_id] = addr
+        if persist:
+            self.save()
+        return True
+
+    def mark_bad(self, node_id: str) -> None:
+        """addrbook MarkBad: ban and forget."""
+        self._banned.add(node_id)
+        self._addrs.pop(node_id, None)
+        self.save()
+
+    def pick(self, exclude: set[str], n: int = 1) -> list[tuple[str, str]]:
+        cands = [(i, a) for i, a in self._addrs.items()
+                 if i not in exclude]
+        random.shuffle(cands)
+        return cands[:n]
+
+    def sample(self, n: int = MAX_ADDRS_PER_RESPONSE) -> list[tuple[str, str]]:
+        cands = list(self._addrs.items())
+        random.shuffle(cands)
+        return cands[:n]
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+
+class PexReactor(Reactor):
+    def __init__(self, book: AddrBook, own_id: str,
+                 max_outbound: int = 10,
+                 request_interval: float = REQUEST_INTERVAL):
+        super().__init__()
+        self.book = book
+        self.own_id = own_id
+        self.max_outbound = max_outbound
+        self.request_interval = request_interval
+        self.log = tmlog.logger("pex", node=own_id[:8])
+        self._task: asyncio.Task | None = None
+        self._dialing: set[str] = set()
+        self._requested: set[str] = set()    # peers we asked for addrs
+
+    def get_channels(self):
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10, name="pex")]
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._ensure_peers_routine())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.book.save()
+
+    def add_peer(self, peer) -> None:
+        # learn the peer's self-advertised dial-back address
+        addr = peer.node_info.listen_addr
+        if addr:
+            self.book.add(peer.id, addr)
+
+    def receive(self, channel_id: int, peer, msg: bytes) -> None:
+        d = msgpack.unpackb(msg, raw=False)
+        tag = d.get("@")
+        if tag == "pex_req":
+            peer.send(PEX_CHANNEL, msgpack.packb(
+                {"@": "pex_res",
+                 "addrs": [{"id": i, "addr": a}
+                           for i, a in self.book.sample()]},
+                use_bin_type=True))
+        elif tag == "pex_res":
+            # only accept what we asked for: unsolicited responses are the
+            # address-poisoning vector (pex_reactor.go requestsSent)
+            if peer.id not in self._requested:
+                self.log.debug("unsolicited pex_res dropped",
+                               peer=peer.id[:8])
+                return
+            self._requested.discard(peer.id)
+            changed = False
+            for entry in d.get("addrs", [])[:MAX_ADDRS_PER_RESPONSE]:
+                nid, addr = entry.get("id", ""), entry.get("addr", "")
+                if nid and nid != self.own_id:
+                    changed |= self.book.add(nid, addr, persist=False)
+            if changed:
+                self.book.save()     # one write per response, not per addr
+
+    # ------------------------------------------------------- ensure peers
+
+    async def _ensure_peers_routine(self) -> None:
+        """pex_reactor.go ensurePeersRoutine: keep outbound connectivity
+        up by asking for and dialing new addresses."""
+        while True:
+            await asyncio.sleep(self.request_interval
+                                * (0.75 + 0.5 * random.random()))
+            try:
+                self._ensure_peers()
+            except Exception as e:
+                self.log.warn("ensure peers failed", err=repr(e))
+
+    def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None:
+            return
+        connected = set(sw.peers)
+        outbound = sum(1 for p in sw.peers.values() if p.outbound)
+        if outbound >= self.max_outbound:
+            return
+        # ask a random connected peer for more addresses
+        if sw.peers:
+            peer = random.choice(list(sw.peers.values()))
+            self._requested.add(peer.id)
+            peer.send(PEX_CHANNEL, msgpack.packb({"@": "pex_req"},
+                                                 use_bin_type=True))
+        # dial someone new
+        for nid, addr in self.book.pick(connected | self._dialing
+                                        | {self.own_id},
+                                        n=self.max_outbound - outbound):
+            self._dialing.add(nid)
+            asyncio.ensure_future(self._dial(nid, addr))
+
+    async def _dial(self, nid: str, addr: str) -> None:
+        try:
+            await self.switch.dial_peer(addr)
+            self.log.debug("pex dialed", peer=nid[:8], addr=addr)
+        except Exception as e:
+            if "duplicate peer" not in str(e):
+                self.log.debug("pex dial failed", addr=addr, err=repr(e))
+        finally:
+            self._dialing.discard(nid)

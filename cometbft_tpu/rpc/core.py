@@ -381,6 +381,31 @@ async def broadcast_evidence(env: Environment, evidence=None) -> dict:
     return {"hash": ev_obj.hash().hex()}
 
 
+# --------------------------------------------------------------- pruning
+
+async def retain_heights(env: Environment) -> dict:
+    """ADR-101 pruning-service introspection."""
+    pruner = env.node.pruner
+    if pruner is None:
+        raise RPCError(-32603, "pruner not running")
+    app, dc = pruner.retain_heights()
+    return {"app_retain_height": app, "data_companion_retain_height": dc,
+            "effective": pruner.effective_retain_height(),
+            "store_base": env.block_store.base()}
+
+
+async def set_companion_retain_height(env: Environment, height=0) -> dict:
+    """ADR-101 data-companion SetBlockRetainHeight."""
+    pruner = env.node.pruner
+    if pruner is None:
+        raise RPCError(-32603, "pruner not running")
+    h = int(height)
+    if h < 0:
+        raise RPCError(-32602, "height must be >= 0")
+    pruner.set_companion_retain_height(h)
+    return {"data_companion_retain_height": h}
+
+
 # --------------------------------------------------------------- indexer
 
 async def tx(env: Environment, hash=None, prove=False) -> dict:
@@ -433,6 +458,8 @@ ROUTES = {
     "abci_info": abci_info,
     "abci_query": abci_query,
     "broadcast_evidence": broadcast_evidence,
+    "retain_heights": retain_heights,
+    "set_companion_retain_height": set_companion_retain_height,
     "tx": tx,
     "tx_search": tx_search,
     "block_search": block_search,

@@ -42,7 +42,7 @@ class BlockExecutor:
     def __init__(self, state_store: StateStore, block_store: BlockStore,
                  app_conn: ABCIClient, mempool: Mempool,
                  evidence_pool=None, event_bus: EventBus | None = None,
-                 backend: str | None = None):
+                 backend: str | None = None, pruner=None):
         self.state_store = state_store
         self.block_store = block_store
         self.app = app_conn
@@ -50,6 +50,7 @@ class BlockExecutor:
         self.evidence_pool = evidence_pool or NopEvidencePool()
         self.event_bus = event_bus or EventBus()
         self.backend = backend
+        self.pruner = pruner
 
     # ----------------------------------------------------------- proposals
 
@@ -159,12 +160,17 @@ class BlockExecutor:
 
         retain = commit_resp.retain_height
         if retain > 0:
-            try:
-                self.block_store.prune_blocks(
-                    min(retain, self.block_store.height()))
-                self.state_store.prune_states(retain)
-            except ValueError:
-                pass
+            if self.pruner is not None:
+                # async: the background pruner honors the companion
+                # retain height too (state/pruner.go)
+                self.pruner.set_app_retain_height(retain)
+            else:
+                try:
+                    self.block_store.prune_blocks(
+                        min(retain, self.block_store.height()))
+                    self.state_store.prune_states(retain)
+                except ValueError:
+                    pass
 
         self._fire_events(block, block_id, resp)
         return new_state

@@ -1,0 +1,88 @@
+"""PEX + address book: peers discovered transitively without direct dials
+(reference: ``p2p/pex/pex_reactor_test.go``, ``addrbook_test.go``)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.config import test_consensus_config as _tcc
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p import AddrBook, NodeKey
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_addr_book_roundtrip(tmp_path):
+    path = str(tmp_path / "addrbook.json")
+    book = AddrBook(path)
+    assert book.add("aa" * 20, "127.0.0.1:1001")
+    assert book.add("bb" * 20, "127.0.0.1:1002")
+    assert not book.add("aa" * 20, "127.0.0.1:1001")     # unchanged
+    book.mark_bad("bb" * 20)
+    assert not book.add("bb" * 20, "127.0.0.1:1002")     # banned stays out
+    book2 = AddrBook(path)
+    assert book2.size() == 1
+    assert book2.pick(set())[0][0] == "aa" * 20
+    assert book2.pick({"aa" * 20}) == []
+
+
+def test_pex_discovers_transitive_peer():
+    """A-B and B-C are dialed; PEX must connect A-C without a dial from
+    the test."""
+
+    def cfg():
+        c = Config(consensus=_tcc())
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        c.p2p.pex = True
+        c.p2p.pex_interval_seconds = 0.5       # fast discovery in tests
+        return c
+
+    async def main():
+        pvs = [MockPV.from_secret(b"pex%d" % i) for i in range(3)]
+        doc = GenesisDoc(chain_id="pex-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            n = await Node.create(doc, KVStoreApplication(),
+                                  priv_validator=pv, config=cfg(),
+                                  node_key=NodeKey.from_secret(b"pk%d" % i),
+                                  name=f"pex{i}")
+            nodes.append(n)
+            await n.start()
+        a, b, c = nodes
+        try:
+            await a.dial_peer(b.listen_addr, persistent=True)
+            await b.dial_peer(c.listen_addr, persistent=True)
+            assert c.node_key.id not in a.switch.peers
+
+            async def connected():
+                while c.node_key.id not in a.switch.peers:
+                    await asyncio.sleep(0.05)
+
+            await asyncio.wait_for(connected(), 30)
+            # and the address book learned it
+            assert any(nid == c.node_key.id
+                       for nid, _ in a.addr_book.sample(100))
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
