@@ -247,12 +247,15 @@ def cmd_unsafe_reset_all(args) -> int:
 
 def cmd_rollback(args) -> int:
     """commands/rollback.go: undo the latest state transition."""
-    from ..storage import BlockStore, LogDB, StateStore
+    from ..storage import BlockStore, StateStore, open_db
     from ..storage.statestore import rollback_state
 
     home = args.home
-    bs_db = LogDB(os.path.join(home, "data", "blockstore.db"))
-    ss_db = LogDB(os.path.join(home, "data", "state.db"))
+    cfg = _load_home(home)
+    bs_db = open_db(cfg.storage.db_backend,
+                    os.path.join(home, "data", "blockstore.db"))
+    ss_db = open_db(cfg.storage.db_backend,
+                    os.path.join(home, "data", "state.db"))
     try:
         new_state = rollback_state(StateStore(ss_db), BlockStore(bs_db),
                                    remove_block=args.hard)

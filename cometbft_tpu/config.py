@@ -104,7 +104,7 @@ class StateSyncConfig:
 
 @dataclass
 class StorageConfig:
-    db_backend: str = "logdb"
+    db_backend: str = "logdb"         # logdb | native (C++ engine)
     discard_abci_responses: bool = False
 
 
@@ -211,6 +211,10 @@ class Config:
                 raise ConfigError(f"consensus.{name} must be positive")
         if self.mempool.size <= 0:
             raise ConfigError("mempool.size must be positive")
+        if self.storage.db_backend not in ("logdb", "native", "memdb"):
+            raise ConfigError(
+                f"storage.db_backend must be logdb|native|memdb, "
+                f"got {self.storage.db_backend!r}")
         if self.tx_index.indexer not in ("kv", "null"):
             raise ConfigError(
                 f"tx_index.indexer must be kv|null, "

@@ -1,0 +1,42 @@
+"""Native (C++) components, built on demand with the image's g++.
+
+The shared object is rebuilt whenever the source hash changes, so the
+repo never carries binaries and a checkout works on any host with a
+C++17 compiler."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+class NativeBuildError(Exception):
+    pass
+
+
+def _build(src: str, out: str) -> None:
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", out]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+
+
+def lib_path(name: str = "kvstore") -> str:
+    """Path to the built shared object, (re)building if stale."""
+    src = os.path.join(_DIR, f"{name}.cpp")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    out = os.path.join(_DIR, f"_lib{name}.so")
+    stamp = out + ".hash"
+    if os.path.exists(out) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == digest:
+                return out
+    _build(src, out)
+    with open(stamp, "w") as f:
+        f.write(digest)
+    return out

@@ -93,13 +93,21 @@ class Node:
         self.config = cfg
         self.genesis = genesis_doc
 
+        from ..storage import open_db
+
+        def make_db(filename: str):
+            if home is None:
+                return MemDB()
+            return open_db(cfg.storage.db_backend,
+                           os.path.join(home, "data", filename))
+
         if home is not None:
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
-            bs_db = LogDB(os.path.join(home, "data", "blockstore.db"))
-            ss_db = LogDB(os.path.join(home, "data", "state.db"))
             wal = WAL(os.path.join(home, "data", "cs.wal"))
         else:
-            bs_db, ss_db, wal = MemDB(), MemDB(), None
+            wal = None
+        bs_db = make_db("blockstore.db")
+        ss_db = make_db("state.db")
         self.block_store = BlockStore(bs_db)
         self.state_store = StateStore(ss_db)
 
@@ -123,10 +131,7 @@ class Node:
             max_tx_bytes=cfg.mempool.max_tx_bytes,
             cache_size=cfg.mempool.cache_size,
             keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache)
-        if home is not None:
-            ev_db = LogDB(os.path.join(home, "data", "evidence.db"))
-        else:
-            ev_db = MemDB()
+        ev_db = make_db("evidence.db")
         self.evidence_pool = EvidencePool(
             ev_db, state_store=self.state_store,
             block_store=self.block_store,
@@ -189,13 +194,8 @@ class Node:
         if cfg.tx_index.indexer == "kv":
             from ..indexer import BlockIndexer, IndexerService, TxIndexer
 
-            if home is not None:
-                ti_db = LogDB(os.path.join(home, "data", "tx_index.db"))
-                bi_db = LogDB(os.path.join(home, "data", "block_index.db"))
-            else:
-                ti_db, bi_db = MemDB(), MemDB()
-            self.tx_indexer = TxIndexer(ti_db)
-            self.block_indexer = BlockIndexer(bi_db)
+            self.tx_indexer = TxIndexer(make_db("tx_index.db"))
+            self.block_indexer = BlockIndexer(make_db("block_index.db"))
             self.indexer_service = IndexerService(
                 self.event_bus, self.tx_indexer, self.block_indexer,
                 name=f"{name}.idx")

@@ -207,16 +207,20 @@ class LogDB(KVStore):
 
 
 def open_db(backend: str, path: str | None = None) -> KVStore:
+    """Backend factory — the one dispatch point (config storage.db_backend).
+
+    "native" is the C++ embedded engine (cometbft_tpu/native/kvstore.cpp),
+    file-compatible with "logdb"."""
     if backend == "memdb":
         return MemDB()
     if backend == "logdb":
         if not path:
             raise ValueError("logdb requires a path")
         return LogDB(path)
-    if backend == "cppdb":
-        from .cppdb import CppDB
+    if backend == "native":
+        from .nativedb import NativeDB
 
         if not path:
-            raise ValueError("cppdb requires a path")
-        return CppDB(path)
+            raise ValueError("native requires a path")
+        return NativeDB(path)
     raise ValueError(f"unknown db backend {backend!r}")

@@ -1,0 +1,138 @@
+"""NativeDB: the C++ embedded KV engine behind the KVStore interface
+(SURVEY §2.9-3 — native where the reference's heavy-duty backend is
+native; the engine lives in ``cometbft_tpu/native/kvstore.cpp``).
+
+Same on-disk record format as LogDB, so the two backends are
+file-compatible; the native engine owns the index, the log, fsync
+batching and compaction, and Python talks to it over a ctypes C ABI."""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+
+from ..native import lib_path
+from .db import KVStore
+
+_TOMBSTONE = 0xFFFFFFFF
+_U32 = struct.Struct("<I")
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(lib_path("kvstore"))
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    lib.kv_get.restype = ctypes.c_int
+    lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_uint32,
+                           ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                           ctypes.POINTER(ctypes.c_uint32)]
+    lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    lib.kv_set.restype = ctypes.c_int
+    lib.kv_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_uint32, ctypes.c_char_p,
+                           ctypes.c_uint32]
+    lib.kv_delete.restype = ctypes.c_int
+    lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32]
+    lib.kv_batch.restype = ctypes.c_int
+    lib.kv_batch.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_uint64]
+    lib.kv_iter_new.restype = ctypes.c_void_p
+    lib.kv_iter_new.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32, ctypes.c_char_p,
+                                ctypes.c_uint32]
+    lib.kv_iter_next.restype = ctypes.c_int
+    lib.kv_iter_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.kv_iter_free.argtypes = [ctypes.c_void_p]
+    lib.kv_size.restype = ctypes.c_uint64
+    lib.kv_size.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def _take(lib, ptr, ln) -> bytes:
+    try:
+        return ctypes.string_at(ptr, ln)
+    finally:
+        lib.kv_free(ptr)
+
+
+class NativeDBError(Exception):
+    pass
+
+
+class NativeDB(KVStore):
+    def __init__(self, path: str):
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._lib = _load()
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise NativeDBError(f"cannot open native kv store at {path}")
+
+    def get(self, key: bytes) -> bytes | None:
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_uint32()
+        if self._lib.kv_get(self._h, key, len(key),
+                            ctypes.byref(val), ctypes.byref(vlen)) == 0:
+            return None
+        return _take(self._lib, val, vlen.value)
+
+    def set(self, key: bytes, value: bytes) -> None:
+        if self._lib.kv_set(self._h, key, len(key), value,
+                            len(value)) != 0:
+            raise NativeDBError("set failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.kv_delete(self._h, key, len(key)) != 0:
+            raise NativeDBError("delete failed")
+
+    def set_batch(self, items: dict[bytes, bytes | None]) -> None:
+        parts = []
+        for k, v in items.items():
+            vlen = _TOMBSTONE if v is None else len(v)
+            parts.append(_U32.pack(len(k)) + _U32.pack(vlen) + k
+                         + (v or b""))
+        wire = b"".join(parts)
+        if self._lib.kv_batch(self._h, wire, len(wire)) != 0:
+            raise NativeDBError("batch failed")
+
+    def iterate(self, start: bytes = b"", end: bytes | None = None):
+        it = self._lib.kv_iter_new(self._h, start, len(start),
+                                   end or b"", len(end or b""))
+        try:
+            while True:
+                kp = ctypes.POINTER(ctypes.c_uint8)()
+                vp = ctypes.POINTER(ctypes.c_uint8)()
+                kl = ctypes.c_uint32()
+                vl = ctypes.c_uint32()
+                if self._lib.kv_iter_next(it, ctypes.byref(kp),
+                                          ctypes.byref(kl),
+                                          ctypes.byref(vp),
+                                          ctypes.byref(vl)) == 0:
+                    return
+                yield (_take(self._lib, kp, kl.value),
+                       _take(self._lib, vp, vl.value))
+        finally:
+            self._lib.kv_iter_free(it)
+
+    def size(self) -> int:
+        return int(self._lib.kv_size(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None

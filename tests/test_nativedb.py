@@ -1,0 +1,156 @@
+"""Native C++ KV engine: KVStore-interface conformance, crash safety,
+compaction, LogDB file compatibility, and a live node running on it
+(SURVEY §2.9-3's native storage backend)."""
+
+import asyncio
+import os
+
+import pytest
+
+from cometbft_tpu.storage.db import LogDB
+from cometbft_tpu.storage.nativedb import NativeDB
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def test_basic_ops_and_iteration(tmp_path):
+    db = NativeDB(str(tmp_path / "kv.db"))
+    for i in range(100):
+        db.set(b"k%03d" % i, b"v%d" % i)
+    db.delete(b"k050")
+    assert db.get(b"k000") == b"v0"
+    assert db.get(b"k050") is None
+    assert db.get(b"missing") is None
+    assert db.size() == 99
+    rng = list(db.iterate(b"k048", b"k053"))
+    assert [k for k, _ in rng] == [b"k048", b"k049", b"k051", b"k052"]
+    # open-ended iteration is sorted
+    allk = [k for k, _ in db.iterate()]
+    assert allk == sorted(allk)
+    db.close()
+
+
+def test_batch_is_atomic_and_survives_reopen(tmp_path):
+    path = str(tmp_path / "kv.db")
+    db = NativeDB(path)
+    db.set_batch({b"a": b"1", b"b": b"2", b"c": None})
+    db.set(b"c", b"3")
+    db.set_batch({b"c": None, b"d": b"4"})
+    db.close()
+    db2 = NativeDB(path)
+    assert db2.get(b"a") == b"1" and db2.get(b"b") == b"2"
+    assert db2.get(b"c") is None and db2.get(b"d") == b"4"
+    db2.close()
+
+
+def test_torn_tail_truncated(tmp_path):
+    path = str(tmp_path / "kv.db")
+    db = NativeDB(path)
+    db.set(b"good", b"record")
+    db.close()
+    with open(path, "ab") as f:
+        f.write(b"\xde\xad\xbe\xefgarbage")
+    db2 = NativeDB(path)
+    assert db2.get(b"good") == b"record"
+    assert db2.size() == 1
+    db2.set(b"after", b"crash")
+    db2.close()
+    db3 = NativeDB(path)
+    assert db3.get(b"after") == b"crash"
+    db3.close()
+
+
+def test_compaction_shrinks_log(tmp_path):
+    path = str(tmp_path / "kv.db")
+    db = NativeDB(path)
+    blob = b"x" * 4096
+    for round_ in range(3):
+        for i in range(200):
+            db.set(b"key%03d" % i, blob)
+    size_before_close = os.path.getsize(path)
+    # 3 rounds x 200 x 4k = ~2.4 MB written; live set is ~800 KB, so
+    # compaction must have rewritten the log at least once
+    assert size_before_close < 2 * 200 * (4096 + 32)
+    db.close()
+    db2 = NativeDB(path)
+    assert db2.size() == 200
+    assert db2.get(b"key000") == blob
+    db2.close()
+
+
+def test_file_compatible_with_logdb(tmp_path):
+    path = str(tmp_path / "kv.db")
+    ldb = LogDB(path)
+    ldb.set(b"from", b"python")
+    ldb.set_batch({b"batch": b"write", b"gone": None})
+    ldb.close()
+    ndb = NativeDB(path)
+    assert ndb.get(b"from") == b"python"
+    assert ndb.get(b"batch") == b"write"
+    ndb.set(b"back", b"native")
+    ndb.close()
+    ldb2 = LogDB(path)
+    assert ldb2.get(b"back") == b"native"
+    ldb2.close()
+
+
+def test_node_runs_on_native_backend(tmp_path):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.config import test_consensus_config as _tcc
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    def cfg():
+        c = Config(consensus=_tcc())
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        c.storage.db_backend = "native"
+        return c
+
+    async def main():
+        pvs = [MockPV.from_secret(b"ndb%d" % i) for i in range(3)]
+        doc = GenesisDoc(chain_id="ndb-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            n = await Node.create(doc, KVStoreApplication(),
+                                  priv_validator=pv, config=cfg(),
+                                  node_key=NodeKey.from_secret(b"nk%d" % i),
+                                  home=str(tmp_path / f"n{i}"),
+                                  name=f"ndb{i}")
+            nodes.append(n)
+            await n.start()
+        for i, a in enumerate(nodes):
+            for b in nodes[i + 1:]:
+                await a.dial_peer(b.listen_addr, persistent=True)
+        try:
+            async def reach(h):
+                while not all(n.height() >= h for n in nodes):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(reach(4), 60)
+            hashes = {n.block_store.load_block(3).hash() for n in nodes}
+            assert len(hashes) == 1
+            assert os.path.exists(tmp_path / "n0" / "data" /
+                                  "blockstore.db")
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
