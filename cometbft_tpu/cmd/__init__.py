@@ -267,6 +267,31 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def cmd_inspect(args) -> int:
+    """commands/inspect.go: read-only RPC over a crashed node's data dir."""
+    return asyncio.run(_inspect_async(args))
+
+
+async def _inspect_async(args) -> int:
+    from ..rpc.inspect import run_inspect
+    from ..types.genesis import GenesisDoc
+
+    home = args.home
+    cfg = _load_home(home)
+    doc = GenesisDoc.load(_join(home, cfg.base.genesis_file))
+    host, port = "127.0.0.1", args.port
+    server, addr = await run_inspect(home, cfg, doc, host, port)
+    print(f"Inspect server on {addr[0]}:{addr[1]} (read-only; ctrl-c to "
+          "stop)", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.close()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -307,6 +332,11 @@ def build_parser() -> argparse.ArgumentParser:
                      ("version", cmd_version)):
         sp = sub.add_parser(name)
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("inspect",
+                        help="read-only RPC over the data directory")
+    sp.add_argument("--port", type=int, default=26657)
+    sp.set_defaults(fn=cmd_inspect)
 
     sp = sub.add_parser("rollback", help="undo the latest block state")
     sp.add_argument("--hard", action="store_true",

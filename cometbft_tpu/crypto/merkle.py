@@ -111,3 +111,116 @@ def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
     proofs = [Proof(total=total, index=i, leaf_hash=leaves[i],
                     aunts=paths[i]) for i in range(total)]
     return root, proofs
+
+
+# ------------------------------------------------------------- proof ops
+# (crypto/merkle/proof_op.go + proof_value.go: composable proof chains for
+# multi-store queries — ProofOperators.Verify walks ops leaf-to-root,
+# each op transforming its input into the next layer's expected value)
+
+@dataclass
+class ProofOp:
+    """Serialized proof step (type tag + key + opaque payload)."""
+
+    type: str
+    key: bytes
+    data: bytes
+
+
+class ProofOpError(Exception):
+    pass
+
+
+def kv_leaf(key: bytes, value: bytes) -> bytes:
+    """Leaf encoding for provable KV stores: the KEY is bound into the
+    leaf alongside the value hash (proof_value.go does the same via
+    proto KVPair) — otherwise a prover could relabel any proven value
+    under any key."""
+    return (len(key).to_bytes(4, "big") + key
+            + hashlib.sha256(value).digest())
+
+
+class ValueOp:
+    """Proves (key, value) -> store root: leaf = hash(kv_leaf(key,
+    sha256(value))), then the merkle path in ``proof``
+    (crypto/merkle/proof_value.go)."""
+
+    TYPE = "simple:v"
+
+    def __init__(self, key: bytes, proof: Proof):
+        self.key = key
+        self.proof = proof
+
+    def run(self, args: list[bytes]) -> list[bytes]:
+        if len(args) != 1:
+            raise ProofOpError(f"ValueOp wants 1 arg, got {len(args)}")
+        if leaf_hash(kv_leaf(self.key, args[0])) != self.proof.leaf_hash:
+            raise ProofOpError("key/value does not match proof leaf")
+        root = self.proof.compute_root()
+        if root is None:
+            raise ProofOpError("invalid merkle path")
+        return [root]
+
+    def proof_op(self) -> ProofOp:
+        import msgpack
+
+        return ProofOp(self.TYPE, self.key, msgpack.packb(
+            {"t": self.proof.total, "i": self.proof.index,
+             "l": self.proof.leaf_hash, "a": self.proof.aunts},
+            use_bin_type=True))
+
+    @classmethod
+    def decode(cls, op: ProofOp) -> "ValueOp":
+        import msgpack
+
+        d = msgpack.unpackb(op.data, raw=False)
+        return cls(op.key, Proof(d["t"], d["i"], d["l"], list(d["a"])))
+
+
+_OP_DECODERS = {ValueOp.TYPE: ValueOp.decode}
+
+
+def register_proof_op(type_: str, decoder) -> None:
+    """proof_op.go ProofRuntime.RegisterOpDecoder."""
+    _OP_DECODERS[type_] = decoder
+
+
+class ProofOperators:
+    """Ordered op chain: Verify(root, keypath, value) runs each op over
+    the previous op's output, consuming keypath segments right-to-left
+    (proof_op.go ProofOperators.Verify)."""
+
+    def __init__(self, ops: list):
+        self.ops = ops
+
+    @classmethod
+    def decode(cls, ops: list[ProofOp]) -> "ProofOperators":
+        decoded = []
+        for op in ops:
+            dec = _OP_DECODERS.get(op.type)
+            if dec is None:
+                raise ProofOpError(f"unregistered proof op {op.type!r}")
+            decoded.append(dec(op))
+        return cls(decoded)
+
+    def verify(self, root: bytes, keypath: list[bytes],
+               value: bytes) -> None:
+        """Raises ProofOpError unless the chain proves value@keypath
+        under root."""
+        if not self.ops:
+            raise ProofOpError("empty proof op chain")
+        args = [value]
+        keys = list(keypath)
+        for op in self.ops:
+            if getattr(op, "key", b""):
+                if not keys:
+                    raise ProofOpError("keypath exhausted")
+                if keys[-1] != op.key:
+                    raise ProofOpError(
+                        f"key mismatch: {keys[-1]!r} != {op.key!r}")
+                keys.pop()
+            args = op.run(args)
+        if keys:
+            raise ProofOpError(f"keypath not fully consumed: {keys!r}")
+        if args != [root]:
+            raise ProofOpError("computed root does not match")

@@ -1,0 +1,169 @@
+"""Inspect mode, key armor, merkle proof operators (reference:
+``internal/inspect/inspect_test.go``, ``crypto/armor/armor_test.go``,
+``crypto/merkle/proof_op.go`` tests)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.crypto.armor import (ArmorError, armor_priv_key,
+                                       decode_armor, encode_armor,
+                                       unarmor_priv_key)
+from cometbft_tpu.crypto.merkle import (Proof, ProofOp, ProofOpError,
+                                        ProofOperators, ValueOp, kv_leaf,
+                                        proofs_from_byte_slices)
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------------ armor
+
+def test_armor_roundtrip():
+    data = bytes(range(256)) * 3
+    text = encode_armor("TEST BLOCK", {"Version": "1", "kdf": "none"}, data)
+    assert text.startswith("-----BEGIN TEST BLOCK-----")
+    bt, headers, out = decode_armor(text)
+    assert bt == "TEST BLOCK" and out == data
+    assert headers["Version"] == "1"
+
+
+def test_armor_detects_corruption():
+    text = encode_armor("T", {}, b"payload-bytes-here")
+    # flip a character inside the base64 body
+    lines = text.splitlines()
+    body_idx = next(i for i, ln in enumerate(lines)
+                    if ln and not ln.startswith("-") and ":" not in ln)
+    ch = "A" if lines[body_idx][0] != "A" else "B"
+    lines[body_idx] = ch + lines[body_idx][1:]
+    with pytest.raises(ArmorError):
+        decode_armor("\n".join(lines))
+
+
+def test_priv_key_armor():
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+
+    sk = Ed25519PrivKey.from_secret(b"armored")
+    text = armor_priv_key(sk.bytes(), "ed25519")
+    raw, typ = unarmor_priv_key(text)
+    assert raw == sk.bytes() and typ == "ed25519"
+
+
+# -------------------------------------------------------------- proof ops
+
+def test_value_op_proves_item_under_root():
+    # a provable KV store hashes kv_leaf(key, value) entries
+    kvs = [(b"a-key", b"alpha"), (b"b-key", b"beta"),
+           (b"c-key", b"gamma"), (b"d-key", b"delta")]
+    root, proofs = proofs_from_byte_slices(
+        [kv_leaf(k, v) for k, v in kvs])
+    op = ValueOp(b"b-key", proofs[1])
+    ops = ProofOperators([op])
+    ops.verify(root, [b"b-key"], b"beta")
+    with pytest.raises(ProofOpError):
+        ops.verify(root, [b"b-key"], b"gamma")          # wrong value
+    with pytest.raises(ProofOpError):
+        ops.verify(b"\x00" * 32, [b"b-key"], b"beta")   # wrong root
+    with pytest.raises(ProofOpError):
+        ops.verify(root, [b"other-key"], b"beta")       # wrong keypath
+
+
+def test_value_op_key_is_bound_into_leaf():
+    """A prover cannot relabel a proven value under a different key: the
+    key participates in the leaf hash."""
+    kvs = [(b"user", b"42"), (b"admin", b"1")]
+    root, proofs = proofs_from_byte_slices(
+        [kv_leaf(k, v) for k, v in kvs])
+    forged = ValueOp(b"admin", proofs[0])    # user's proof, admin's key
+    with pytest.raises(ProofOpError):
+        ProofOperators([forged]).verify(root, [b"admin"], b"42")
+
+
+def test_proof_op_wire_roundtrip_and_registry():
+    root, proofs = proofs_from_byte_slices(
+        [kv_leaf(b"k", b"x"), kv_leaf(b"j", b"y")])
+    wire: ProofOp = ValueOp(b"k", proofs[0]).proof_op()
+    assert wire.type == ValueOp.TYPE
+    ops = ProofOperators.decode([wire])
+    ops.verify(root, [b"k"], b"x")
+    bad = ProofOp("unknown:op", b"", b"")
+    with pytest.raises(ProofOpError):
+        ProofOperators.decode([bad])
+    with pytest.raises(ProofOpError):
+        ProofOperators([]).verify(root, [], root)       # empty chain
+
+
+# -------------------------------------------------------------- inspect
+
+def test_inspect_serves_chain_data_from_dead_node_dir(tmp_path):
+    """Run a node, stop it ('crash'), then read its blocks/validators/txs
+    through the inspect RPC server."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.config import test_consensus_config as _tcc
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+    from cometbft_tpu.rpc.inspect import run_inspect
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    def cfg():
+        c = Config(consensus=_tcc())
+        c.p2p.laddr = "tcp://127.0.0.1:0"
+        c.rpc.laddr = "tcp://127.0.0.1:0"
+        return c
+
+    async def main():
+        pvs = [MockPV.from_secret(b"ins%d" % i) for i in range(3)]
+        doc = GenesisDoc(chain_id="ins-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            n = await Node.create(doc, KVStoreApplication(),
+                                  priv_validator=pv, config=cfg(),
+                                  node_key=NodeKey.from_secret(b"ik%d" % i),
+                                  home=str(tmp_path / f"n{i}"),
+                                  name=f"ins{i}")
+            nodes.append(n)
+            await n.start()
+        for i, a in enumerate(nodes):
+            for b in nodes[i + 1:]:
+                await a.dial_peer(b.listen_addr, persistent=True)
+        cli0 = HTTPClient(*nodes[0].rpc_addr)
+        res = await cli0.call("broadcast_tx_commit", tx=b"ik=iv".hex())
+        committed_h = res["height"]
+        txh = res["hash"]
+        while nodes[0].height() < committed_h + 1:
+            await asyncio.sleep(0.05)
+        for n in nodes:
+            await n.stop()
+
+        # node is dead: inspect its data dir
+        server, addr = await run_inspect(str(tmp_path / "n0"), cfg(), doc)
+        try:
+            cli = HTTPClient(*addr)
+            st = await cli.call("status")
+            assert st["sync_info"]["latest_block_height"] >= committed_h
+            blk = await cli.call("block", height=committed_h)
+            assert blk["block"]["hdr"]["h"] == committed_h
+            vals = await cli.call("validators")
+            assert vals["total"] == 3
+            tx = await cli.call("tx", hash=txh)
+            assert tx["height"] == committed_h
+            # live-only routes answer with an error, not a hang
+            with pytest.raises(RPCError):
+                await cli.call("broadcast_tx_sync", tx=b"zz".hex())
+        finally:
+            await server.close()
+        return True
+
+    assert run(main())
