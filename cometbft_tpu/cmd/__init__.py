@@ -292,6 +292,44 @@ async def _inspect_async(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """commands/light.go: light-client proxy daemon."""
+    return asyncio.run(_light_async(args))
+
+
+async def _light_async(args) -> int:
+    from ..light import Client, TrustOptions
+    from ..light.proxy import run_light_proxy
+    from ..light.rpc_provider import RPCProvider
+    from ..rpc.client import HTTPClient
+
+    def parse_hp(s: str) -> tuple[str, int]:
+        host, _, port = s.removeprefix("tcp://").rpartition(":")
+        return host or "127.0.0.1", int(port)
+
+    phost, pport = parse_hp(args.primary)
+    primary = RPCProvider(phost, pport, "primary")
+    witnesses = [RPCProvider(*parse_hp(w), f"witness{i}")
+                 for i, w in enumerate(args.witness or [])]
+    client = Client(
+        args.chain_id,
+        TrustOptions(args.trust_period * 1_000_000_000,
+                     args.trust_height, bytes.fromhex(args.trust_hash)),
+        primary, witnesses=witnesses)
+    server, addr = await run_light_proxy(
+        client, HTTPClient(phost, pport), "127.0.0.1", args.port)
+    print(f"Light proxy on {addr[0]}:{addr[1]} "
+          f"(primary {args.primary}, {len(witnesses)} witnesses)",
+          flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await server.close()
+    return 0
+
+
 def cmd_version(args) -> int:
     print(VERSION)
     return 0
@@ -332,6 +370,20 @@ def build_parser() -> argparse.ArgumentParser:
                      ("version", cmd_version)):
         sp = sub.add_parser(name)
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("light", help="light-client RPC proxy daemon")
+    sp.add_argument("--primary", required=True,
+                    help="full node RPC addr host:port")
+    sp.add_argument("--witness", action="append", default=[],
+                    help="witness RPC addr (repeatable)")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--trust-height", type=int, required=True)
+    sp.add_argument("--trust-hash", required=True,
+                    help="hex header hash at the trust height")
+    sp.add_argument("--trust-period", type=int, default=168 * 3600,
+                    help="trusting period in seconds")
+    sp.add_argument("--port", type=int, default=0)
+    sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("inspect",
                         help="read-only RPC over the data directory")

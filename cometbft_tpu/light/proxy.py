@@ -1,0 +1,137 @@
+"""Light-client RPC proxy: an RPC endpoint whose answers are VERIFIED
+against the trusted header chain before being returned (reference:
+``light/proxy/proxy.go`` + the ``cometbft light`` daemon).
+
+A wallet pointing at this proxy gets full-node convenience with
+light-client trust: headers/commits/validators come from the light
+client's verification pipeline, and blocks fetched from the primary are
+only returned when their hash matches the verified header."""
+
+from __future__ import annotations
+
+from ..rpc.core import RPCError
+from ..rpc.json import jsonable
+from .client import Client
+from .types import LightClientError
+
+
+class LightProxy:
+    """The 'node' the RPC server wraps: routes resolve against a light
+    client instead of local stores."""
+
+    def __init__(self, client: Client, primary_rpc):
+        self.client = client
+        self.primary_rpc = primary_rpc     # HTTPClient to the full node
+        self.event_bus = None
+        self.name = "light-proxy"
+
+
+async def _lb(env, height) -> "tuple":
+    proxy: LightProxy = env.node
+    try:
+        if height in (None, 0, "0", ""):
+            lb = await proxy.client.update()
+            if lb is None:
+                lb = proxy.client.latest_trusted()
+        else:
+            lb = await proxy.client.verify_light_block_at_height(
+                int(height))
+    except LightClientError as e:
+        raise RPCError(-32603, f"light verification failed: {e}")
+    if lb is None:
+        raise RPCError(-32603, "no trusted block available")
+    return lb
+
+
+async def status(env) -> dict:
+    proxy: LightProxy = env.node
+    latest = proxy.client.latest_trusted()
+    return {
+        "node_info": {"moniker": proxy.name,
+                      "network": proxy.client.chain_id},
+        "sync_info": {
+            "latest_block_height": latest.height if latest else 0,
+            "latest_block_hash":
+                latest.header.hash().hex() if latest else "",
+            "trusted": True,
+        },
+    }
+
+
+async def header(env, height=None) -> dict:
+    lb = await _lb(env, height)
+    return {"header": jsonable(lb.header), "verified": True}
+
+
+async def commit(env, height=None) -> dict:
+    lb = await _lb(env, height)
+    return {"header": jsonable(lb.header), "commit": jsonable(lb.commit),
+            "canonical": True, "verified": True}
+
+
+async def validators(env, height=None, page=1, per_page=30) -> dict:
+    """Same shape + pagination as the full-node route (a light client can
+    point at a light proxy)."""
+    lb = await _lb(env, height)
+    vals = lb.validators
+    page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
+    start = (page - 1) * per_page
+    sel = vals.validators[start:start + per_page]
+    return {"block_height": lb.height,
+            "validators": [{"address": v.address.hex(),
+                            "pub_key_type": v.pub_key.type(),
+                            "pub_key": v.pub_key.bytes().hex(),
+                            "voting_power": v.voting_power,
+                            "proposer_priority": v.proposer_priority}
+                           for v in sel],
+            "count": len(sel), "total": vals.size(), "verified": True}
+
+
+async def block(env, height=None) -> dict:
+    """Fetch the full block from the primary, admit it only if its hash
+    matches the VERIFIED header (proxy.go block verification)."""
+    proxy: LightProxy = env.node
+    lb = await _lb(env, height)
+    res = await proxy.primary_rpc.call("block", height=lb.height)
+    from ..rpc.json import from_jsonable
+    from ..types import codec
+    from ..types.block_id import BlockID
+    from ..types.part_set import PartSet
+
+    blk = from_jsonable(res["block"])
+    if blk.hash() != lb.header.hash():
+        raise RPCError(-32603,
+                       "primary served a block that does not match the "
+                       "verified header (possible attack)")
+    # NEVER echo the primary's block_id: recompute it from the verified
+    # block so a forged id can't ride a valid body (light/rpc/client.go
+    # Block checks BlockID.Hash too)
+    parts = PartSet.from_data(codec.pack(blk))
+    bid = BlockID(blk.hash(), parts.header())
+    return {"block_id": jsonable(bid), "block": res["block"],
+            "verified": True}
+
+
+async def health(env) -> dict:
+    return {}
+
+
+PROXY_ROUTES = {
+    "health": health,
+    "status": status,
+    "header": header,
+    "commit": commit,
+    "validators": validators,
+    "block": block,
+}
+
+
+async def run_light_proxy(client: Client, primary_rpc,
+                          host: str = "127.0.0.1", port: int = 0):
+    """Start the verified-RPC proxy; returns (server, (host, port))."""
+    from ..rpc.server import RPCServer
+
+    server = RPCServer(LightProxy(client, primary_rpc),
+                       routes=PROXY_ROUTES)
+    addr = await server.listen(host, port)
+    return server, addr

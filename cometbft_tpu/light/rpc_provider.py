@@ -1,0 +1,53 @@
+"""RPC-backed light-block provider: fetch headers/commits/validators from
+a full node's JSON-RPC endpoint (reference: ``light/provider/http`` — the
+provider real light clients use in production)."""
+
+from __future__ import annotations
+
+from ..crypto.keys import pub_key_from_type_bytes
+from ..rpc.client import HTTPClient
+from ..rpc.core import RPCError
+from ..rpc.json import from_jsonable
+from ..types.validator_set import Validator, ValidatorSet
+from .provider import ErrLightBlockNotFound, Provider
+from .types import LightBlock
+
+
+class RPCProvider(Provider):
+    def __init__(self, host: str, port: int, name: str | None = None):
+        self.client = HTTPClient(host, port)
+        self.name = name or f"rpc:{host}:{port}"
+
+    def id(self) -> str:
+        return self.name
+
+    async def light_block(self, height: int) -> LightBlock:
+        try:
+            cm = await self.client.call("commit", height=height or None)
+            if cm.get("header") is None or cm.get("commit") is None:
+                raise ErrLightBlockNotFound(
+                    f"{self.name}: no commit at {height}")
+            header = from_jsonable(cm["header"])
+            commit = from_jsonable(cm["commit"])
+            vals = await self._validators(commit.height)
+        except RPCError as e:
+            raise ErrLightBlockNotFound(f"{self.name}: {e}") from e
+        except OSError as e:
+            raise ErrLightBlockNotFound(
+                f"{self.name}: unreachable: {e}") from e
+        return LightBlock(header=header, commit=commit, validators=vals)
+
+    async def _validators(self, height: int) -> ValidatorSet:
+        vals: list[Validator] = []
+        page = 1
+        while True:
+            res = await self.client.call("validators", height=height,
+                                         page=page, per_page=100)
+            for v in res["validators"]:
+                vals.append(Validator(
+                    pub_key_from_type_bytes(v["pub_key_type"],
+                                            bytes.fromhex(v["pub_key"])),
+                    v["voting_power"], v["proposer_priority"]))
+            if len(vals) >= res["total"] or not res["validators"]:
+                return ValidatorSet(vals)
+            page += 1

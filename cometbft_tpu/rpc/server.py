@@ -64,8 +64,11 @@ def _coerce(v: str):
 
 
 class RPCServer:
-    def __init__(self, node):
+    def __init__(self, node, routes: dict | None = None):
+        """``routes`` overrides the default route table (the light proxy
+        serves verified routes against a light client instead)."""
         self.env = Environment(node)
+        self.routes = routes if routes is not None else ROUTES
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._ws_counter = 0
@@ -176,7 +179,7 @@ class RPCServer:
         method = parts.path.strip("/")
         if not method:
             return {"jsonrpc": "2.0", "id": -1,
-                    "result": {"routes": sorted(ROUTES)}}
+                    "result": {"routes": sorted(self.routes)}}
         try:
             params = {k: _coerce(v) for k, v in parse_qsl(parts.query)}
         except ValueError as e:       # e.g. odd-length 0x hex
@@ -184,7 +187,7 @@ class RPCServer:
         return await self._dispatch(-1, method, params)
 
     async def _dispatch(self, rid, method: str, params: dict) -> dict:
-        handler = ROUTES.get(method)
+        handler = self.routes.get(method)
         if handler is None:
             return _rpc_error(rid, -32601, f"method {method!r} not found")
         try:
@@ -228,6 +231,8 @@ class _WsSession:
         self.subs: dict[str, asyncio.Task] = {}   # query -> pump task
 
     def cleanup(self) -> None:
+        if not self.subs:
+            return              # never touch the bus if nothing subscribed
         bus = self.server.env.node.event_bus
         for query, task in self.subs.items():
             task.cancel()
@@ -286,7 +291,11 @@ class _WsSession:
             await self._send_json(_rpc_error(rid, -32603,
                                              "already subscribed"))
             return
-        bus = self.server.env.node.event_bus
+        bus = getattr(self.server.env.node, "event_bus", None)
+        if bus is None:
+            await self._send_json(_rpc_error(
+                rid, -32601, "subscriptions not supported on this server"))
+            return
         sub = bus.subscribe(f"{self.sid}:{query}", qdict)
         self.subs[query] = asyncio.create_task(self._pump(query, sub))
         await self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})

@@ -64,18 +64,12 @@ def test_cli_init_and_key_commands(tmp_path):
     assert rr.returncode == 0, rr.stderr
 
 
-def test_cli_testnet_multiprocess_commits_blocks(tmp_path):
-    """4 real OS processes, launched by the CLI, commit blocks; txs and
-    queries flow through RPC only."""
-    base = str(tmp_path / "net")
-    res = _run_cli("testnet", "--v", "4", "--output-dir", base,
-                   "--base-port", str(BASE_PORT), "--chain-id", "proc-net")
-    assert res.returncode == 0, res.stderr
 
-    # shrink consensus timeouts for test speed
+def _patch_testnet_configs(base, n=4):
+    """Shrink consensus timeouts + pin the CPU backend for test speed."""
     from cometbft_tpu.config import Config
 
-    for i in range(4):
+    for i in range(n):
         cfgp = f"{base}/node{i}/config/config.toml"
         cfg = Config.load(cfgp)
         cfg.consensus.timeout_propose = 300_000_000
@@ -88,15 +82,29 @@ def test_cli_testnet_multiprocess_commits_blocks(tmp_path):
         cfg.base.signature_backend = "cpu"
         cfg.save(cfgp)
 
+
+def _spawn_node(base, i):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu",
+         "--home", f"{base}/node{i}", "start"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO)
+
+
+def test_cli_testnet_multiprocess_commits_blocks(tmp_path):
+    """4 real OS processes, launched by the CLI, commit blocks; txs and
+    queries flow through RPC only."""
+    base = str(tmp_path / "net")
+    res = _run_cli("testnet", "--v", "4", "--output-dir", base,
+                   "--base-port", str(BASE_PORT), "--chain-id", "proc-net")
+    assert res.returncode == 0, res.stderr
+
+    _patch_testnet_configs(base)
     procs = []
     try:
         for i in range(4):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "cometbft_tpu",
-                 "--home", f"{base}/node{i}", "start"],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, env=env, cwd=REPO))
+            procs.append(_spawn_node(base, i))
 
         asyncio.run(_drive_rpc())
     finally:
@@ -150,3 +158,82 @@ async def _drive_rpc():
     # the app state is queryable through any node
     q = await clients[3].call("abci_query", path="/key", data=b"pk".hex())
     assert bytes.fromhex(q["response"]["value"]) == b"pv"
+
+
+def test_cli_testnet_kill_and_restart_node(tmp_path):
+    """The reference e2e runner's perturbations (test/e2e/runner/perturb.go)
+    shrunk to one machine: SIGKILL a validator process mid-chain, the rest
+    keep committing, the restarted process recovers from its WAL/stores and
+    catches back up to the live chain."""
+    base = str(tmp_path / "pnet")
+    kill_port = BASE_PORT + 100
+    res = _run_cli("testnet", "--v", "4", "--output-dir", base,
+                   "--base-port", str(kill_port), "--chain-id", "perturb")
+    assert res.returncode == 0, res.stderr
+
+    _patch_testnet_configs(base)
+
+    def spawn(i):
+        return _spawn_node(base, i)
+
+    procs = {i: spawn(i) for i in range(4)}
+    try:
+        asyncio.run(_drive_perturbation(procs, spawn, kill_port))
+    finally:
+        for p in procs.values():
+            try:
+                p.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+async def _drive_perturbation(procs, spawn, base_port):
+    sys.path.insert(0, REPO)
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+
+    def cli(i):
+        return HTTPClient("127.0.0.1", base_port + 2 * i + 1)
+
+    async def height(i):
+        st = await cli(i).call("status")
+        return st["sync_info"]["latest_block_height"]
+
+    async def wait_height(i, h, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                if await height(i) >= h:
+                    return
+            except (OSError, RPCError, asyncio.TimeoutError):
+                pass
+            await asyncio.sleep(0.3)
+        raise TimeoutError(f"node{i} never reached height {h}")
+
+    for i in range(4):
+        await wait_height(i, 1)
+
+    # SIGKILL node3 — a hard crash, no cleanup
+    procs[3].kill()
+    procs[3].wait(timeout=10)
+
+    # the remaining 3/4 (>2/3) keep committing
+    h_at_kill = await height(0)
+    await wait_height(0, h_at_kill + 5)
+
+    # restart the crashed node: it must recover and catch up to the tip
+    procs[3] = spawn(3)
+    target = await height(0) + 3
+    await wait_height(3, target, timeout=90)
+
+    # all four agree on a recent block hash
+    check_h = target
+    hashes = set()
+    for i in range(4):
+        blk = await cli(i).call("block", height=check_h)
+        hashes.add(blk["block_id"]["hash"]["~b"])
+    assert len(hashes) == 1, f"fork after restart: {hashes}"

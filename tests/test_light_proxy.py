@@ -1,0 +1,131 @@
+"""Light client over the RPC provider + the verified light proxy
+(reference: ``light/provider/http``, ``light/proxy``)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.config import test_consensus_config as _tcc
+from cometbft_tpu.light import Client, TrustOptions
+from cometbft_tpu.light.proxy import run_light_proxy
+from cometbft_tpu.light.rpc_provider import RPCProvider
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p import NodeKey
+from cometbft_tpu.rpc import HTTPClient
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+
+pytestmark = pytest.mark.timeout(150)
+
+PERIOD = 3600 * 1_000_000_000
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _config() -> Config:
+    cfg = Config(consensus=_tcc())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    return cfg
+
+
+async def _net(n=3):
+    pvs = [MockPV.from_secret(b"lpx%d" % i) for i in range(n)]
+    doc = GenesisDoc(chain_id="lpx-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+    nodes = []
+    for i, pv in enumerate(pvs):
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv, config=_config(),
+            node_key=NodeKey.from_secret(b"lk%d" % i), name=f"lpx{i}")
+        nodes.append(node)
+        await node.start()
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            await a.dial_peer(b.listen_addr, persistent=True)
+    return nodes
+
+
+async def _stop(nodes):
+    for n in nodes:
+        try:
+            await n.stop()
+        except Exception:
+            pass
+
+
+def test_light_client_over_rpc_provider():
+    async def main():
+        nodes = await _net(3)
+        try:
+            async def reach(h):
+                while not all(n.height() >= h for n in nodes):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(reach(6), 60)
+            trust_h = 2
+            trust_hash = nodes[0].block_store.load_block(trust_h).hash()
+            primary = RPCProvider(*nodes[0].rpc_addr, "primary")
+            witness = RPCProvider(*nodes[1].rpc_addr, "witness")
+            client = Client("lpx-net",
+                            TrustOptions(PERIOD, trust_h, trust_hash),
+                            primary, witnesses=[witness], backend="cpu")
+            lb = await client.verify_light_block_at_height(5)
+            assert lb.header.hash() == \
+                nodes[0].block_store.load_block(5).hash()
+            # update() follows the moving chain tip
+            tip = await client.update()
+            assert tip.height >= 5
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
+def test_light_proxy_serves_verified_routes():
+    async def main():
+        nodes = await _net(3)
+        try:
+            async def reach(h):
+                while not all(n.height() >= h for n in nodes):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(reach(5), 60)
+            trust_h = 2
+            trust_hash = nodes[0].block_store.load_block(trust_h).hash()
+            client = Client(
+                "lpx-net", TrustOptions(PERIOD, trust_h, trust_hash),
+                RPCProvider(*nodes[0].rpc_addr, "primary"), backend="cpu")
+            server, addr = await run_light_proxy(
+                client, HTTPClient(*nodes[0].rpc_addr))
+            try:
+                cli = HTTPClient(*addr)
+                st = await cli.call("status")
+                assert st["node_info"]["network"] == "lpx-net"
+                h = await cli.call("header", height=4)
+                assert h["verified"] is True
+                cm = await cli.call("commit", height=4)
+                assert cm["commit"]["h"] == 4
+                vals = await cli.call("validators", height=4)
+                assert vals["total"] == 3
+                blk = await cli.call("block", height=4)
+                assert blk["verified"] is True
+                want = nodes[0].block_store.load_block(4).hash().hex()
+                assert blk["block_id"]["hash"]["~b"] == want
+            finally:
+                await server.close()
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
