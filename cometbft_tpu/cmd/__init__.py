@@ -305,6 +305,10 @@ async def _light_async(args) -> int:
 
     def parse_hp(s: str) -> tuple[str, int]:
         host, _, port = s.removeprefix("tcp://").rpartition(":")
+        if not port.isdigit():
+            print(f"bad address {s!r}: expected host:port",
+                  file=sys.stderr)
+            raise SystemExit(2)
         return host or "127.0.0.1", int(port)
 
     phost, pport = parse_hp(args.primary)

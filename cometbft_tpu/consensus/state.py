@@ -651,15 +651,21 @@ class ConsensusState:
 
         self.block_exec.validate_block(self.state, block)
 
+        from ..libs.fail import fail_point
+
+        fail_point("cs:before-save-block")    # state.go:1867-1936 sites
         if self.block_store.height() < height:
             ext = precommits.make_extended_commit()
             self.block_store.save_block_with_extended_commit(
                 block, parts, ext)
+        fail_point("cs:after-save-block")
         if self.wal is not None and not self._replaying:
             self.wal.write_end_height(height)
+        fail_point("cs:after-wal-endheight")
 
         new_state = await self.block_exec.apply_block(
             self.state, bid, block, verified=True)
+        fail_point("cs:after-apply-block")
 
         self._update_to_state(new_state)
         if not self._replaying:       # replayed commits would pollute stats

@@ -1,0 +1,42 @@
+"""Fail points: deterministic crash injection for recovery testing
+(reference: ``internal/fail/fail.go`` — the env var names the Nth call to
+``fail_point()`` at which the process dies with a distinctive exit code).
+
+Sites live in the commit path (consensus finalize + block executor), so a
+test harness can kill a node at EVERY stage boundary and assert that WAL
++ handshake recovery reaches the same chain state (the reference's
+``replay_test.go`` crash matrix)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+ENV_VAR = "CMT_FAIL_INDEX"
+EXIT_CODE = 38              # distinctive: "killed by fail point"
+
+_index = int(os.environ.get(ENV_VAR, "-1"))
+_counter = 0
+_labels: list[str] = []
+
+
+def fail_point(label: str) -> None:
+    """Die hard (os._exit — no cleanup, no flushing, like a real crash)
+    when this is the ``CMT_FAIL_INDEX``-th call in the process.
+
+    Unarmed (the production default) this is a near-free no-op — no
+    bookkeeping accumulates on the commit path."""
+    if _index < 0:
+        return
+    global _counter
+    _labels.append(label)
+    my_idx = _counter
+    _counter += 1
+    if my_idx == _index:
+        print(f"FAIL POINT {my_idx} ({label}): crashing",
+              file=sys.stderr, flush=True)
+        os._exit(EXIT_CODE)
+
+
+def labels_seen() -> list[str]:
+    return list(_labels)

@@ -72,19 +72,12 @@ async def commit(env, height=None) -> dict:
 async def validators(env, height=None, page=1, per_page=30) -> dict:
     """Same shape + pagination as the full-node route (a light client can
     point at a light proxy)."""
+    from ..rpc.core import paginate_validators
+
     lb = await _lb(env, height)
-    vals = lb.validators
-    page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
-    start = (page - 1) * per_page
-    sel = vals.validators[start:start + per_page]
-    return {"block_height": lb.height,
-            "validators": [{"address": v.address.hex(),
-                            "pub_key_type": v.pub_key.type(),
-                            "pub_key": v.pub_key.bytes().hex(),
-                            "voting_power": v.voting_power,
-                            "proposer_priority": v.proposer_priority}
-                           for v in sel],
-            "count": len(sel), "total": vals.size(), "verified": True}
+    out = paginate_validators(lb.validators, lb.height, page, per_page)
+    out["verified"] = True
+    return out
 
 
 async def block(env, height=None) -> dict:

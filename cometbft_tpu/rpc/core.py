@@ -189,16 +189,13 @@ async def block_results(env: Environment, height=None) -> dict:
     }
 
 
-async def validators(env: Environment, height=None, page=1,
-                     per_page=30) -> dict:
-    h = _height_or_latest(env, height)
-    vals = env.state_store.load_validators(h)
-    if vals is None:
-        raise RPCError(-32603, f"no validator set at height {h}")
+def paginate_validators(vals, height: int, page, per_page) -> dict:
+    """Shared validator-page serializer (also used by the light proxy so
+    a light client can point at either endpoint)."""
     page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
     start = (page - 1) * per_page
     sel = vals.validators[start:start + per_page]
-    return {"block_height": h,
+    return {"block_height": height,
             "validators": [{"address": v.address.hex(),
                             "pub_key_type": v.pub_key.type(),
                             "pub_key": v.pub_key.bytes().hex(),
@@ -206,6 +203,15 @@ async def validators(env: Environment, height=None, page=1,
                             "proposer_priority": v.proposer_priority}
                            for v in sel],
             "count": len(sel), "total": vals.size()}
+
+
+async def validators(env: Environment, height=None, page=1,
+                     per_page=30) -> dict:
+    h = _height_or_latest(env, height)
+    vals = env.state_store.load_validators(h)
+    if vals is None:
+        raise RPCError(-32603, f"no validator set at height {h}")
+    return paginate_validators(vals, h, page, per_page)
 
 
 async def consensus_params(env: Environment, height=None) -> dict:

@@ -144,8 +144,12 @@ class BlockExecutor:
                 f"app returned {len(resp.tx_results)} tx results for "
                 f"{len(block.data.txs)} txs")
 
+        from ..libs.fail import fail_point
+
+        fail_point("exec:after-finalize-block")   # execution.go:261-311
         self.state_store.save_finalize_block_response(
             block.header.height, _pack_finalize_response(resp))
+        fail_point("exec:after-save-response")
 
         new_state = self._update_state(state, block_id, block, resp)
 
@@ -155,7 +159,9 @@ class BlockExecutor:
             commit_resp = await self.app.commit()
             await self.mempool.update(block.header.height,
                                       list(block.data.txs), resp.tx_results)
+        fail_point("exec:after-app-commit")
         self.state_store.save(new_state)
+        fail_point("exec:after-state-save")
         self.evidence_pool.update(new_state, block.evidence)
 
         retain = commit_resp.retain_height

@@ -230,6 +230,7 @@ class InProcNetwork:
 async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
                               app_factory=None, config=None,
                               vote_extensions_height: int = 0,
+                              pbts_height: int = 0,
                               wal_dir: str | None = None,
                               backend: str = "cpu",
                               power=None,
@@ -258,6 +259,7 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
                          for i, pv in enumerate(pvs)])
     doc.consensus_params.feature.vote_extensions_enable_height = \
         vote_extensions_height
+    doc.consensus_params.feature.pbts_enable_height = pbts_height
 
     from .evidence import EvidencePool
 

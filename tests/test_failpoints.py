@@ -1,0 +1,112 @@
+"""Crash-point recovery matrix: kill a real node process at EVERY commit
+-path fail point and assert the restarted process recovers and keeps
+committing (reference: ``internal/fail`` + ``internal/consensus/
+replay_test.go``'s crash table — 8 sites across state.go:1867-1936 and
+state/execution.go:261-311)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.timeout(400)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 28760
+
+# one crash per commit-path stage (order of fail_point() calls per height:
+# cs:before-save-block, cs:after-save-block, cs:after-wal-endheight,
+# exec:after-finalize-block, exec:after-save-response,
+# exec:after-app-commit, exec:after-state-save, cs:after-apply-block)
+N_FAIL_POINTS = 8
+# crash during the SECOND height's commit so there is real state to recover
+FAIL_BASE = N_FAIL_POINTS
+
+
+def _spawn(home, fail_index=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    if fail_index is not None:
+        env["CMT_FAIL_INDEX"] = str(fail_index)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+
+
+def test_recovery_from_every_commit_crash_point(tmp_path):
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.libs.fail import EXIT_CODE
+
+    home = str(tmp_path / "solo")
+    res = subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "init",
+         "--chain-id", "crash-matrix"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO))
+    assert res.returncode == 0, res.stderr
+    cfgp = f"{home}/config/config.toml"
+    cfg = Config.load(cfgp)
+    cfg.consensus.timeout_propose = 200_000_000
+    cfg.consensus.timeout_prevote = 100_000_000
+    cfg.consensus.timeout_precommit = 100_000_000
+    cfg.consensus.timeout_commit = 100_000_000
+    cfg.base.signature_backend = "cpu"
+    cfg.p2p.laddr = f"tcp://127.0.0.1:{BASE_PORT}"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{BASE_PORT + 1}"
+    cfg.save(cfgp)
+
+    for stage in range(N_FAIL_POINTS):
+        fail_index = FAIL_BASE + stage
+        proc = _spawn(home, fail_index=fail_index)
+        rc = proc.wait(timeout=120)
+        assert rc == EXIT_CODE, (
+            f"stage {stage}: expected fail-point exit {EXIT_CODE}, "
+            f"got {rc}:\n{proc.stdout.read()[-2000:]}")
+
+        # restart WITHOUT the fail point: must recover and commit further
+        proc = _spawn(home)
+        try:
+            asyncio.run(_assert_recovers_and_progresses(stage))
+        except BaseException:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                out = proc.communicate(timeout=10)[0]
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                out = ""
+            print(f"--- stage {stage} node output:\n{out[-3000:]}")
+            raise
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+async def _assert_recovers_and_progresses(stage):
+    sys.path.insert(0, REPO)
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+
+    cli = HTTPClient("127.0.0.1", BASE_PORT + 1)
+    deadline = time.monotonic() + 90
+    first_h = None
+    while True:
+        try:
+            st = await cli.call("status")
+            h = st["sync_info"]["latest_block_height"]
+            if first_h is None:
+                first_h = h
+            if h >= max(first_h + 2, 3):
+                break
+        except (OSError, RPCError, asyncio.TimeoutError):
+            pass
+        assert time.monotonic() < deadline, \
+            f"stage {stage}: node did not recover/progress"
+        await asyncio.sleep(0.3)
+    # the app and the chain agree after recovery
+    info = await cli.call("abci_info")
+    assert info["response"]["last_block_height"] >= first_h - 1
