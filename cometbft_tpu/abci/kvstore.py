@@ -46,8 +46,19 @@ class KVStoreApplication(Application):
     async def query(self, path: str, data: bytes, height: int,
                     prove: bool) -> t.QueryResponse:
         value = self.state.get(data, b"")
-        return t.QueryResponse(key=data, value=value, height=self.height,
+        resp = t.QueryResponse(key=data, value=value, height=self.height,
                                log="exists" if value else "does not exist")
+        if prove and value:
+            from ..crypto.merkle import ValueOp
+
+            index, proofs = getattr(self, "_proof_cache", ({}, []))
+            if data not in index:       # state mutated since last commit
+                self._compute_app_hash()
+                index, proofs = self._proof_cache
+            op = ValueOp(data, proofs[index[data]]).proof_op()
+            resp.proof_ops = [{"type": op.type, "key": op.key,
+                               "data": op.data}]
+        return resp
 
     # -------------------------------------------------------------- mempool
 
@@ -159,11 +170,23 @@ class KVStoreApplication(Application):
              "height": self.height}, use_bin_type=True)
 
     def _compute_app_hash(self) -> bytes:
-        h = hashlib.sha256()
-        for k in sorted(self.state):
-            h.update(struct.pack(">I", len(k)) + k)
-            h.update(struct.pack(">I", len(self.state[k])) + self.state[k])
-        return h.digest()
+        """Merkle root over key-bound leaves: queries are PROVABLE against
+        the app hash in the next block header (crypto/merkle ValueOp).
+
+        The per-key proofs are cached here — the tree only changes when
+        the state does (finalize/restore), so proven queries are O(1)."""
+        from ..crypto.merkle import kv_leaf, proofs_from_byte_slices
+
+        keys = sorted(self.state)
+        if not keys:
+            self._proof_cache = ({}, [])
+            from ..crypto.merkle import hash_from_byte_slices
+
+            return hash_from_byte_slices([])
+        root, proofs = proofs_from_byte_slices(
+            [kv_leaf(k, self.state[k]) for k in keys])
+        self._proof_cache = ({k: i for i, k in enumerate(keys)}, proofs)
+        return root
 
     async def list_snapshots(self) -> list[t.Snapshot]:
         out = []

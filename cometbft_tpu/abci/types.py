@@ -98,6 +98,9 @@ class QueryResponse:
     key: bytes = b""
     value: bytes = b""
     height: int = 0
+    # merkle proof op chain as wire dicts {"type","key","data"}
+    # (abci ProofOps; verified against the header app_hash at height+1)
+    proof_ops: list = field(default_factory=list)
 
 
 @dataclass

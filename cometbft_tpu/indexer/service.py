@@ -5,21 +5,22 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs.service import BaseService
 from ..types import events as ev
 from .block import BlockIndexer
 from .tx import TxIndexer
 
 
-class IndexerService:
+class IndexerService(BaseService):
     def __init__(self, event_bus, tx_indexer: TxIndexer,
                  block_indexer: BlockIndexer, name: str = "indexer"):
+        super().__init__(name=name)
         self.event_bus = event_bus
         self.tx_indexer = tx_indexer
         self.block_indexer = block_indexer
-        self.name = name
         self._tasks: list[asyncio.Task] = []
 
-    async def start(self) -> None:
+    async def on_start(self) -> None:
         # unbuffered: the indexer must see EVERY event — the default
         # drop-oldest subscription would lose txs of large blocks
         tx_sub = self.event_bus.subscribe(
@@ -32,7 +33,7 @@ class IndexerService:
             asyncio.create_task(self._pump_blocks(blk_sub)),
         ]
 
-    async def stop(self) -> None:
+    async def on_stop(self) -> None:
         for t in self._tasks:
             t.cancel()
         self._tasks = []

@@ -26,7 +26,12 @@ class LightProxy:
         self.name = "light-proxy"
 
 
+CODE_NOT_YET_AVAILABLE = -32001      # retryable: chain hasn't caught up
+
+
 async def _lb(env, height) -> "tuple":
+    from .provider import ErrLightBlockNotFound
+
     proxy: LightProxy = env.node
     try:
         if height in (None, 0, "0", ""):
@@ -36,6 +41,9 @@ async def _lb(env, height) -> "tuple":
         else:
             lb = await proxy.client.verify_light_block_at_height(
                 int(height))
+    except ErrLightBlockNotFound as e:
+        # benign: the primary simply doesn't have that height yet
+        raise RPCError(CODE_NOT_YET_AVAILABLE, str(e))
     except LightClientError as e:
         raise RPCError(-32603, f"light verification failed: {e}")
     if lb is None:
@@ -105,6 +113,53 @@ async def block(env, height=None) -> dict:
             "verified": True}
 
 
+async def abci_query(env, path="", data=None, height=0) -> dict:
+    """Verified state query: fetch value + merkle proof from the primary,
+    check the proof chain against the app hash in the VERIFIED header at
+    height+1 (light/rpc/client.go ABCIQueryWithOptions with prove=true —
+    the wallet-grade query flow)."""
+    from ..crypto.merkle import ProofOp, ProofOpError, ProofOperators
+
+    proxy: LightProxy = env.node
+    raw = bytes.fromhex(data) if isinstance(data, str) else (data or b"")
+    res = await proxy.primary_rpc.call("abci_query", path=path,
+                                       data=raw.hex(), height=int(height),
+                                       prove=True)
+    r = res["response"]
+    if r["code"] != 0 or not r["value"]:
+        raise RPCError(-32603,
+                       f"query failed or empty (cannot verify): {r['log']}")
+    if not r["proof_ops"]:
+        raise RPCError(-32603, "primary returned no proof")
+    q_height = r["height"]
+    # app hash AFTER q_height lives in header q_height+1, which may not be
+    # committed for another block interval: retry briefly
+    import asyncio as _aio
+
+    lb = None
+    for _ in range(25):
+        try:
+            lb = await _lb(env, q_height + 1)
+            break
+        except RPCError as e:
+            if e.code != CODE_NOT_YET_AVAILABLE:
+                raise            # a verification FAILURE is an attack signal
+            await _aio.sleep(0.2)
+    if lb is None:
+        raise RPCError(-32603,
+                       f"header {q_height + 1} not yet available to "
+                       "verify the query against")
+    try:
+        ops = ProofOperators.decode(
+            [ProofOp(op["type"], bytes.fromhex(op["key"]),
+                     bytes.fromhex(op["data"]))
+             for op in r["proof_ops"]])
+        ops.verify(lb.header.app_hash, [raw], bytes.fromhex(r["value"]))
+    except ProofOpError as e:
+        raise RPCError(-32603, f"proof verification FAILED: {e}")
+    return {"response": r, "verified": True}
+
+
 async def health(env) -> dict:
     return {}
 
@@ -116,6 +171,7 @@ PROXY_ROUTES = {
     "commit": commit,
     "validators": validators,
     "block": block,
+    "abci_query": abci_query,
 }
 
 

@@ -373,7 +373,11 @@ async def abci_query(env: Environment, path="", data=None, height=0,
                                                 bool(prove))
     return {"response": {"code": resp.code, "log": resp.log,
                          "key": resp.key.hex(), "value": resp.value.hex(),
-                         "height": resp.height}}
+                         "height": resp.height,
+                         "proof_ops": [{"type": op["type"],
+                                        "key": op["key"].hex(),
+                                        "data": op["data"].hex()}
+                                       for op in resp.proof_ops]}}
 
 
 # -------------------------------------------------------------- evidence

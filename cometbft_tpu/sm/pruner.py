@@ -13,10 +13,13 @@ import asyncio
 
 from ..libs import log as tmlog
 from ..libs import metrics
+from ..libs.service import BaseService
 
-class Pruner:
+
+class Pruner(BaseService):
     def __init__(self, state_store, block_store, interval: float = 10.0,
                  name: str = "pruner"):
+        super().__init__(name=f"pruner:{name}")
         self.state_store = state_store
         self.block_store = block_store
         self.interval = interval
@@ -54,12 +57,12 @@ class Pruner:
     def effective_retain_height(self) -> int:
         return self.state_store.get_retain_height()
 
-    # ---------------------------------------------------------- lifecycle
+    # ------------------------------------------- lifecycle (BaseService)
 
-    async def start(self) -> None:
+    async def on_start(self) -> None:
         self._task = asyncio.create_task(self._routine())
 
-    async def stop(self) -> None:
+    async def on_stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
 

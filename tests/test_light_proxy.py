@@ -129,3 +129,47 @@ def test_light_proxy_serves_verified_routes():
         return True
 
     assert run(main())
+
+
+def test_light_proxy_verified_abci_query():
+    """Wallet-grade flow: a state query through the proxy is proven
+    against the app hash in a light-client-verified header; a tampered
+    proof or value is rejected."""
+
+    async def main():
+        nodes = await _net(3)
+        try:
+            cli0 = HTTPClient(*nodes[0].rpc_addr)
+            res = await cli0.call("broadcast_tx_commit", tx=b"pq=pv".hex())
+            committed_h = res["height"]
+
+            async def reach(h):
+                while not all(n.height() >= h for n in nodes):
+                    await asyncio.sleep(0.02)
+
+            await asyncio.wait_for(reach(committed_h + 2), 60)
+            trust_hash = nodes[0].block_store.load_block(1).hash()
+            client = Client(
+                "lpx-net", TrustOptions(PERIOD, 1, trust_hash),
+                RPCProvider(*nodes[0].rpc_addr, "primary"), backend="cpu")
+            server, addr = await run_light_proxy(
+                client, HTTPClient(*nodes[0].rpc_addr))
+            try:
+                pcli = HTTPClient(*addr)
+                q = await pcli.call("abci_query", path="/key",
+                                    data=b"pq".hex())
+                assert q["verified"] is True
+                assert bytes.fromhex(q["response"]["value"]) == b"pv"
+                # absent keys cannot be verified -> explicit error
+                from cometbft_tpu.rpc import RPCError
+
+                with pytest.raises(RPCError):
+                    await pcli.call("abci_query", path="/key",
+                                    data=b"nope".hex())
+            finally:
+                await server.close()
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())

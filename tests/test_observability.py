@@ -199,3 +199,56 @@ def test_node_indexes_and_serves_tx_routes():
         return True
 
     assert run(main())
+
+
+def test_base_service_lifecycle():
+    """libs.service.BaseService: double-start refusal, failed-start reset,
+    idempotent stop, waitable termination — exercised through its two
+    adopters (Pruner, IndexerService)."""
+    from cometbft_tpu.libs.service import BaseService, ServiceError
+
+    class Boom(BaseService):
+        async def on_start(self):
+            raise RuntimeError("nope")
+
+    class Ok(BaseService):
+        def __init__(self):
+            super().__init__("ok")
+            self.events = []
+
+        async def on_start(self):
+            self.events.append("start")
+
+        async def on_stop(self):
+            self.events.append("stop")
+
+    async def main():
+        s = Ok()
+        await s.start()
+        assert s.is_running
+        with pytest.raises(ServiceError):
+            await s.start()
+        waiter = asyncio.create_task(s.wait())
+        await s.stop()
+        await s.stop()                      # idempotent
+        await asyncio.wait_for(waiter, 1)
+        assert s.events == ["start", "stop"]
+
+        b = Boom()
+        with pytest.raises(RuntimeError):
+            await b.start()
+        assert not b.is_running
+        await asyncio.wait_for(b.wait(), 1)   # failed start releases waiters
+
+        # the real adopters run on it
+        from cometbft_tpu.sm.pruner import Pruner
+        from cometbft_tpu.storage import BlockStore, MemDB, StateStore
+
+        p = Pruner(StateStore(MemDB()), BlockStore(MemDB()))
+        await p.start()
+        assert p.is_running
+        await p.stop()
+        assert not p.is_running
+        return True
+
+    assert run(main())
