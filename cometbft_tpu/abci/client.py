@@ -165,6 +165,18 @@ def _encode_value(v):
     return v
 
 
+def _domain_types():
+    """Domain types that ride inside ABCI requests: the commit /
+    extended-commit trees of PrepareProposal.local_last_commit and
+    FinalizeBlock.decided_last_commit."""
+    from ..types.block_id import BlockID, PartSetHeader
+    from ..types.commit import (Commit, CommitSig, ExtendedCommit,
+                                ExtendedCommitSig)
+
+    return (BlockID, PartSetHeader, Commit, CommitSig, ExtendedCommit,
+            ExtendedCommitSig)
+
+
 _DC_TYPES = {cls.__name__: cls for cls in (
     t.EventAttribute, t.Event, t.ExecTxResult, t.ValidatorUpdate,
     t.Misbehavior, t.Snapshot, t.InfoResponse, t.QueryResponse,
@@ -175,7 +187,7 @@ _DC_TYPES = {cls.__name__: cls for cls in (
     t.VerifyVoteExtensionResponse, t.CommitResponse,
     _params.ConsensusParams, _params.BlockParams, _params.EvidenceParams,
     _params.ValidatorParams, _params.VersionParams, _params.FeatureParams,
-    _params.SynchronyParams)}
+    _params.SynchronyParams) + _domain_types()}
 
 
 def _decode_value(v):

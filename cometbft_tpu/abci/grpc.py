@@ -1,0 +1,139 @@
+"""ABCI over gRPC (reference: ``abci/client/grpc_client.go`` +
+``abci/server/grpc_server.go``).
+
+One unary RPC per ABCI method on ``cometbft.abci.v1.ABCIService`` (the
+reference's service shape), HTTP/2 via grpc.aio.  Payloads are the same
+msgpack frames as the socket transport (self-interop, like the socket
+protocol — the framework is not Go-wire-compatible by design), carried as
+raw bytes through gRPC's generic handlers, so no protoc codegen is needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import grpc
+import grpc.aio
+import msgpack
+
+from .application import Application
+from .client import (ABCIClient, ABCIClientError, _decode_value,
+                     _encode_value, dispatch_to_app)
+
+SERVICE = "cometbft.abci.v1.ABCIService"
+
+# snake_case dispatch names <-> CamelCase wire method names
+_METHODS = [
+    "echo", "info", "query", "check_tx", "init_chain",
+    "prepare_proposal", "process_proposal", "finalize_block",
+    "extend_vote", "verify_vote_extension", "commit",
+    "list_snapshots", "offer_snapshot", "load_snapshot_chunk",
+    "apply_snapshot_chunk", "flush",
+]
+
+
+def _camel(name: str) -> str:
+    return "".join(p.capitalize() for p in name.split("_"))
+
+
+_WIRE_TO_SNAKE = {_camel(m): m for m in _METHODS}
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True, default=_encode_value)
+
+
+def _unpack(raw: bytes):
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False)
+
+
+class _ABCIHandler(grpc.GenericRpcHandler):
+    def __init__(self, app: Application, lock: asyncio.Lock):
+        self._app = app
+        self._lock = lock
+
+    def service(self, details):
+        prefix = f"/{SERVICE}/"
+        if not details.method.startswith(prefix):
+            return None
+        snake = _WIRE_TO_SNAKE.get(details.method[len(prefix):])
+        if snake is None:
+            return None
+
+        async def handler(request: bytes, context):
+            try:
+                params = {k: _decode_value(v)
+                          for k, v in _unpack(request).items()}
+                # app calls serialized like the socket server's lock
+                async with self._lock:
+                    if snake == "flush":
+                        result = None
+                    else:
+                        result = await dispatch_to_app(
+                            self._app, snake, params)
+                return _pack({"ok": True, "result": _encode_value(result)})
+            except Exception as e:  # app errors propagate to the client
+                return _pack({"ok": False, "error": repr(e)})
+
+        return grpc.unary_unary_rpc_method_handler(handler)
+
+
+class GRPCABCIServer:
+    """Serves an :class:`Application` over gRPC
+    (``abci/server/grpc_server.go``)."""
+
+    def __init__(self, app: Application, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.app = app
+        self.host = host
+        self.port = port
+        self._server: grpc.aio.Server | None = None
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers(
+            (_ABCIHandler(self.app, asyncio.Lock()),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.2)
+            self._server = None
+
+
+class GRPCClient(ABCIClient):
+    """gRPC ABCI client (``abci/client/grpc_client.go``); one HTTP/2
+    channel, calls pipelined by gRPC itself (no explicit request queue
+    needed — stream multiplexing replaces the socket client's id map)."""
+
+    def __init__(self, channel: grpc.aio.Channel):
+        self._channel = channel
+        self._stubs = {
+            m: channel.unary_unary(f"/{SERVICE}/{_camel(m)}")
+            for m in _METHODS
+        }
+
+    @classmethod
+    async def connect(cls, host: str = "127.0.0.1",
+                      port: int = 26658) -> "GRPCClient":
+        channel = grpc.aio.insecure_channel(f"{host}:{port}")
+        return cls(channel)
+
+    async def call(self, method: str, **params):
+        stub = self._stubs.get(method)
+        if stub is None:
+            raise ABCIClientError(f"unknown ABCI method {method!r}")
+        try:
+            raw = await stub(_pack(_encode_value(params)))
+        except grpc.aio.AioRpcError as e:
+            raise ABCIClientError(
+                f"grpc transport error: {e.code()}: {e.details()}") from e
+        frame = _unpack(raw)
+        if not frame.get("ok", False):
+            raise ABCIClientError(frame.get("error"))
+        return _decode_value(frame["result"])
+
+    async def close(self) -> None:
+        await self._channel.close()

@@ -83,6 +83,7 @@ class P2PConfig:
 @dataclass
 class RPCConfig:
     laddr: str = "tcp://127.0.0.1:26657"
+    grpc_laddr: str = ""              # block/version/pruning gRPC services
     max_open_connections: int = 900
     max_subscription_clients: int = 100
 
@@ -199,8 +200,8 @@ class Config:
 
     def validate(self) -> None:
         """Per-section sanity (config/config.go ValidateBasic)."""
-        if self.base.abci not in ("builtin", "socket"):
-            raise ConfigError(f"base.abci must be builtin|socket, "
+        if self.base.abci not in ("builtin", "socket", "grpc"):
+            raise ConfigError(f"base.abci must be builtin|socket|grpc, "
                               f"got {self.base.abci!r}")
         if self.base.signature_backend not in ("auto", "tpu", "jax", "cpu"):
             raise ConfigError(

@@ -200,6 +200,44 @@ def _metrics():
     )
 
 
+_DEVICE_WAIT_S = 30.0            # max time a verify waits on the device
+_DEVICE_POOL = None              # single dispatch thread owning the chip
+_DEVICE_INFLIGHT = None          # last submitted future (may be stuck)
+
+
+def set_device_wait(seconds: float) -> None:
+    """Config hook: bound on how long a verification waits for the
+    accelerator before falling back to host crypto."""
+    global _DEVICE_WAIT_S
+    _DEVICE_WAIT_S = max(0.1, float(seconds))
+
+
+def _device_call(fn):
+    """Run ``fn`` (a device dispatch) on the single device-owner thread,
+    waiting at most ``_DEVICE_WAIT_S``.  Returns ``fn()``'s result, or
+    None when the device is unavailable: a previous call is still running
+    (possibly wedged in native code — it cannot be killed, only
+    abandoned) or the bounded wait expired.  Callers fall back to host
+    verification; if the abandoned call eventually completes, the device
+    resumes on a later batch.  This keeps the consensus event loop from
+    ever blocking on the accelerator — the TPU is a compute sidecar, not
+    a liveness dependency."""
+    global _DEVICE_POOL, _DEVICE_INFLIGHT
+    import concurrent.futures as cf
+
+    if _DEVICE_POOL is None:
+        _DEVICE_POOL = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-verify")
+    if _DEVICE_INFLIGHT is not None and not _DEVICE_INFLIGHT.done():
+        return None
+    fut = _DEVICE_POOL.submit(fn)
+    _DEVICE_INFLIGHT = fut
+    try:
+        return fut.result(timeout=_DEVICE_WAIT_S)
+    except cf.TimeoutError:
+        return None
+
+
 class TpuBatchVerifier(BatchVerifier):
     """Device-backed batch verifier behind the ``crypto.BatchVerifier`` seam.
 
@@ -271,23 +309,103 @@ class TpuBatchVerifier(BatchVerifier):
                 ss[j] = np.frombuffer(s[32:], np.uint8)
                 msgs[j, :len(m)] = np.frombuffer(m, np.uint8)
                 lens[j] = len(m)
-            dev = device_verify_ed25519(pubs, rs, ss, msgs, lens, self._device)
-            for j, i in enumerate(ed_idx):
-                oks[i] = bool(dev[j])
+            dev = _device_call(lambda: device_verify_ed25519(
+                pubs, rs, ss, msgs, lens, self._device))
+            if dev is None:
+                # device busy/stuck/slow: verify these lanes on host so
+                # consensus never waits on the accelerator
+                lanes.inc(len(ed_idx), route="host_fallback")
+                for i in ed_idx:
+                    p, m, s = self._items[i]
+                    oks[i] = p.verify_signature(m, s)
+            else:
+                for j, i in enumerate(ed_idx):
+                    oks[i] = bool(dev[j])
         return all(oks), oks
+
+
+_PROBE_RESULT: list | None = None    # [bool] once probed: accel usable?
+_PROBE_LOCK = None                   # created lazily (threading.Lock)
+
+
+def _probe_accelerator_subprocess(timeout_s: float = 15.0) -> bool:
+    """Backend discovery in a THROWAWAY subprocess with a hard timeout.
+
+    ``jax.devices()`` hangs forever in native code when the accelerator
+    relay is wedged (observed repeatedly on this image) — a hung thread
+    can't be killed, so the only safe first touch is a process we can.
+    Returns True only if the child reports a live non-CPU platform."""
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(any(d.platform != 'cpu' "
+             "for d in jax.devices()))"],
+            capture_output=True, timeout=timeout_s, text=True)
+        return out.returncode == 0 and "True" in out.stdout
+    except Exception:            # timeout, OOM, missing interpreter...
+        return False
+
+
+_PROBE_THREAD = None
+
+
+def _start_probe_background() -> None:
+    """Kick off :func:`_accelerator_device` on a daemon thread so the
+    caller can fall back to host crypto immediately; once the probe
+    caches its verdict, later auto-selections use the device."""
+    global _PROBE_THREAD, _PROBE_RESULT
+    import os
+    import threading
+
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        _PROBE_RESULT = [False]
+        return
+    if _PROBE_THREAD is None:
+        _PROBE_THREAD = threading.Thread(
+            target=_accelerator_device, daemon=True,
+            name="tpu-backend-probe")
+        _PROBE_THREAD.start()
 
 
 def _accelerator_device():
     """First non-CPU jax device, or None (config-free auto-detection).
 
     When the environment pins CPU (``JAX_PLATFORMS=cpu``), return None
-    WITHOUT touching jax: backend discovery probes every registered
-    plugin, and on this image a wedged axon relay can make that probe
-    hang forever (the same hazard jaxenv.force_cpu_backend defends
-    against) — a node configured for CPU must never block on it."""
+    WITHOUT touching jax.  Otherwise the first call probes the backend in
+    a subprocess (see :func:`_probe_accelerator_subprocess`) so a wedged
+    relay degrades a node to the CPU verifier instead of hanging its
+    consensus hot path; the verdict is cached for the process."""
+    global _PROBE_RESULT, _PROBE_LOCK
     import os
 
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return None
+    if _PROBE_LOCK is None:
+        import threading
+
+        _PROBE_LOCK = threading.Lock()
+    with _PROBE_LOCK:       # one probe; concurrent callers share verdict
+        if _PROBE_RESULT is None:
+            import sys
+
+            if "jax" in sys.modules and getattr(
+                    sys.modules.get("jax._src.xla_bridge"),
+                    "_backends", None):
+                # a backend already initialized in-process without
+                # hanging — trust it, skip the subprocess round-trip
+                _PROBE_RESULT = [True]
+            else:
+                _PROBE_RESULT = [_probe_accelerator_subprocess()]
+                if not _PROBE_RESULT[0]:
+                    # pin + harden so later jax imports can't wedge
+                    os.environ["JAX_PLATFORMS"] = "cpu"
+                    from ..jaxenv import harden_cpu_pinned_env
+
+                    harden_cpu_pinned_env()
+    if not _PROBE_RESULT[0]:
         return None
     try:
         import jax
@@ -325,6 +443,13 @@ def create_batch_verifier(backend: str = "auto",
     if backend in ("tpu", "jax"):
         return TpuBatchVerifier(device)
     if backend == "auto":
+        if device is None and _PROBE_RESULT is None:
+            # first auto-selection: the device probe can take seconds
+            # (subprocess, 15s worst case on a wedged relay) — run it in
+            # the background and serve this batch from host crypto so a
+            # node's consensus loop never blocks on backend discovery
+            _start_probe_background()
+            return CpuBatchVerifier()
         dev = device if device is not None else _accelerator_device()
         if dev is not None and getattr(dev, "platform", "cpu") != "cpu":
             return TpuBatchVerifier(dev)

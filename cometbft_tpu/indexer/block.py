@@ -5,7 +5,7 @@ from __future__ import annotations
 
 from ..storage.db import KVStore, MemDB
 
-K_HEIGHT = b"bi/"          # K_HEIGHT + height8 -> b"" (block indexed)
+K_HEIGHT = b"bi/"          # K_HEIGHT + height8 -> msgpack events
 K_ATTR = b"ba/"            # K_ATTR + key + 0 + value + 0 + height8
 
 
@@ -14,7 +14,12 @@ class BlockIndexer:
         self.db = db or MemDB()
 
     def index(self, height: int, events: list) -> None:
-        batch = {K_HEIGHT + height.to_bytes(8, "big"): b""}
+        import msgpack
+
+        stored = [(e.type, [(a.key, str(a.value)) for a in e.attributes])
+                  for e in events]
+        batch = {K_HEIGHT + height.to_bytes(8, "big"):
+                 msgpack.packb(stored, use_bin_type=True)}
         postings = [("block.height", str(height))]
         for e in events:
             for a in e.attributes:
@@ -29,12 +34,22 @@ class BlockIndexer:
         return self.db.get(K_HEIGHT + height.to_bytes(8, "big")) is not None
 
     def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
-        from ..rpc.server import parse_query
+        """Full-grammar search; equality clauses use postings, the rest
+        post-filters against stored events (see TxIndexer.search)."""
+        import msgpack
 
-        clauses = parse_query(query)
-        clauses.pop("tm.event", None)
+        from ..libs.query import Query
+
+        q = Query.parse(query)
+        # tm.event is implied (every record here is a block event); strip
+        # those conditions so any value the client used (NewBlock /
+        # NewBlockEvents) is tolerated, matching the old posting-path pop
+        conds = [c for c in q.conditions if c.key != "tm.event"]
+        if len(conds) != len(q.conditions):
+            q = Query(conds) if conds else None
+        eq = q.equality_clauses() if q else {}
         heights: set[int] | None = None
-        for k, v in clauses.items():
+        for k, v in eq.items():
             prefix = (K_ATTR + k.encode() + b"\x00" + v.encode() + b"\x00")
             found = {int.from_bytes(key[-8:], "big")
                      for key, _ in self.db.iterate(prefix,
@@ -44,7 +59,26 @@ class BlockIndexer:
             heights = {int.from_bytes(k[len(K_HEIGHT):], "big")
                        for k, _ in self.db.iterate(
                            K_HEIGHT, K_HEIGHT + b"\xff" * 9)}
-        ordered = sorted(heights)
+        kept = []
+        for h in heights:
+            if q is None:
+                kept.append(h)
+                continue
+            raw = self.db.get(K_HEIGHT + h.to_bytes(8, "big"))
+            m: dict[str, list[str]] = {"block.height": [str(h)]}
+            if raw:
+                for etype, attrs in msgpack.unpackb(raw, raw=False):
+                    for k, v in attrs:
+                        m.setdefault(f"{etype}.{k}", []).append(v)
+                conds = q.conditions
+            else:
+                # legacy row (pre-events storage, value b""): only
+                # block.height conditions are decidable; the rest were
+                # already satisfied by posting narrowing for equality
+                conds = [c for c in q.conditions if c.key == "block.height"]
+            if all(c.matches(m.get(c.key)) for c in conds):
+                kept.append(h)
+        ordered = sorted(kept)
         page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
         start = (page - 1) * per_page
         return {"heights": ordered[start:start + per_page],

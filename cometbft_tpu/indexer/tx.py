@@ -56,14 +56,18 @@ class TxIndexer:
         }
 
     def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
-        """Equality-clause search (the subset the event system itself
-        emits); clauses are intersected."""
-        from ..rpc.server import parse_query
+        """Full-grammar search (``libs/query``): plain string-equality
+        clauses narrow candidates via the posting index; every remaining
+        condition (ranges, CONTAINS, EXISTS, numeric equality) post-filters
+        against the record's reconstructed event map — same result as the
+        reference kv indexer's range scans (``state/txindex/kv/kv.go``)."""
+        from ..libs.query import Query
 
-        clauses = parse_query(query)
-        clauses.pop("tm.event", None)        # implied: these are all txs
+        q = Query.parse(query)
+        eq = q.equality_clauses()
+        eq.pop("tm.event", None)             # implied: these are all txs
         result_hashes: set[bytes] | None = None
-        for k, v in clauses.items():
+        for k, v in eq.items():
             found = set()
             prefix = _attr_prefix(k, v)
             for key, _ in self.db.iterate(prefix, prefix + b"\xff" * 9):
@@ -74,13 +78,40 @@ class TxIndexer:
             result_hashes = {k[len(K_TX):]
                              for k, _ in self.db.iterate(
                                  K_TX, K_TX + b"\xff" * 33)}
-        records = sorted(
-            (self.get(h) for h in result_hashes),
-            key=lambda r: (r["height"], r["index"]))
+        records = []
+        for h in result_hashes:
+            raw = self.db.get(K_TX + h)
+            if raw is None:
+                continue
+            d = msgpack.unpackb(raw, raw=False)
+            if q.matches(_event_map(h, d)):
+                records.append({
+                    "hash": h.hex(), "height": d["height"],
+                    "index": d["index"], "tx": d["tx"].hex(),
+                    "tx_result": {"code": d["code"], "log": d["log"],
+                                  "data": d["data"].hex(),
+                                  "gas_used": d["gas_used"]},
+                })
+        records.sort(key=lambda r: (r["height"], r["index"]))
         page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
         start = (page - 1) * per_page
         return {"txs": records[start:start + per_page],
                 "total_count": len(records)}
+
+
+def _event_map(tx_hash: bytes, record: dict) -> dict[str, list[str]]:
+    """Composite-key -> values map for query post-filtering, mirroring the
+    attributes the live event bus publishes for a Tx event."""
+    m: dict[str, list[str]] = {
+        "tm.event": ["Tx"],
+        "tx.height": [str(record["height"])],
+        # lowercase hex, matching the live event bus attr (TxKey().hex())
+        "tx.hash": [tx_hash.hex()],
+    }
+    for etype, attrs in record["events"]:
+        for k, v in attrs:
+            m.setdefault(f"{etype}.{k}", []).append(str(v))
+    return m
 
 
 def _attr_key(key: str, value: str, height: int, tx_hash: bytes) -> bytes:

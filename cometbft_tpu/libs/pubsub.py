@@ -1,16 +1,17 @@
 """Async pub/sub event bus (reference: ``libs/pubsub/pubsub.go`` +
 ``types/event_bus.go``).
 
-Subscriptions match on event type plus optional attribute equality
-constraints (the core of the reference's query language
-``tm.event='Tx' AND tx.hash='...'``; the full query grammar lives in
-``rpc/``'s query compiler).
+Subscriptions match with the full query language of ``libs/query``
+(``tm.event='Tx' AND tx.height > 5 AND app.key CONTAINS 'x'``); plain
+``{attr: value}`` dicts are still accepted as the equality subset.
 """
 
 from __future__ import annotations
 
 import asyncio
 from dataclasses import dataclass, field
+
+from .query import Query
 
 
 @dataclass
@@ -19,14 +20,24 @@ class Message:
     data: object
     attrs: dict[str, str] = field(default_factory=dict)
 
+    def event_map(self) -> dict[str, list[str]]:
+        """The composite-key -> values map the query language evaluates
+        over (reference ``types/event_bus.go`` flattens events the same
+        way before matching)."""
+        m = {k: [v] for k, v in self.attrs.items()}
+        m["tm.event"] = [self.event_type]
+        return m
+
 
 @dataclass
 class Subscription:
-    query: dict[str, str]                # attr -> required value; "" matches
+    query: object                        # Query | dict[str, str]
     queue: asyncio.Queue = field(default_factory=lambda: asyncio.Queue(256))
     unbuffered: bool = False             # guaranteed delivery (indexer)
 
     def matches(self, msg: Message) -> bool:
+        if isinstance(self.query, Query):
+            return self.query.matches(msg.event_map())
         for k, want in self.query.items():
             if k == "tm.event":
                 if msg.event_type != want:
@@ -44,11 +55,15 @@ class EventBus:
     def __init__(self):
         self._subs: dict[str, Subscription] = {}
 
-    def subscribe(self, subscriber: str, query: dict[str, str],
+    def subscribe(self, subscriber: str, query,
                   unbuffered: bool = False) -> Subscription:
-        """``unbuffered=True`` gives an unbounded queue with no drop — for
-        consumers that must see every event (the indexer; the reference's
-        SubscribeUnbuffered in types/event_bus.go)."""
+        """``query`` is a :class:`Query`, a query string (compiled here),
+        or an equality dict.  ``unbuffered=True`` gives an unbounded queue
+        with no drop — for consumers that must see every event (the
+        indexer; the reference's SubscribeUnbuffered in
+        types/event_bus.go)."""
+        if isinstance(query, str):
+            query = Query.parse(query)
         sub = Subscription(query, unbuffered=unbuffered)
         if unbuffered:
             sub.queue = asyncio.Queue()

@@ -62,6 +62,7 @@ class Node:
         self.listen_addr: str | None = None
         self.rpc_server = None
         self.rpc_addr: tuple[str, int] | None = None
+        self.grpc_server = None
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
@@ -120,9 +121,15 @@ class Node:
             # (proxy/client.go remote creator)
             shost, sport = _parse_laddr(cfg.base.proxy_app)
             creator = socket_client_creator(shost, sport)
+        elif cfg.base.abci == "grpc":
+            from ..proxy.multi_app_conn import grpc_client_creator
+
+            ghost, gport = _parse_laddr(cfg.base.proxy_app)
+            creator = grpc_client_creator(ghost, gport)
         else:
             raise ValueError("no application: pass app or configure "
-                             "base.abci='socket' with base.proxy_app addr")
+                             "base.abci='socket'|'grpc' with "
+                             "base.proxy_app addr")
         self.app_conns = AppConns(creator)
         await self.app_conns.start()
         self.event_bus = EventBus()
@@ -291,6 +298,12 @@ class Node:
             rhost, rport = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server = RPCServer(self)
             self.rpc_addr = await self.rpc_server.listen(rhost, rport)
+        if self.config.rpc.grpc_laddr:
+            from ..rpc.grpc import GRPCServer
+
+            ghost, gport = _parse_laddr(self.config.rpc.grpc_laddr)
+            self.grpc_server = GRPCServer(self, ghost, gport)
+            await self.grpc_server.start()
         from ..crypto import batch as cryptobatch
 
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
@@ -323,6 +336,8 @@ class Node:
             self.statesync_done.cancel()
         if self.rpc_server is not None:
             await self.rpc_server.close()
+        if self.grpc_server is not None:
+            await self.grpc_server.stop()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
         if self.pruner is not None:

@@ -35,6 +35,18 @@ def socket_client_creator(host: str = "127.0.0.1", port: int = 26658,
     return create
 
 
+def grpc_client_creator(host: str = "127.0.0.1",
+                        port: int = 26658) -> ClientCreator:
+    """Remote app over gRPC (``proxy/client.go`` grpc creator)."""
+
+    async def create() -> ABCIClient:
+        from ..abci.grpc import GRPCClient
+
+        return await GRPCClient.connect(host, port)
+
+    return create
+
+
 class AppConns:
     def __init__(self, creator: ClientCreator):
         self._creator = creator

@@ -1,0 +1,195 @@
+"""Node gRPC services (reference: ``rpc/grpc/server/services/``):
+version, block, block-results, and the ADR-101 pruning service.
+
+Same transport convention as ``abci/grpc.py``: generic handlers, msgpack
+payload frames ``{ok, result|error}``, no protoc codegen.  The service and
+method names mirror the reference's proto packages
+(``cometbft.services.*.v1``) so a reference user finds the same surface.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import grpc
+import grpc.aio
+import msgpack
+
+from . import core
+from .core import Environment, RPCError
+from .json import jsonable
+
+_PREFIX = "cometbft.services"
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(jsonable(obj), use_bin_type=True, default=str)
+
+
+def _unpack(raw: bytes):
+    return msgpack.unpackb(raw, raw=False, strict_map_key=False) if raw \
+        else {}
+
+
+class GRPCServices(grpc.GenericRpcHandler):
+    """Routes ``/cometbft.services.<svc>.v1.<Svc>Service/<Method>`` to
+    handlers over the same :class:`Environment` the JSON-RPC routes use."""
+
+    def __init__(self, node):
+        self.env = Environment(node)
+        self.node = node
+        self._stream_ids = itertools.count(1)
+        self._unary = {
+            f"/{_PREFIX}.version.v1.VersionService/GetVersion":
+                self._get_version,
+            f"/{_PREFIX}.block.v1.BlockService/GetByHeight":
+                self._get_by_height,
+            f"/{_PREFIX}.block_results.v1.BlockResultsService/"
+            "GetBlockResults": self._get_block_results,
+            f"/{_PREFIX}.pruning.v1.PruningService/SetBlockRetainHeight":
+                self._set_retain,
+            f"/{_PREFIX}.pruning.v1.PruningService/GetBlockRetainHeight":
+                self._get_retain,
+        }
+        self._streaming = {
+            f"/{_PREFIX}.block.v1.BlockService/GetLatestHeight":
+                self._latest_heights,
+        }
+
+    # -- handlers --------------------------------------------------------
+
+    async def _get_version(self, req: dict) -> dict:
+        from .. import __version__
+
+        return {"node": __version__, "abci": "2.0.0", "p2p": 9, "block": 11}
+
+    async def _get_by_height(self, req: dict) -> dict:
+        return await core.block(self.env, height=req.get("height"))
+
+    async def _get_block_results(self, req: dict) -> dict:
+        return await core.block_results(self.env,
+                                        height=req.get("height"))
+
+    async def _set_retain(self, req: dict) -> dict:
+        return await core.set_companion_retain_height(
+            self.env, height=req.get("height", 0))
+
+    async def _get_retain(self, req: dict) -> dict:
+        out = await core.retain_heights(self.env)
+        return {"app_retain_height": out["app_retain_height"],
+                "pruning_service_retain_height":
+                    out["data_companion_retain_height"]}
+
+    async def _latest_heights(self, req: dict):
+        """Server-streaming: the committed height now, then every new one
+        (reference GetLatestHeight streams from the NewBlock event)."""
+        bus = getattr(self.node, "event_bus", None)
+        store = self.env.block_store
+        yield {"height": store.height()}
+        if bus is None:
+            return
+        sid = f"grpc-latest-height-{next(self._stream_ids)}"
+        sub = bus.subscribe(sid, {"tm.event": "NewBlock"})
+        try:
+            while True:
+                msg = await sub.queue.get()
+                yield {"height": msg.data["block"].header.height}
+        finally:
+            bus.unsubscribe(sid)
+
+    # -- grpc plumbing ---------------------------------------------------
+
+    def service(self, details):
+        unary = self._unary.get(details.method)
+        if unary is not None:
+            async def handler(request: bytes, context):
+                try:
+                    return _pack({"ok": True,
+                                  "result": await unary(_unpack(request))})
+                except RPCError as e:
+                    return _pack({"ok": False, "error": e.message,
+                                  "code": e.code})
+                except Exception as e:
+                    return _pack({"ok": False, "error": repr(e)})
+            return grpc.unary_unary_rpc_method_handler(handler)
+        stream = self._streaming.get(details.method)
+        if stream is not None:
+            async def shandler(request: bytes, context):
+                async for item in stream(_unpack(request)):
+                    yield _pack({"ok": True, "result": item})
+            return grpc.unary_stream_rpc_method_handler(shandler)
+        return None
+
+
+class GRPCServer:
+    """The node's gRPC listener (started when ``rpc.grpc_laddr`` is
+    set — reference ``node/node.go`` gRPC block/pruning services)."""
+
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+        self.host = host
+        self.port = port
+        self._server: grpc.aio.Server | None = None
+
+    async def start(self) -> None:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((GRPCServices(self.node),))
+        self.port = self._server.add_insecure_port(
+            f"{self.host}:{self.port}")
+        await self._server.start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=0.2)
+            self._server = None
+
+
+class GRPCServicesClient:
+    """Client for :class:`GRPCServer` (reference
+    ``rpc/grpc/client/client.go``)."""
+
+    def __init__(self, channel: grpc.aio.Channel):
+        self._channel = channel
+
+    @classmethod
+    async def connect(cls, host: str, port: int) -> "GRPCServicesClient":
+        return cls(grpc.aio.insecure_channel(f"{host}:{port}"))
+
+    async def _call(self, method: str, req: dict | None = None):
+        stub = self._channel.unary_unary(method)
+        frame = _unpack(await stub(_pack(req or {})))
+        if not frame.get("ok", False):
+            raise RPCError(frame.get("code", -32603), frame.get("error"))
+        return frame["result"]
+
+    async def get_version(self) -> dict:
+        return await self._call(
+            f"/{_PREFIX}.version.v1.VersionService/GetVersion")
+
+    async def get_block_by_height(self, height: int | None = None) -> dict:
+        return await self._call(
+            f"/{_PREFIX}.block.v1.BlockService/GetByHeight",
+            {"height": height})
+
+    async def get_block_results(self, height: int | None = None) -> dict:
+        return await self._call(
+            f"/{_PREFIX}.block_results.v1.BlockResultsService/"
+            "GetBlockResults", {"height": height})
+
+    async def set_block_retain_height(self, height: int) -> dict:
+        return await self._call(
+            f"/{_PREFIX}.pruning.v1.PruningService/SetBlockRetainHeight",
+            {"height": height})
+
+    async def get_block_retain_height(self) -> dict:
+        return await self._call(
+            f"/{_PREFIX}.pruning.v1.PruningService/GetBlockRetainHeight")
+
+    async def latest_height_stream(self):
+        stub = self._channel.unary_stream(
+            f"/{_PREFIX}.block.v1.BlockService/GetLatestHeight")
+        async for raw in stub(_pack({})):
+            yield _unpack(raw)["result"]
+
+    async def close(self) -> None:
+        await self._channel.close()

@@ -26,6 +26,7 @@ import json
 import struct
 from urllib.parse import parse_qsl, unquote, urlsplit
 
+from ..libs.query import Query, QuerySyntaxError
 from .core import ROUTES, Environment, RPCError
 from .json import jsonable
 
@@ -33,20 +34,33 @@ _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 MAX_BODY = 10 << 20
 
 
+def compile_query(q: str) -> Query:
+    """Compile a query string with the full grammar of ``libs/query``
+    (reference ``libs/pubsub/query``), mapping syntax errors to JSON-RPC
+    invalid-params."""
+    try:
+        return Query.parse(q)
+    except QuerySyntaxError as e:
+        raise RPCError(-32602, f"bad query: {e}") from e
+
+
 def parse_query(q: str) -> dict[str, str]:
-    """``tm.event='NewBlock' AND tx.hash='AB12'`` -> dict (the equality
-    subset of libs/pubsub/query — the only part the reference's own event
-    system uses for subscriptions)."""
-    out = {}
-    for clause in q.split(" AND "):
-        clause = clause.strip()
-        if not clause:
-            continue
-        if "=" not in clause:
-            raise RPCError(-32602, f"bad query clause {clause!r}")
-        k, v = clause.split("=", 1)
-        out[k.strip()] = v.strip().strip("'\"")
-    return out
+    """``tm.event='NewBlock' AND tx.hash='AB12'`` -> equality dict.  Kept
+    for callers that only need the posting-index subset; bare ``=``
+    clauses without quotes are tolerated for CLI ergonomics."""
+    try:
+        return compile_query(q).equality_clauses()
+    except RPCError:
+        out = {}
+        for clause in q.split(" AND "):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if "=" not in clause:
+                raise RPCError(-32602, f"bad query clause {clause!r}")
+            k, v = clause.split("=", 1)
+            out[k.strip()] = v.strip().strip("'\"")
+        return out
 
 
 def _coerce(v: str):
@@ -283,7 +297,7 @@ class _WsSession:
 
     async def _subscribe(self, rid, query: str) -> None:
         try:
-            qdict = parse_query(query)
+            compiled = compile_query(query)
         except RPCError as e:
             await self._send_json(_rpc_error(rid, e.code, e.message))
             return
@@ -296,7 +310,7 @@ class _WsSession:
             await self._send_json(_rpc_error(
                 rid, -32601, "subscriptions not supported on this server"))
             return
-        sub = bus.subscribe(f"{self.sid}:{query}", qdict)
+        sub = bus.subscribe(f"{self.sid}:{query}", compiled)
         self.subs[query] = asyncio.create_task(self._pump(query, sub))
         await self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
 

@@ -23,6 +23,12 @@ from cometbft_tpu.jaxenv import enable_compile_cache, force_cpu_backend  # noqa:
 force_cpu_backend(min_devices=8)
 enable_compile_cache()
 
+# kernel tests must exercise the device code path even when a cold compile
+# outlasts the production watchdog (which would silently host-fallback)
+from cometbft_tpu.crypto import batch as _batch  # noqa: E402
+
+_batch.set_device_wait(900)
+
 
 # ---------------------------------------------------------------------------
 # Real per-test timeout enforcement. ``pytest-timeout`` is not installed in

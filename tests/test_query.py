@@ -1,0 +1,159 @@
+"""Query language tests (reference: ``libs/pubsub/query/query_test.go``)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.libs.query import Query, QuerySyntaxError
+
+
+def m(**kw):
+    return {k.replace("_", "."): (v if isinstance(v, list) else [v])
+            for k, v in kw.items()}
+
+
+def test_equality_and_conjunction():
+    q = Query.parse("tm.event = 'NewBlock' AND block.height = '5'")
+    assert q.matches({"tm.event": ["NewBlock"], "block.height": ["5"]})
+    assert not q.matches({"tm.event": ["Tx"], "block.height": ["5"]})
+    assert not q.matches({"tm.event": ["NewBlock"]})
+    assert q.equality_clauses() == {"tm.event": "NewBlock",
+                                    "block.height": "5"}
+
+
+def test_numeric_comparisons():
+    q = Query.parse("tx.height > 5 AND tx.height <= 10")
+    assert q.matches({"tx.height": ["7"]})
+    assert q.matches({"tx.height": ["10"]})
+    assert not q.matches({"tx.height": ["5"]})
+    assert not q.matches({"tx.height": ["11"]})
+    # unparseable values are skipped, not errors
+    assert not q.matches({"tx.height": ["7atom"]})
+    # floats compare against int conditions
+    assert Query.parse("p.x >= 0.5").matches({"p.x": ["0.75"]})
+    # numeric equality parses the value as a number (07 == 7)
+    assert Query.parse("tx.height = 7").matches({"tx.height": ["07"]})
+
+
+def test_contains_and_exists():
+    q = Query.parse("transfer.amount CONTAINS 'uatom'")
+    assert q.matches({"transfer.amount": ["100uatom"]})
+    assert not q.matches({"transfer.amount": ["100stake"]})
+    q = Query.parse("account.created EXISTS")
+    assert q.matches({"account.created": ["anything"]})
+    assert not q.matches({"other.key": ["x"]})
+
+
+def test_any_value_matches():
+    # a condition is satisfied by ANY value of a repeated attribute
+    q = Query.parse("transfer.to = 'bob'")
+    assert q.matches({"transfer.to": ["alice", "bob"]})
+
+
+def test_time_and_date():
+    q = Query.parse("tx.time >= TIME 2023-05-03T14:45:00Z")
+    assert q.matches({"tx.time": ["2023-05-03T15:00:00Z"]})
+    assert not q.matches({"tx.time": ["2023-05-03T14:00:00Z"]})
+    q = Query.parse("tx.date = DATE 2023-05-03")
+    assert q.matches({"tx.date": ["2023-05-03T00:00:00Z"]})
+
+
+def test_syntax_errors():
+    for bad in ["", "AND", "tm.event =", "tm.event < 'str'", "key CONTAINS 5",
+                "a = 'x' OR b = 'y'", "a = 'x' b = 'y'", "a = 'x' AND"]:
+        with pytest.raises(QuerySyntaxError):
+            Query.parse(bad)
+
+
+def test_escaped_quote_roundtrip():
+    q = Query.parse(r"app.note = 'it\'s'")
+    assert q.matches({"app.note": ["it's"]})
+
+
+def test_event_bus_full_query():
+    from cometbft_tpu.libs.pubsub import EventBus
+
+    async def run():
+        bus = EventBus()
+        sub = bus.subscribe("s", "tm.event='Tx' AND tx.height > 3")
+        bus.publish("Tx", {"n": 1}, {"tx.height": "2"})
+        bus.publish("Tx", {"n": 2}, {"tx.height": "9"})
+        bus.publish("NewBlock", {"n": 3}, {"tx.height": "9"})
+        got = sub.queue.get_nowait()
+        assert got.data == {"n": 2}
+        assert sub.queue.empty()
+    asyncio.run(run())
+
+
+def test_tx_indexer_range_search():
+    from cometbft_tpu.abci.types import Event, EventAttribute, ExecTxResult
+    from cometbft_tpu.indexer.tx import TxIndexer
+
+    ix = TxIndexer()
+    for h in range(1, 8):
+        res = ExecTxResult(code=0, data=b"", log="", gas_wanted=0, gas_used=1,
+                       events=[Event("transfer",
+                                     [EventAttribute("amount",
+                                                     f"{h}00uatom")])])
+        ix.index(h, 0, b"tx%d" % h, res, {})
+    out = ix.search("tx.height > 2 AND tx.height <= 5")
+    assert [r["height"] for r in out["txs"]] == [3, 4, 5]
+    out = ix.search("transfer.amount CONTAINS '00uatom' AND tx.height < 3")
+    assert [r["height"] for r in out["txs"]] == [1, 2]
+    out = ix.search("transfer.amount = '300uatom'")
+    assert [r["height"] for r in out["txs"]] == [3]
+
+
+def test_tx_indexer_hash_search():
+    from cometbft_tpu.abci.types import ExecTxResult
+    from cometbft_tpu.indexer.tx import TxIndexer
+    from cometbft_tpu.mempool.mempool import TxKey
+
+    ix = TxIndexer()
+    ix.index(4, 0, b"mytx", ExecTxResult(), {"tx.hash": TxKey(b"mytx").hex()})
+    out = ix.search(f"tx.hash='{TxKey(b'mytx').hex()}'")
+    assert out["total_count"] == 1 and out["txs"][0]["height"] == 4
+
+
+def test_block_indexer_tm_event_tolerated():
+    from cometbft_tpu.abci.types import Event, EventAttribute
+    from cometbft_tpu.indexer.block import BlockIndexer
+
+    ix = BlockIndexer()
+    ix.index(1, [Event("reward", [EventAttribute("amt", "10")])])
+    # any tm.event value is tolerated: all records here are block events
+    for ev in ("NewBlock", "NewBlockEvents"):
+        out = ix.search(f"tm.event='{ev}' AND block.height=1")
+        assert out["heights"] == [1], ev
+    assert ix.search("tm.event='NewBlock'")["heights"] == [1]
+
+
+def test_block_indexer_legacy_empty_record():
+    """Rows written before events were stored (value b'') must stay
+    findable through postings + height conditions."""
+    from cometbft_tpu.indexer.block import BlockIndexer, K_ATTR, K_HEIGHT
+
+    ix = BlockIndexer()
+    h8 = (5).to_bytes(8, "big")
+    ix.db.set_batch({
+        K_HEIGHT + h8: b"",
+        K_ATTR + b"reward.amt\x00" + b"50\x00" + h8: b"",
+    })
+    assert ix.search("reward.amt='50'")["heights"] == [5]
+    assert ix.search("reward.amt='50' AND block.height <= 5")["heights"] == [5]
+    assert ix.search("block.height > 5")["heights"] == []
+
+
+def test_block_indexer_range_search():
+    from cometbft_tpu.abci.types import Event, EventAttribute
+    from cometbft_tpu.indexer.block import BlockIndexer
+
+    ix = BlockIndexer()
+    for h in range(1, 8):
+        ix.index(h, [Event("reward", [EventAttribute("amt", str(h * 10))])])
+    out = ix.search("block.height >= 6")
+    assert out["heights"] == [6, 7]
+    out = ix.search("reward.amt = 30")
+    assert out["heights"] == [3]
+    out = ix.search("reward.amt EXISTS AND block.height < 3")
+    assert out["heights"] == [1, 2]
