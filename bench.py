@@ -150,6 +150,102 @@ def _child_blocksync(backend: str, n_blocks: int, n_vals: int) -> None:
     }), flush=True)
 
 
+def _child_verifycommit(backend: str, n_vals: int) -> None:
+    """One VerifyCommitLight call at commit scale (BASELINE configs[2]:
+    150-validator commit, CPU vs TPU backend through the seam)."""
+    note, kernel_backend = _mode_child_setup("vc", backend)
+
+    from cometbft_tpu.testing import make_light_chain
+    from cometbft_tpu.types.validation import VerifyCommitLight
+
+    note(f"building one commit @ {n_vals} validators")
+    lb = make_light_chain(1, n_vals=n_vals)[0]
+
+    note("seam verification (cold: includes compile)")
+    cold, warm = _timed_cold_warm(lambda: VerifyCommitLight(
+        "light-chain", lb.validators, lb.commit.block_id, lb.height,
+        lb.commit, backend=kernel_backend))
+
+    note("host baseline")
+    t0 = time.perf_counter()
+    VerifyCommitLight("light-chain", lb.validators, lb.commit.block_id,
+                      lb.height, lb.commit, backend="cpu")
+    host = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": f"VerifyCommitLight latency ({n_vals}-validator commit)",
+        "value": round(warm * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(host / warm, 2),
+        "cold_s": round(cold, 3),
+        "host_s": round(host, 4),
+        "backend": backend,
+    }), flush=True)
+
+
+def _child_stress(backend: str, n_vals: int, secp_pct: int) -> None:
+    """BASELINE configs[5]: ExtendedCommit-scale batch with vote
+    extensions and mixed secp256k1 keys.  Two signatures per validator
+    (precommit + extension); ed25519 lanes ride the device, secp256k1
+    lanes take the CPU route inside the same TpuBatchVerifier — the
+    mixed-routing improvement over the reference's refusal to batch
+    mixed key sets (types/validation.go:13-19)."""
+    note, kernel_backend = _mode_child_setup("stress", backend)
+
+    from cometbft_tpu.crypto.batch import create_batch_verifier
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.canonical import (
+        canonical_vote_extension_sign_bytes, canonical_vote_sign_bytes)
+    from cometbft_tpu.types.vote import PRECOMMIT_TYPE
+
+    n_secp = n_vals * secp_pct // 100
+    note(f"building {n_vals}-val extended commit ({n_secp} secp256k1)")
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    items = []                      # (pub, msg, sig) x2 per validator
+    for i in range(n_vals):
+        if i < n_secp:
+            priv = Secp256k1PrivKey.from_secret(b"stress%d" % i)
+        else:
+            priv = Ed25519PrivKey.from_secret(b"stress%d" % i)
+        sb = canonical_vote_sign_bytes("stress", PRECOMMIT_TYPE, 5, 0,
+                                       bid, 1_700_000_000_000_000_000 + i)
+        eb = canonical_vote_extension_sign_bytes("stress", 5, 0,
+                                                 b"ext%d" % i)
+        items.append((priv.pub_key(), sb, priv.sign(sb)))
+        items.append((priv.pub_key(), eb, priv.sign(eb)))
+
+    def run_batch():
+        bv = create_batch_verifier(kernel_backend)
+        for pub, msg, sig in items:
+            bv.add(pub, msg, sig)
+        ok, _ = bv.verify()
+        assert ok
+
+    note("mixed batch verification (cold: includes compile)")
+    cold, warm = _timed_cold_warm(run_batch)
+
+    note("host baseline (single verifies, stride-sampled so the "
+         "key-type mix matches the batch)")
+    sample = items[::max(1, len(items) // 512)]
+    t0 = time.perf_counter()
+    for pub, msg, sig in sample:
+        assert pub.verify_signature(msg, sig)
+    host = (time.perf_counter() - t0) / len(sample) * len(items)
+
+    print(json.dumps({
+        "metric": f"mixed-key extended-commit verify ({n_vals} vals, "
+                  f"{secp_pct}% secp256k1, 2 sigs/val)",
+        "value": round(len(items) / warm, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(host / warm, 2),
+        "p50_batch_latency_ms": round(warm * 1e3, 3),
+        "cold_s": round(cold, 3),
+        "backend": backend,
+    }), flush=True)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
     if mode == "light":
@@ -160,6 +256,13 @@ def _child_main(backend: str, nsig: int) -> None:
         return _child_blocksync(backend,
                                 int(os.environ.get("BENCH_BLOCKS", "500")),
                                 int(os.environ.get("BENCH_VALS", "32")))
+    if mode == "verifycommit":
+        return _child_verifycommit(backend,
+                                   int(os.environ.get("BENCH_VALS", "150")))
+    if mode == "stress":
+        return _child_stress(backend,
+                             int(os.environ.get("BENCH_VALS", "10000")),
+                             int(os.environ.get("BENCH_SECP_PCT", "10")))
 
     def note(msg):
         print(f"[bench:{backend}] {msg}", file=sys.stderr, flush=True)
@@ -291,6 +394,8 @@ def main() -> None:
         "light": ("light-client sequential sync, headers/sec",
                   "headers/s"),
         "blocksync": ("blocksync replay, blocks/sec", "blocks/s"),
+        "verifycommit": ("VerifyCommitLight latency", "ms"),
+        "stress": ("mixed-key extended-commit verify", "sigs/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

@@ -267,6 +267,40 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def _rpc_client(addr: str):
+    from ..rpc.client import HTTPClient
+
+    host, _, port = addr.rpartition(":")
+    return HTTPClient(host or "127.0.0.1", int(port))
+
+
+def cmd_load(args) -> int:
+    """test/loadtime generator: timestamped txs at a fixed rate."""
+    from .. import loadtime
+
+    async def go():
+        client = _rpc_client(args.rpc)
+        out = await loadtime.generate(client, args.rate, args.duration,
+                                      tx_size=args.size)
+        print(json.dumps(out))
+
+    asyncio.run(go())
+    return 0
+
+
+def cmd_load_report(args) -> int:
+    """test/loadtime/report: per-tx latency from committed chain data."""
+    from .. import loadtime
+
+    async def go():
+        client = _rpc_client(args.rpc)
+        out = await loadtime.report(client, run_id=args.run_id)
+        print(json.dumps(out))
+
+    asyncio.run(go())
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """commands/inspect.go: read-only RPC over a crashed node's data dir."""
     return asyncio.run(_inspect_async(args))
@@ -398,6 +432,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--hard", action="store_true",
                     help="also remove the block itself")
     sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("load", help="drive timestamped load at a node "
+                                     "(test/loadtime generator)")
+    sp.add_argument("--rpc", default="127.0.0.1:26657")
+    sp.add_argument("--rate", type=float, default=100.0, help="tx/s")
+    sp.add_argument("--duration", type=float, default=10.0, help="seconds")
+    sp.add_argument("--size", type=int, default=256, help="tx bytes")
+    sp.set_defaults(fn=cmd_load)
+
+    sp = sub.add_parser("load-report",
+                        help="latency distribution of committed load txs")
+    sp.add_argument("--rpc", default="127.0.0.1:26657")
+    sp.add_argument("--run-id", default=None)
+    sp.set_defaults(fn=cmd_load_report)
     return p
 
 

@@ -77,6 +77,10 @@ class P2PConfig:
     recv_rate: int = 5 * 1024 * 1024
     pex: bool = True
     pex_interval_seconds: float = 30.0     # ensurePeersPeriod
+    # one-way inter-node delay injected at the MConnection receive side;
+    # the e2e runner uses it to emulate geo-distribution on one machine
+    # (reference test/e2e/runner/latency_emulation.go)
+    emulated_latency_ms: float = 0.0
     addr_book_path: str = "config/addrbook.json"
 
 
@@ -135,6 +139,9 @@ class BaseConfig:
     # latency dominates tiny batches); device warmup pre-compiles the
     # hot bucket shapes at node start
     min_device_lanes: int = 64
+    # bound on how long one verification may wait for the accelerator
+    # before host fallback (crypto/batch._device_call); 0 = library default
+    device_wait_s: float = 0.0
     device_warmup: bool = True
 
 

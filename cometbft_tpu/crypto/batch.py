@@ -200,7 +200,10 @@ def _metrics():
     )
 
 
-_DEVICE_WAIT_S = 30.0            # max time a verify waits on the device
+_DEVICE_WAIT_S = 2.0             # max time a verify waits on the device:
+#   below the p2p pong timeout (5 s), so even the FIRST wedged dispatch
+#   cannot make peers drop the node; a compile that outlasts the wait
+#   finishes on the worker thread and the device resumes on a later batch
 _DEVICE_POOL = None              # single dispatch thread owning the chip
 _DEVICE_INFLIGHT = None          # last submitted future (may be stuck)
 

@@ -46,14 +46,7 @@ class TxIndexer:
         raw = self.db.get(K_TX + tx_hash)
         if raw is None:
             return None
-        d = msgpack.unpackb(raw, raw=False)
-        return {
-            "hash": tx_hash.hex(), "height": d["height"],
-            "index": d["index"], "tx": d["tx"].hex(),
-            "tx_result": {"code": d["code"], "log": d["log"],
-                          "data": d["data"].hex(),
-                          "gas_used": d["gas_used"]},
-        }
+        return _record(tx_hash, msgpack.unpackb(raw, raw=False))
 
     def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
         """Full-grammar search (``libs/query``): plain string-equality
@@ -85,18 +78,24 @@ class TxIndexer:
                 continue
             d = msgpack.unpackb(raw, raw=False)
             if q.matches(_event_map(h, d)):
-                records.append({
-                    "hash": h.hex(), "height": d["height"],
-                    "index": d["index"], "tx": d["tx"].hex(),
-                    "tx_result": {"code": d["code"], "log": d["log"],
-                                  "data": d["data"].hex(),
-                                  "gas_used": d["gas_used"]},
-                })
+                records.append(_record(h, d))
         records.sort(key=lambda r: (r["height"], r["index"]))
         page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
         start = (page - 1) * per_page
         return {"txs": records[start:start + per_page],
                 "total_count": len(records)}
+
+
+def _record(tx_hash: bytes, d: dict) -> dict:
+    """The tx endpoint/search response shape, built from a decoded
+    stored record (single source of truth for both)."""
+    return {
+        "hash": tx_hash.hex(), "height": d["height"],
+        "index": d["index"], "tx": d["tx"].hex(),
+        "tx_result": {"code": d["code"], "log": d["log"],
+                      "data": d["data"].hex(),
+                      "gas_used": d["gas_used"]},
+    }
 
 
 def _event_map(tx_hash: bytes, record: dict) -> dict[str, list[str]]:

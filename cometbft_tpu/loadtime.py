@@ -1,0 +1,116 @@
+"""Load generation + latency reporting (reference: ``test/loadtime/`` —
+a tx generator that embeds send timestamps, and a report tool that
+recovers per-tx latency from committed chain data).
+
+Load txs are kvstore-compatible ``k=v`` pairs::
+
+    load:<run-id>:<seq>=<send_time_ns_hex>:<padding>
+
+The report scans committed blocks over RPC and, for every load tx,
+computes ``block_time - send_time`` (the reference's
+``loadtime/report`` does exactly this from the tx payload timestamp
+and the block header time), then prints distribution statistics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+PREFIX = b"load:"
+
+
+def make_load_tx(run_id: str, seq: int, size: int = 256,
+                 now_ns: int | None = None) -> bytes:
+    t = time.time_ns() if now_ns is None else now_ns
+    key = b"%s%s:%d" % (PREFIX, run_id.encode(), seq)
+    body = key + b"=" + format(t, "x").encode() + b":"
+    pad = max(0, size - len(body))
+    return body + b"x" * pad
+
+
+def parse_load_tx(tx: bytes) -> tuple[str, int, int] | None:
+    """-> (run_id, seq, send_time_ns) or None for non-load txs."""
+    if not tx.startswith(PREFIX) or b"=" not in tx:
+        return None
+    key, val = tx.split(b"=", 1)
+    try:
+        run_id, seq = key[len(PREFIX):].rsplit(b":", 1)
+        t_hex = val.split(b":", 1)[0]
+        return run_id.decode(), int(seq), int(t_hex, 16)
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+async def generate(client, rate: float, duration_s: float,
+                   tx_size: int = 256, run_id: str | None = None,
+                   broadcast: str = "broadcast_tx_async") -> dict:
+    """Drive ``rate`` tx/s at a node for ``duration_s`` through the RPC
+    client (loadtime's generator loop, minus the UUID machinery)."""
+    run_id = run_id or format(int(time.time()) & 0xFFFFFF, "x")
+    interval = 1.0 / rate
+    sent = errors = 0
+    t_end = time.monotonic() + duration_s
+    next_at = time.monotonic()
+    while time.monotonic() < t_end:
+        tx = make_load_tx(run_id, sent, tx_size)
+        try:
+            await client.call(broadcast, tx=tx.hex())
+            sent += 1
+        except Exception:
+            errors += 1
+        next_at += interval
+        delay = next_at - time.monotonic()
+        if delay > 0:
+            await asyncio.sleep(delay)
+    return {"run_id": run_id, "sent": sent, "errors": errors,
+            "rate": rate, "duration_s": duration_s}
+
+
+async def report(client, run_id: str | None = None,
+                 min_height: int = 1) -> dict:
+    """Scan the chain via RPC and compute the latency distribution of
+    committed load txs (loadtime/report's ``Report`` statistics)."""
+    st = await client.call("status")
+    tip = st["sync_info"]["latest_block_height"]
+    latencies_ns: list[int] = []
+    first_h = last_h = None
+    block_times: list[int] = []
+    for h in range(max(1, min_height), tip + 1):
+        blk = await client.call("block", height=h)
+        hdr = blk["block"]["hdr"]
+        block_times.append(hdr["ts"])
+        for tx_hex in blk["block"]["data"]["txs"]:
+            tx = bytes.fromhex(tx_hex["~b"]) if isinstance(tx_hex, dict) \
+                else bytes.fromhex(tx_hex)
+            parsed = parse_load_tx(tx)
+            if parsed is None:
+                continue
+            rid, _seq, t_send = parsed
+            if run_id is not None and rid != run_id:
+                continue
+            latencies_ns.append(hdr["ts"] - t_send)
+            first_h = h if first_h is None else first_h
+            last_h = h
+    if not latencies_ns:
+        return {"txs": 0}
+    lat_s = sorted(x / 1e9 for x in latencies_ns)
+
+    def pct(p):
+        return lat_s[min(len(lat_s) - 1, int(p * len(lat_s)))]
+
+    window_s = (block_times[-1] - block_times[0]) / 1e9 \
+        if len(block_times) > 1 else 0.0
+    return {
+        "txs": len(lat_s),
+        "blocks": (last_h - first_h + 1) if first_h else 0,
+        "min_s": round(lat_s[0], 4),
+        "p50_s": round(pct(0.50), 4),
+        "p90_s": round(pct(0.90), 4),
+        "p99_s": round(pct(0.99), 4),
+        "max_s": round(lat_s[-1], 4),
+        "avg_s": round(sum(lat_s) / len(lat_s), 4),
+        "throughput_tx_s": round(len(lat_s) / window_s, 2)
+        if window_s > 0 else None,
+    }

@@ -38,6 +38,35 @@ def _parse_laddr(laddr: str) -> tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+async def _serve_prometheus(laddr: str):
+    """Standalone Prometheus exposition listener (reference:
+    ``node/node.go`` Prometheus server on instrumentation.prometheus);
+    the JSON-RPC server also serves ``GET /metrics``, this is the
+    dedicated scrape port."""
+    import asyncio as _aio
+
+    from ..libs import metrics as _metrics
+
+    host, port = _parse_laddr(laddr)
+
+    async def handle(reader, writer):
+        try:
+            await reader.readline()                 # request line; ignore
+            while (await reader.readline()).strip():
+                pass                                # drain headers
+            body = _metrics.DEFAULT.collect().encode()
+            writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
+                         b"version=0.0.4\r\nContent-Length: "
+                         + str(len(body)).encode() + b"\r\n\r\n" + body)
+            await writer.drain()
+        except (ConnectionError, _aio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    return await _aio.start_server(handle, host, port)
+
+
 class Node:
     def __init__(self):
         # populated by create(); kept flat for introspection/RPC
@@ -63,6 +92,7 @@ class Node:
         self.rpc_server = None
         self.rpc_addr: tuple[str, int] | None = None
         self.grpc_server = None
+        self.prometheus_server = None
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
@@ -197,7 +227,9 @@ class Node:
 
         self.node_key = node_key or NodeKey.generate()
         self.transport = Transport(self.node_key, self._node_info)
-        self.switch = Switch(self.transport)
+        self.switch = Switch(
+            self.transport,
+            emulated_latency=cfg.p2p.emulated_latency_ms / 1e3)
         if cfg.tx_index.indexer == "kv":
             from ..indexer import BlockIndexer, IndexerService, TxIndexer
 
@@ -304,9 +336,14 @@ class Node:
             ghost, gport = _parse_laddr(self.config.rpc.grpc_laddr)
             self.grpc_server = GRPCServer(self, ghost, gport)
             await self.grpc_server.start()
+        if self.config.instrumentation.prometheus:
+            self.prometheus_server = await _serve_prometheus(
+                self.config.instrumentation.prometheus_listen_addr)
         from ..crypto import batch as cryptobatch
 
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
+        if self.config.base.device_wait_s > 0:
+            cryptobatch.set_device_wait(self.config.base.device_wait_s)
         if self.config.base.device_warmup and \
                 self.config.base.signature_backend in ("tpu", "jax",
                                                        "auto"):
@@ -338,6 +375,8 @@ class Node:
             await self.rpc_server.close()
         if self.grpc_server is not None:
             await self.grpc_server.stop()
+        if self.prometheus_server is not None:
+            self.prometheus_server.close()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
         if self.pruner is not None:

@@ -62,7 +62,8 @@ class MConnection:
                  ping_interval: float = DEFAULT_PING_INTERVAL,
                  pong_timeout: float = DEFAULT_PONG_TIMEOUT,
                  send_rate: float | None = None,
-                 recv_rate: float | None = None):
+                 recv_rate: float | None = None,
+                 emulated_latency: float = 0.0):
         self.conn = conn
         self.channels: dict[int, _Channel] = {
             d.channel_id: _Channel(d) for d in channels}
@@ -74,6 +75,11 @@ class MConnection:
         self.recv_monitor = Monitor()
         self.send_rate = send_rate
         self.recv_rate = recv_rate
+        # one-way latency emulation (the reference injects tc-netem delays
+        # between e2e containers, test/e2e/runner/latency_emulation.go;
+        # here completed messages are dispatched after a timer so latency
+        # rises without throttling bandwidth)
+        self.emulated_latency = emulated_latency
         self._send_wakeup = asyncio.Event()
         self._pong_due: float | None = None
         self._pong_to_send = False
@@ -236,7 +242,25 @@ class MConnection:
         if packet.get("e"):
             msg = bytes(ch.recv_buf)
             ch.recv_buf.clear()
-            self.on_receive(ch.desc.channel_id, msg)
+            if self.emulated_latency > 0:
+                # equal delays preserve delivery order (asyncio timer
+                # heap breaks ties by schedule sequence)
+                asyncio.get_running_loop().call_later(
+                    self.emulated_latency, self._deliver_delayed,
+                    ch.desc.channel_id, msg)
+            else:
+                self.on_receive(ch.desc.channel_id, msg)
+
+    def _deliver_delayed(self, chan_id: int, msg: bytes) -> None:
+        """Latency-emulated delivery with the same error semantics as the
+        inline path: reactor exceptions fail the connection, and nothing
+        is delivered after the connection stopped."""
+        if self._stopped:
+            return
+        try:
+            self.on_receive(chan_id, msg)
+        except Exception as e:
+            self._fail(e)
 
     # ----------------------------------------------------------------- ping
 

@@ -28,8 +28,10 @@ class SwitchError(Exception):
 
 class Switch:
     def __init__(self, transport: Transport,
-                 ping_interval: float = 10.0, pong_timeout: float = 5.0):
+                 ping_interval: float = 10.0, pong_timeout: float = 5.0,
+                 emulated_latency: float = 0.0):
         self.transport = transport
+        self.emulated_latency = emulated_latency
         self.reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._descriptors: list[ChannelDescriptor] = []
@@ -109,7 +111,8 @@ class Switch:
 
         mconn = MConnection(conn, self._descriptors, on_receive, on_error,
                             ping_interval=self.ping_interval,
-                            pong_timeout=self.pong_timeout)
+                            pong_timeout=self.pong_timeout,
+                            emulated_latency=self.emulated_latency)
         peer = Peer(node_info, mconn, outbound, persistent, dial_addr)
         peer_box.append(peer)
         self.peers[peer.id] = peer

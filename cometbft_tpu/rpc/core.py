@@ -431,18 +431,28 @@ async def tx(env: Environment, hash=None, prove=False) -> dict:
 
 async def tx_search(env: Environment, query="", page=1,
                     per_page=30) -> dict:
+    from ..libs.query import QuerySyntaxError
+
     indexer = getattr(env.node, "tx_indexer", None)
     if indexer is None:
         raise RPCError(-32603, "transaction indexing is disabled")
-    return indexer.search(query, int(page), int(per_page))
+    try:
+        return indexer.search(query, int(page), int(per_page))
+    except QuerySyntaxError as e:
+        raise RPCError(-32602, f"bad query: {e}") from e
 
 
 async def block_search(env: Environment, query="", page=1,
                        per_page=30) -> dict:
+    from ..libs.query import QuerySyntaxError
+
     indexer = getattr(env.node, "block_indexer", None)
     if indexer is None:
         raise RPCError(-32603, "block indexing is disabled")
-    return indexer.search(query, int(page), int(per_page))
+    try:
+        return indexer.search(query, int(page), int(per_page))
+    except QuerySyntaxError as e:
+        raise RPCError(-32602, f"bad query: {e}") from e
 
 
 ROUTES = {

@@ -46,10 +46,13 @@ def compile_query(q: str) -> Query:
 
 def parse_query(q: str) -> dict[str, str]:
     """``tm.event='NewBlock' AND tx.hash='AB12'`` -> equality dict.  Kept
-    for callers that only need the posting-index subset; bare ``=``
-    clauses without quotes are tolerated for CLI ergonomics."""
+    for callers that only need the posting-index subset: a query with any
+    non-equality condition is REJECTED here (use ``compile_query`` for
+    the full grammar) so an empty/partial dict can never silently match
+    everything.  Bare ``=`` clauses without quotes are tolerated for CLI
+    ergonomics."""
     try:
-        return compile_query(q).equality_clauses()
+        compiled = compile_query(q)
     except RPCError:
         out = {}
         for clause in q.split(" AND "):
@@ -61,6 +64,12 @@ def parse_query(q: str) -> dict[str, str]:
             k, v = clause.split("=", 1)
             out[k.strip()] = v.strip().strip("'\"")
         return out
+    eq = compiled.equality_clauses()
+    if len(eq) != len(compiled.conditions):
+        raise RPCError(-32602,
+                       "query has non-equality conditions; this endpoint "
+                       "supports the equality subset only")
+    return eq
 
 
 def _coerce(v: str):

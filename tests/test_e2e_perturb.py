@@ -1,0 +1,258 @@
+"""E2E perturbations + latency emulation (reference:
+``test/e2e/runner/perturb.go`` — disconnect/kill/pause/restart — and
+``test/e2e/runner/latency_emulation.go``).
+
+The pause perturbation uses real SIGSTOP/SIGCONT on a node OS process
+(the in-one-machine analogue of ``docker pause``); the disconnect
+perturbation drops every peer connection of a live in-proc node and
+relies on persistent-peer reconnection.  After every perturbation the
+network must stabilize: all nodes advance and agree on block hashes.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 28860
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------- multi-process: pause
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu", *args],
+        capture_output=True, text=True, env=env, cwd=REPO)
+
+
+def _patch_configs(base, n=4):
+    from cometbft_tpu.config import Config
+
+    for i in range(n):
+        cfgp = f"{base}/node{i}/config/config.toml"
+        cfg = Config.load(cfgp)
+        cfg.consensus.timeout_propose = 300_000_000
+        cfg.consensus.timeout_propose_delta = 100_000_000
+        cfg.consensus.timeout_prevote = 150_000_000
+        cfg.consensus.timeout_prevote_delta = 50_000_000
+        cfg.consensus.timeout_precommit = 150_000_000
+        cfg.consensus.timeout_precommit_delta = 50_000_000
+        cfg.consensus.timeout_commit = 100_000_000
+        cfg.base.signature_backend = "cpu"
+        cfg.save(cfgp)
+
+
+def _spawn(base, i):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu",
+         "--home", f"{base}/node{i}", "start"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO)
+
+
+async def _rpc_clients(n):
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+
+    clients = [HTTPClient("127.0.0.1", BASE_PORT + 2 * i + 1)
+               for i in range(n)]
+
+    async def wait_rpc(cli, timeout=90.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                return await cli.call("status")
+            except (OSError, RPCError, asyncio.TimeoutError):
+                await asyncio.sleep(0.3)
+        raise TimeoutError("rpc never came up")
+
+    for cli in clients:
+        await wait_rpc(cli)
+    return clients
+
+
+async def _wait_all_beyond(clients, h, timeout=90.0):
+    from cometbft_tpu.rpc import RPCError
+
+    deadline = time.monotonic() + timeout
+    for cli in clients:
+        while True:
+            try:
+                st = await cli.call("status")
+                if st["sync_info"]["latest_block_height"] >= h:
+                    break
+            except (OSError, RPCError, asyncio.TimeoutError):
+                pass
+            assert time.monotonic() < deadline, f"stuck below {h}"
+            await asyncio.sleep(0.3)
+
+
+async def _assert_agreement(clients, h):
+    hashes = set()
+    for cli in clients:
+        blk = await cli.call("block", height=h)
+        hashes.add(blk["block_id"]["hash"]["~b"])
+    assert len(hashes) == 1, f"fork at {h}: {hashes}"
+
+
+def test_pause_resume_node_sigstop(tmp_path):
+    """SIGSTOP a validator for several blocks; the other 3 keep the chain
+    live (>2/3), and after SIGCONT the paused node catches up and agrees."""
+    base = str(tmp_path / "net")
+    res = _run_cli("testnet", "--v", "4", "--output-dir", base,
+                   "--base-port", str(BASE_PORT), "--chain-id", "pause-net")
+    assert res.returncode == 0, res.stderr
+    _patch_configs(base)
+    procs = [_spawn(base, i) for i in range(4)]
+    try:
+        async def scenario():
+            clients = await _rpc_clients(4)
+            await _wait_all_beyond(clients, 3)
+
+            # pause node3 (docker-pause analogue)
+            procs[3].send_signal(signal.SIGSTOP)
+            st = await clients[0].call("status")
+            h0 = st["sync_info"]["latest_block_height"]
+            # chain stays live without it
+            await _wait_all_beyond(clients[:3], h0 + 4)
+
+            procs[3].send_signal(signal.SIGCONT)
+            st = await clients[0].call("status")
+            target = st["sync_info"]["latest_block_height"] + 2
+            # resumed node catches up to the moving tip
+            await _wait_all_beyond(clients, target, timeout=120)
+            await _assert_agreement(clients, target)
+
+        run(scenario())
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# ----------------------------------------- in-proc: disconnect + latency
+
+def _genesis(n, chain_id):
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    pvs = [MockPV.from_secret(b"pert%d" % i) for i in range(n)]
+    doc = GenesisDoc(chain_id=chain_id,
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+    return doc, pvs
+
+
+async def _make_net(n, chain_id, latency_ms=0.0):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+
+    doc, pvs = _genesis(n, chain_id)
+    nodes = []
+    for i in range(n):
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.p2p.emulated_latency_ms = latency_ms
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pvs[i], config=cfg,
+            node_key=NodeKey.from_secret(b"pk%d" % i), name=f"pert{i}")
+        nodes.append(node)
+    for node in nodes:
+        await node.start()
+    for i, a in enumerate(nodes):
+        for b in nodes[i + 1:]:
+            await a.dial_peer(b.listen_addr, persistent=True)
+    return nodes
+
+
+async def _wait_height(nodes, h, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while not all(n.height() >= h for n in nodes):
+        assert time.monotonic() < deadline, \
+            f"heights {[n.height() for n in nodes]} stuck below {h}"
+        await asyncio.sleep(0.05)
+
+
+def test_disconnect_perturbation():
+    """Dropping every peer connection of one node mid-run: persistent-peer
+    reconnection restores it and the chain continues fork-free."""
+
+    async def main():
+        nodes = await _make_net(4, "disc-net")
+        try:
+            await _wait_height(nodes, 3)
+            victim = nodes[2]
+            for peer in list(victim.switch.peers.values()):
+                await victim.switch.stop_peer_for_error(
+                    peer, RuntimeError("perturbation: disconnect"))
+            h0 = max(n.height() for n in nodes)
+            await _wait_height(nodes, h0 + 5)
+            for h in range(1, h0 + 5):
+                hashes = {n.block_store.load_block(h).hash() for n in nodes
+                          if n.block_store.load_block(h) is not None}
+                assert len(hashes) == 1, f"fork at {h}"
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
+
+
+def test_latency_emulation_liveness():
+    """With 60 ms emulated one-way latency (WAN-ish), a 4-node net keeps
+    committing; latency shows up as slower blocks, not forks — the
+    reference QA observes the same (rounds rise, liveness holds)."""
+
+    async def main():
+        nodes = await _make_net(4, "lat-net", latency_ms=60.0)
+        try:
+            t0 = time.monotonic()
+            await _wait_height(nodes, 5)
+            elapsed = time.monotonic() - t0
+            for h in range(1, 6):
+                hashes = {n.block_store.load_block(h).hash() for n in nodes}
+                assert len(hashes) == 1, f"fork at {h}"
+            # sanity: latency actually took effect on the wire
+            assert all(
+                any(getattr(p.mconn, "emulated_latency", 0) == 0.06
+                    for p in n.switch.peers.values())
+                for n in nodes if n.switch.peers)
+            return elapsed
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+
+    elapsed = run(main())
+    assert elapsed is not None

@@ -1,0 +1,204 @@
+"""Randomized robustness tests (reference: ``test/fuzz/`` — mempool
+CheckTx, SecretConnection read/write, JSON-RPC server).
+
+Go's fuzzer explores inputs coverage-guided; here a seeded PRNG drives a
+few thousand adversarial inputs per surface with the same bar: the
+component must never crash the process, hang, or corrupt state — malformed
+input produces an error (or a closed connection), nothing else.
+"""
+
+import asyncio
+import os
+import random
+import struct
+
+import pytest
+
+SEED = int(os.environ.get("FUZZ_SEED", "20260730"))
+N = int(os.environ.get("FUZZ_ITERS", "300"))
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _rand_bytes(rng: random.Random, max_len: int = 512) -> bytes:
+    return rng.randbytes(rng.randint(0, max_len))
+
+
+# ------------------------------------------------------------- mempool
+
+def test_fuzz_mempool_checktx():
+    """Arbitrary tx bytes through CheckTx never crash the mempool; state
+    stays consistent (size == committed set of valid txs)."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.proxy import AppConns, local_client_creator
+
+    async def main():
+        rng = random.Random(SEED)
+        conns = AppConns(local_client_creator(KVStoreApplication()))
+        await conns.start()
+        mp = CListMempool(conns.mempool, max_txs=1000)
+        for _ in range(N):
+            tx = _rand_bytes(rng, 64)
+            try:
+                await mp.check_tx(tx)
+            except Exception as e:
+                # only the mempool-domain rejection is acceptable
+                assert type(e).__name__ == "TxRejectedError", e
+        assert mp.size() <= 1000
+        reaped = mp.reap_max_bytes_max_gas(10 << 20, -1)
+        assert len(reaped) == mp.size()
+        await conns.stop()
+        return True
+
+    assert run(main())
+
+
+# ----------------------------------------------------- secret connection
+
+def test_fuzz_secret_connection_frames():
+    """Garbage and bit-flipped ciphertext on an established
+    SecretConnection must raise/close, never hang or decrypt."""
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.secret_connection import (SecretConnectionError,
+                                                    handshake)
+
+    async def main():
+        rng = random.Random(SEED + 1)
+        server_done = asyncio.Event()
+        results = {}
+
+        async def server(reader, writer):
+            try:
+                sc = await handshake(reader, writer,
+                                     NodeKey.from_secret(b"srv").priv_key)
+                while True:
+                    await sc.read_msg()
+            except (SecretConnectionError, ConnectionError,
+                    asyncio.IncompleteReadError, Exception) as e:
+                results["server"] = type(e).__name__
+            finally:
+                server_done.set()
+                writer.close()
+
+        srv = await asyncio.start_server(server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        sc = await handshake(reader, writer, NodeKey.from_secret(b"cli").priv_key)
+        # a valid message flows
+        await sc.write_msg(b"hello")
+        # now inject garbage straight into the TCP stream (bypassing the
+        # encryption layer) — frames that cannot authenticate
+        for _ in range(64):
+            writer.write(_rand_bytes(rng, 128))
+        try:
+            await writer.drain()
+        except ConnectionError:
+            pass
+        await asyncio.wait_for(server_done.wait(), 10)
+        # server rejected the stream with an error, not a hang/accept
+        assert results["server"] != "hang"
+        writer.close()
+        srv.close()
+        return True
+
+    assert run(main())
+
+
+def test_fuzz_secret_connection_handshake_garbage():
+    """Random bytes instead of a handshake must error out promptly."""
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.p2p.secret_connection import handshake
+
+    async def main():
+        rng = random.Random(SEED + 2)
+
+        async def server(reader, writer):
+            try:
+                await asyncio.wait_for(
+                    handshake(reader, writer, NodeKey.from_secret(b"s").priv_key), 5)
+            except Exception:
+                pass
+            finally:
+                writer.close()
+
+        srv = await asyncio.start_server(server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        for _ in range(16):
+            try:
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.write(_rand_bytes(rng, 256))
+                await w.drain()
+                w.close()
+            except ConnectionError:
+                pass
+        srv.close()
+        await srv.wait_closed()
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------------ JSON-RPC
+
+def test_fuzz_jsonrpc_server():
+    """Malformed HTTP/JSON-RPC requests (bad JSON, huge ids, wrong types,
+    random bytes) get error responses or closed connections — the server
+    survives and still answers a well-formed request afterwards."""
+    from cometbft_tpu.rpc.server import RPCServer
+
+    class _FakeNode:
+        event_bus = None
+
+    async def main():
+        rng = random.Random(SEED + 3)
+        server = RPCServer(_FakeNode())
+        host, port = await server.listen("127.0.0.1", 0)
+
+        async def send_raw(payload: bytes) -> None:
+            try:
+                r, w = await asyncio.open_connection(host, port)
+                w.write(payload)
+                await w.drain()
+                try:
+                    await asyncio.wait_for(r.read(4096), 2)
+                except TimeoutError:
+                    pass
+                w.close()
+            except ConnectionError:
+                pass
+
+        cases = []
+        for _ in range(N // 4):
+            cases.append(_rand_bytes(rng, 200))                 # raw noise
+        for body in (b"{", b"[]", b'{"jsonrpc":"2.0"}',
+                     b'{"method":123}', b'{"id":{}, "method":"status"}',
+                     b'{"jsonrpc":"2.0","id":1,"method":"nope"}',
+                     b'{"jsonrpc":"2.0","id":1,"method":"tx_search",'
+                     b'"params":{"query":"junk ("}}'):
+            cases.append(b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: "
+                         + str(len(body)).encode() + b"\r\n\r\n" + body)
+        cases.append(b"GET /%ff%fe HTTP/1.1\r\n\r\n")
+        cases.append(b"POST / HTTP/1.1\r\nContent-Length: 99999999\r\n\r\n")
+        for c in cases:
+            await send_raw(c)
+
+        # the server is still healthy: a valid request round-trips
+        r, w = await asyncio.open_connection(host, port)
+        body = b'{"jsonrpc":"2.0","id":1,"method":"health","params":{}}'
+        w.write(b"POST / HTTP/1.1\r\nHost: x\r\nContent-Length: "
+                + str(len(body)).encode() + b"\r\n\r\n" + body)
+        await w.drain()
+        resp = await asyncio.wait_for(r.read(4096), 5)
+        assert b"200" in resp.split(b"\r\n")[0] or b'"error"' in resp
+        w.close()
+        await server.close()
+        return True
+
+    assert run(main())

@@ -1,0 +1,78 @@
+"""Loadtime generator + report (reference: ``test/loadtime/``)."""
+
+import asyncio
+import time
+
+from cometbft_tpu.loadtime import make_load_tx, parse_load_tx
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_load_tx_roundtrip():
+    tx = make_load_tx("abc123", 42, size=256, now_ns=1_700_000_000_000_000_000)
+    assert len(tx) == 256
+    rid, seq, t = parse_load_tx(tx)
+    assert (rid, seq, t) == ("abc123", 42, 1_700_000_000_000_000_000)
+    assert parse_load_tx(b"k=v") is None
+    assert parse_load_tx(b"load:bad") is None
+    # kvstore accepts it as a k=v tx
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    assert KVStoreApplication._parse_tx(tx) is not None
+
+
+def test_load_generate_and_report_against_node():
+    """Generate ~2s of load at a single-validator node over RPC, then the
+    report recovers per-tx latency from committed blocks."""
+    from cometbft_tpu import loadtime
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc.client import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    async def main():
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        pv = MockPV.from_secret(b"load0")
+        doc = GenesisDoc(chain_id="load-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)])
+        node = await Node.create(doc, KVStoreApplication(),
+                                 priv_validator=pv, config=cfg,
+                                 node_key=NodeKey.from_secret(b"lnk"),
+                                 name="load0")
+        await node.start()
+        try:
+            host, port = node.rpc_addr
+            client = HTTPClient(host, port)
+            gen = await loadtime.generate(client, rate=50, duration_s=2.0,
+                                          tx_size=128)
+            assert gen["sent"] > 20, gen
+            # let the tail commit
+            target = node.height() + 2
+            while node.height() < target:
+                await asyncio.sleep(0.05)
+            rep = await loadtime.report(client, run_id=gen["run_id"])
+            assert rep["txs"] > 20, rep
+            # block header time is BFT time (median of the PREVIOUS
+            # round's vote timestamps), so a tx committed immediately can
+            # show slightly negative latency — small skew is expected
+            assert rep["min_s"] >= -2.0
+            assert rep["p50_s"] <= rep["p99_s"] <= rep["max_s"]
+            assert rep["max_s"] < 30
+            assert rep["throughput_tx_s"] is None or \
+                rep["throughput_tx_s"] > 0
+        finally:
+            await node.stop()
+        return True
+
+    assert run(main())

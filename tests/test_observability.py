@@ -252,3 +252,28 @@ def test_base_service_lifecycle():
         return True
 
     assert run(main())
+
+
+def test_prometheus_standalone_listener():
+    """instrumentation.prometheus serves the dedicated scrape port
+    (reference node/node.go Prometheus server)."""
+    import asyncio
+
+    from cometbft_tpu.node.node import _serve_prometheus
+    from cometbft_tpu.libs import metrics
+
+    async def main():
+        server = await _serve_prometheus("tcp://127.0.0.1:0")
+        port = server.sockets[0].getsockname()[1]
+        metrics.counter("obs_test_total", "test counter").inc(3)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(65536), 5)
+        assert b"200 OK" in raw.split(b"\r\n")[0]
+        assert b"obs_test_total" in raw
+        writer.close()
+        server.close()
+        return True
+
+    assert run(main())
