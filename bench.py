@@ -301,11 +301,19 @@ def _child_main(backend: str, nsig: int) -> None:
     assert out.all(), "benchmark batch failed verification"
 
     reps = int(os.environ.get("BENCH_REPS", "10" if backend != "cpu" else "5"))
+    profile_dir = os.environ.get("BENCH_PROFILE", "")
+    if profile_dir:
+        # tracing/profiling hook (SURVEY §5): captures an XLA/JAX trace of
+        # the timed loop, viewable in TensorBoard/Perfetto
+        note(f"capturing jax profiler trace to {profile_dir}")
+        jax.profiler.start_trace(profile_dir)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         fn(*args)[0].block_until_ready()
         times.append(time.perf_counter() - t0)
+    if profile_dir:
+        jax.profiler.stop_trace()
     p50 = float(np.percentile(times, 50))
     sigs_per_sec = nsig / p50
 

@@ -301,6 +301,137 @@ def cmd_load_report(args) -> int:
     return 0
 
 
+def cmd_reindex_event(args) -> int:
+    """commands/reindex_event.go: rebuild the tx + block indexes offline
+    from the block store and the saved FinalizeBlock responses."""
+    from ..indexer.block import BlockIndexer
+    from ..indexer.tx import TxIndexer
+    from ..mempool.mempool import TxKey
+    from ..sm.execution import unpack_finalize_response
+    from ..storage import BlockStore, StateStore, open_db
+    from ..types import events as ev
+
+    home = args.home
+    cfg = _load_home(home)
+
+    def data_db(name):
+        return open_db(cfg.storage.db_backend,
+                       os.path.join(home, "data", name))
+
+    bs = BlockStore(data_db("blockstore.db"))
+    ss = StateStore(data_db("state.db"))
+    tx_ix = TxIndexer(data_db("tx_index.db"))
+    blk_ix = BlockIndexer(data_db("block_index.db"))
+
+    start = args.start_height or bs.base()
+    end = args.end_height or bs.height()
+    if start < bs.base() or end > bs.height() or start > end:
+        print(f"height range [{start},{end}] outside stored "
+              f"[{bs.base()},{bs.height()}]", file=sys.stderr)
+        return 1
+    done = 0
+    for h in range(start, end + 1):
+        block = bs.load_block(h)
+        raw = ss.load_finalize_block_response(h)
+        if block is None or raw is None:
+            print(f"skipping height {h}: "
+                  f"{'no block' if block is None else 'no ABCI response'}",
+                  file=sys.stderr)
+            continue
+        resp = unpack_finalize_response(raw)
+        blk_ix.index(h, resp.events)
+        for i, tx in enumerate(block.data.txs):
+            tx = bytes(tx)
+            res = resp.tx_results[i] if i < len(resp.tx_results) else None
+            if res is None:
+                continue
+            tx_ix.index(h, i, tx, res,
+                        {ev.TX_HASH_KEY: TxKey(tx).hex(),
+                         ev.TX_HEIGHT_KEY: str(h)})
+        done += 1
+    print(f"Reindexed {done} blocks [{start},{end}]")
+    return 0
+
+
+def cmd_compact_db(args) -> int:
+    """commands/compact.go analogue: force-compact the data-dir stores
+    (LogDB rewrites live records; other backends no-op)."""
+    cfg = _load_home(args.home)
+    from ..storage import open_db
+
+    total = 0
+    for name in ("blockstore.db", "state.db", "evidence.db",
+                 "tx_index.db", "block_index.db"):
+        path = os.path.join(args.home, "data", name)
+        if not os.path.exists(path):
+            continue
+        before = os.path.getsize(path) if os.path.isfile(path) else 0
+        db = open_db(cfg.storage.db_backend, path)
+        compact = getattr(db, "_compact", None) or getattr(
+            db, "compact", None)
+        if compact is not None:
+            compact()
+        db.close()
+        after = os.path.getsize(path) if os.path.isfile(path) else 0
+        total += max(0, before - after)
+        print(f"{name}: {before} -> {after} bytes")
+    print(f"Reclaimed {total} bytes")
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """commands/debug: capture a post-mortem bundle — node introspection
+    over RPC when the node is up, plus config and WAL/data listings."""
+    import tarfile
+    import time as _time
+
+    out_dir = args.output_dir or f"debug-dump-{int(_time.time())}"
+    os.makedirs(out_dir, exist_ok=True)
+
+    async def fetch_rpc():
+        from ..rpc.client import HTTPClient
+
+        client = _rpc_client(args.rpc)
+        for route in ("status", "net_info", "consensus_state",
+                      "dump_consensus_state", "num_unconfirmed_txs"):
+            try:
+                out = await asyncio.wait_for(client.call(route), 5)
+                with open(os.path.join(out_dir, f"{route}.json"), "w") as f:
+                    json.dump(out, f, indent=2, default=str)
+            except Exception as e:
+                with open(os.path.join(out_dir, f"{route}.err"), "w") as f:
+                    f.write(repr(e))
+
+    asyncio.run(fetch_rpc())
+
+    home = args.home
+    if os.path.isdir(home):
+        cfgp = _cfg_path(home)
+        if os.path.exists(cfgp):
+            shutil.copy(cfgp, os.path.join(out_dir, "config.toml"))
+        listing = []
+        for root, _dirs, files in os.walk(os.path.join(home, "data")):
+            for fn in files:
+                p = os.path.join(root, fn)
+                listing.append(f"{os.path.getsize(p):>12} {p}")
+        with open(os.path.join(out_dir, "data_listing.txt"), "w") as f:
+            f.write("\n".join(listing))
+        wal_dir = os.path.join(home, "data", "cs.wal")
+        wal_file = wal_dir if os.path.isfile(wal_dir) else None
+        if os.path.isdir(wal_dir):
+            segs = sorted(os.listdir(wal_dir))
+            if segs:
+                wal_file = os.path.join(wal_dir, segs[-1])
+        if wal_file and os.path.isfile(wal_file):
+            shutil.copy(wal_file, os.path.join(out_dir, "wal_tail.bin"))
+
+    tar_path = out_dir.rstrip("/") + ".tar.gz"
+    with tarfile.open(tar_path, "w:gz") as tar:
+        tar.add(out_dir, arcname=os.path.basename(out_dir))
+    print(f"Debug bundle written to {tar_path}")
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """commands/inspect.go: read-only RPC over a crashed node's data dir."""
     return asyncio.run(_inspect_async(args))
@@ -446,6 +577,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--rpc", default="127.0.0.1:26657")
     sp.add_argument("--run-id", default=None)
     sp.set_defaults(fn=cmd_load_report)
+
+    sp = sub.add_parser("reindex-event",
+                        help="rebuild tx/block indexes from stored blocks")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sp = sub.add_parser("compact-db",
+                        help="force-compact the data-dir stores")
+    sp.set_defaults(fn=cmd_compact_db)
+
+    sp = sub.add_parser("debug", help="post-mortem capture")
+    dsub = sp.add_subparsers(dest="debug_command", required=True)
+    dp = dsub.add_parser("dump", help="capture an introspection bundle")
+    dp.add_argument("--rpc", default="127.0.0.1:26657")
+    dp.add_argument("--output-dir", default="")
+    dp.set_defaults(fn=cmd_debug_dump)
     return p
 
 

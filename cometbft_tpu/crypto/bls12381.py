@@ -1,0 +1,167 @@
+"""BLS12-381 keys (reference: ``crypto/bls12381/``).
+
+The reference gates its real implementation behind the ``bls12381`` build
+tag (cgo -> supranational/blst, ``crypto/bls12381/key_bls12381.go:1-30``);
+default builds ship an error-returning stub with ``Enabled = false``
+(``crypto/bls12381/key.go``).  This module mirrors that surface exactly:
+``ENABLED`` reflects whether a host BLS backend is importable (``py_ecc``
+or ``blspy`` — neither is baked into this image), all operations raise
+:class:`ErrDisabled` otherwise, and the key type is registered either way
+so configs and genesis docs that *name* bls12_381 parse and fail with the
+same actionable error the reference gives.
+
+Sizes follow the min-pubkey-size scheme the reference uses (blst minimal
+public keys): 32-byte private keys, 48-byte compressed G1 public keys,
+96-byte compressed G2 signatures.
+"""
+
+from __future__ import annotations
+
+from .keys import BLS12381_KEY_TYPE, PrivKey, PubKey, address_hash
+
+PRIV_KEY_SIZE = 32
+PUB_KEY_SIZE = 48
+SIGNATURE_LENGTH = 96
+
+
+class ErrDisabled(NotImplementedError):
+    """bls12_381 is disabled (no host BLS backend in this build) —
+    the reference's ``bls12381.ErrDisabled``."""
+
+    def __init__(self):
+        super().__init__(
+            "bls12_381 is disabled: no host BLS backend available "
+            "(the reference equally requires the `bls12381` build tag + "
+            "blst; install py_ecc or blspy to enable)")
+
+
+class _PyEccBackend:
+    """Adapter over py_ecc's basic ciphersuite (G2Basic =
+    BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_, minimal-pubkey-size:
+    48-byte G1 pubkeys / 96-byte G2 signatures, the reference's blst
+    layout)."""
+
+    def __init__(self, impl):
+        self._impl = impl
+
+    def key_gen(self, ikm: bytes) -> int:
+        return int(self._impl.KeyGen(ikm))
+
+    def sk_to_pk(self, sk: int) -> bytes:
+        return bytes(self._impl.SkToPk(sk))
+
+    def sign(self, sk: int, msg: bytes) -> bytes:
+        return bytes(self._impl.Sign(sk, msg))
+
+    def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        return bool(self._impl.Verify(pk, msg, sig))
+
+
+class _BlspyBackend:
+    """Adapter over blspy's BasicSchemeMPL (same ciphersuite)."""
+
+    def __init__(self, mod):
+        self._mod = mod
+
+    def key_gen(self, ikm: bytes) -> int:
+        sk = self._mod.BasicSchemeMPL.key_gen(ikm)
+        return int.from_bytes(bytes(sk), "big")
+
+    def _sk(self, sk: int):
+        return self._mod.PrivateKey.from_bytes(
+            sk.to_bytes(PRIV_KEY_SIZE, "big"))
+
+    def sk_to_pk(self, sk: int) -> bytes:
+        return bytes(self._sk(sk).get_g1())
+
+    def sign(self, sk: int, msg: bytes) -> bytes:
+        return bytes(self._mod.BasicSchemeMPL.sign(self._sk(sk), msg))
+
+    def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        m = self._mod
+        return bool(m.BasicSchemeMPL.verify(
+            m.G1Element.from_bytes(pk), msg, m.G2Element.from_bytes(sig)))
+
+
+def _backend():
+    """The optional host implementation, or None."""
+    try:
+        from py_ecc.bls import G2Basic
+
+        return _PyEccBackend(G2Basic)
+    except Exception:
+        pass
+    try:
+        import blspy
+
+        return _BlspyBackend(blspy)
+    except Exception:
+        return None
+
+
+ENABLED = _backend() is not None
+
+
+class Bls12381PubKey(PubKey):
+    def __init__(self, raw: bytes):
+        if len(raw) != PUB_KEY_SIZE:
+            raise ValueError(f"bls12_381 pubkey must be {PUB_KEY_SIZE} "
+                             f"bytes, got {len(raw)}")
+        self._raw = bytes(raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return BLS12381_KEY_TYPE
+
+    def address(self) -> bytes:
+        return address_hash(self._raw)
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        impl = _backend()
+        if impl is None:
+            raise ErrDisabled()
+        if len(sig) != SIGNATURE_LENGTH:
+            return False
+        try:
+            return impl.verify(self._raw, msg, sig)
+        except Exception:
+            return False
+
+
+class Bls12381PrivKey(PrivKey):
+    def __init__(self, raw: bytes):
+        if len(raw) != PRIV_KEY_SIZE:
+            raise ValueError(f"bls12_381 privkey must be {PRIV_KEY_SIZE} "
+                             f"bytes, got {len(raw)}")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "Bls12381PrivKey":
+        impl = _backend()
+        if impl is None:
+            raise ErrDisabled()
+        import os as _os
+
+        sk = impl.key_gen(_os.urandom(48))
+        return cls(sk.to_bytes(PRIV_KEY_SIZE, "big"))
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return BLS12381_KEY_TYPE
+
+    def sign(self, msg: bytes) -> bytes:
+        impl = _backend()
+        if impl is None:
+            raise ErrDisabled()
+        return impl.sign(int.from_bytes(self._raw, "big"), msg)
+
+    def pub_key(self) -> Bls12381PubKey:
+        impl = _backend()
+        if impl is None:
+            raise ErrDisabled()
+        return Bls12381PubKey(
+            impl.sk_to_pk(int.from_bytes(self._raw, "big")))

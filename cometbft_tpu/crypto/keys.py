@@ -39,6 +39,10 @@ def pub_key_from_type_bytes(key_type: str, raw: bytes) -> "PubKey":
         from .secp256k1 import Secp256k1PubKey
 
         return Secp256k1PubKey(raw)
+    if key_type == BLS12381_KEY_TYPE:
+        from .bls12381 import Bls12381PubKey
+
+        return Bls12381PubKey(raw)
     raise ValueError(f"unsupported pubkey type {key_type!r}")
 
 ADDRESS_SIZE = 20

@@ -76,11 +76,11 @@ async def report(client, run_id: str | None = None,
     tip = st["sync_info"]["latest_block_height"]
     latencies_ns: list[int] = []
     first_h = last_h = None
-    block_times: list[int] = []
+    block_time: dict[int, int] = {}
     for h in range(max(1, min_height), tip + 1):
         blk = await client.call("block", height=h)
         hdr = blk["block"]["hdr"]
-        block_times.append(hdr["ts"])
+        block_time[h] = hdr["ts"]
         for tx_hex in blk["block"]["data"]["txs"]:
             tx = bytes.fromhex(tx_hex["~b"]) if isinstance(tx_hex, dict) \
                 else bytes.fromhex(tx_hex)
@@ -100,8 +100,11 @@ async def report(client, run_id: str | None = None,
     def pct(p):
         return lat_s[min(len(lat_s) - 1, int(p * len(lat_s)))]
 
-    window_s = (block_times[-1] - block_times[0]) / 1e9 \
-        if len(block_times) > 1 else 0.0
+    # throughput over the window that actually CONTAINS the run's txs,
+    # not the whole scanned chain (a long-lived node would otherwise
+    # dilute the rate toward zero)
+    window_s = (block_time[last_h] - block_time[first_h]) / 1e9 \
+        if last_h is not None and last_h > first_h else 0.0
     return {
         "txs": len(lat_s),
         "blocks": (last_h - first_h + 1) if first_h else 0,
@@ -113,4 +116,5 @@ async def report(client, run_id: str | None = None,
         "avg_s": round(sum(lat_s) / len(lat_s), 4),
         "throughput_tx_s": round(len(lat_s) / window_s, 2)
         if window_s > 0 else None,
+        "window_s": round(window_s, 3),
     }

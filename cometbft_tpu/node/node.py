@@ -51,15 +51,17 @@ async def _serve_prometheus(laddr: str):
 
     async def handle(reader, writer):
         try:
-            await reader.readline()                 # request line; ignore
-            while (await reader.readline()).strip():
-                pass                                # drain headers
+            # bounded reads: a silent client must not pin the handler
+            await _aio.wait_for(reader.readline(), 10)   # request line
+            while (await _aio.wait_for(reader.readline(), 10)).strip():
+                pass                                     # drain headers
             body = _metrics.DEFAULT.collect().encode()
             writer.write(b"HTTP/1.1 200 OK\r\nContent-Type: text/plain; "
                          b"version=0.0.4\r\nContent-Length: "
                          + str(len(body)).encode() + b"\r\n\r\n" + body)
             await writer.drain()
-        except (ConnectionError, _aio.IncompleteReadError):
+        except (ConnectionError, _aio.IncompleteReadError,
+                _aio.TimeoutError):
             pass
         finally:
             writer.close()
@@ -377,6 +379,7 @@ class Node:
             await self.grpc_server.stop()
         if self.prometheus_server is not None:
             self.prometheus_server.close()
+            await self.prometheus_server.wait_closed()
         if self.indexer_service is not None:
             await self.indexer_service.stop()
         if self.pruner is not None:

@@ -73,16 +73,16 @@ def test_fuzz_secret_connection_frames():
         rng = random.Random(SEED + 1)
         server_done = asyncio.Event()
         results = {}
+        received = []
 
         async def server(reader, writer):
             try:
                 sc = await handshake(reader, writer,
                                      NodeKey.from_secret(b"srv").priv_key)
                 while True:
-                    await sc.read_msg()
-            except (SecretConnectionError, ConnectionError,
-                    asyncio.IncompleteReadError, Exception) as e:
-                results["server"] = type(e).__name__
+                    received.append(await sc.read_msg())
+            except Exception as e:
+                results["server"] = e
             finally:
                 server_done.set()
                 writer.close()
@@ -102,8 +102,13 @@ def test_fuzz_secret_connection_frames():
         except ConnectionError:
             pass
         await asyncio.wait_for(server_done.wait(), 10)
-        # server rejected the stream with an error, not a hang/accept
-        assert results["server"] != "hang"
+        # the legitimate message was the ONLY thing delivered: none of
+        # the unauthenticated garbage decrypted into a message, and the
+        # stream died with an AEAD/framing error, not EOF-acceptance
+        assert received == [b"hello"], received
+        assert isinstance(results["server"],
+                          (SecretConnectionError, ConnectionError,
+                           asyncio.IncompleteReadError)), results["server"]
         writer.close()
         srv.close()
         return True

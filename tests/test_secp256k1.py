@@ -105,3 +105,35 @@ def test_mixed_key_commit_verifies():
     with pytest.raises(ErrInvalidSignature):
         VerifyCommit(CHAIN_ID, vals, commit2.block_id, 10, commit2,
                      backend="jax")
+
+
+# ---------------------------------------------------------------- bls12381
+
+def test_bls12381_stub_surface():
+    """Default builds mirror the reference's !bls12381 stub
+    (crypto/bls12381/key.go): key type registered, sizes fixed,
+    operations raise ErrDisabled unless a host backend exists."""
+    import pytest as _pytest
+
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.crypto.keys import (BLS12381_KEY_TYPE,
+                                          pub_key_from_type_bytes)
+
+    pub = pub_key_from_type_bytes(BLS12381_KEY_TYPE, b"\x01" * 48)
+    assert pub.type() == BLS12381_KEY_TYPE
+    assert len(pub.address()) == 20
+    with _pytest.raises(ValueError):
+        pub_key_from_type_bytes(BLS12381_KEY_TYPE, b"\x01" * 32)
+
+    if not bls.ENABLED:
+        with _pytest.raises(bls.ErrDisabled):
+            pub.verify_signature(b"msg", b"\x00" * 96)
+        with _pytest.raises(bls.ErrDisabled):
+            bls.Bls12381PrivKey(b"\x02" * 32).sign(b"msg")
+        with _pytest.raises(bls.ErrDisabled):
+            bls.Bls12381PrivKey.generate()
+    else:  # a host backend is present: sign/verify round-trips
+        sk = bls.Bls12381PrivKey.generate()
+        sig = sk.sign(b"msg")
+        assert len(sig) == 96
+        assert sk.pub_key().verify_signature(b"msg", sig)
