@@ -252,6 +252,7 @@ def cmd_rollback(args) -> int:
 
     home = args.home
     cfg = _load_home(home)
+    _lock_data_dir(home)
     bs_db = open_db(cfg.storage.db_backend,
                     os.path.join(home, "data", "blockstore.db"))
     ss_db = open_db(cfg.storage.db_backend,
@@ -272,6 +273,18 @@ def _rpc_client(addr: str):
 
     host, _, port = addr.rpartition(":")
     return HTTPClient(host or "127.0.0.1", int(port))
+
+
+def _lock_data_dir(home: str):
+    """Exclusive lock for offline tooling — refuses while a node runs on
+    this home (a live LogDB must never be reopened/compacted under it)."""
+    from ..storage.db import DataDirLock
+
+    try:
+        return DataDirLock(os.path.join(home, "data"))
+    except RuntimeError as e:
+        print(e, file=sys.stderr)
+        raise SystemExit(1) from None
 
 
 def cmd_load(args) -> int:
@@ -313,6 +326,7 @@ def cmd_reindex_event(args) -> int:
 
     home = args.home
     cfg = _load_home(home)
+    _lock_data_dir(home)
 
     def data_db(name):
         return open_db(cfg.storage.db_backend,
@@ -357,6 +371,7 @@ def cmd_compact_db(args) -> int:
     """commands/compact.go analogue: force-compact the data-dir stores
     (LogDB rewrites live records; other backends no-op)."""
     cfg = _load_home(args.home)
+    _lock_data_dir(args.home)
     from ..storage import open_db
 
     total = 0
@@ -389,8 +404,6 @@ def cmd_debug_dump(args) -> int:
     os.makedirs(out_dir, exist_ok=True)
 
     async def fetch_rpc():
-        from ..rpc.client import HTTPClient
-
         client = _rpc_client(args.rpc)
         for route in ("status", "net_info", "consensus_state",
                       "dump_consensus_state", "num_unconfirmed_txs"):
@@ -443,6 +456,7 @@ async def _inspect_async(args) -> int:
 
     home = args.home
     cfg = _load_home(home)
+    _lock_data_dir(home)
     doc = GenesisDoc.load(_join(home, cfg.base.genesis_file))
     host, port = "127.0.0.1", args.port
     server, addr = await run_inspect(home, cfg, doc, host, port)

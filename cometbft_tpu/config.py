@@ -122,6 +122,9 @@ class TxIndexConfig:
 class InstrumentationConfig:
     prometheus: bool = False
     prometheus_listen_addr: str = ":26660"
+    # event-loop stall watchdog (libs/loopwatch — the asyncio analogue of
+    # the reference's deadlock-detecting mutex build); 0 disables
+    loop_stall_threshold_s: float = 1.0
 
 
 @dataclass

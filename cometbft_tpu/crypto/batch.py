@@ -298,20 +298,25 @@ class TpuBatchVerifier(BatchVerifier):
             if i not in ed_set:
                 oks[i] = p.verify_signature(m, s)
         if ed_idx:
-            maxlen = max(len(self._items[i][1]) for i in ed_idx)
+            # vectorized packing: one frombuffer per FIELD, not per lane
+            # (a per-lane loop costs ~100 ms at 10k sigs — on the p50
+            # VerifyCommit latency path that dwarfs the device dispatch)
+            ed_items = [self._items[i] for i in ed_idx]
+            maxlen = max(max(len(m) for _, m, _ in ed_items), 1)
             bsz = len(ed_idx)
-            pubs = np.zeros((bsz, 32), np.uint8)
-            rs = np.zeros((bsz, 32), np.uint8)
-            ss = np.zeros((bsz, 32), np.uint8)
-            msgs = np.zeros((bsz, max(maxlen, 1)), np.uint8)
-            lens = np.zeros((bsz,), np.int64)
-            for j, i in enumerate(ed_idx):
-                p, m, s = self._items[i]
-                pubs[j] = np.frombuffer(p.bytes(), np.uint8)
-                rs[j] = np.frombuffer(s[:32], np.uint8)
-                ss[j] = np.frombuffer(s[32:], np.uint8)
-                msgs[j, :len(m)] = np.frombuffer(m, np.uint8)
+            pubs = np.frombuffer(
+                b"".join(p.bytes() for p, _, _ in ed_items),
+                np.uint8).reshape(bsz, 32)
+            sigs = np.frombuffer(
+                b"".join(s for _, _, s in ed_items),
+                np.uint8).reshape(bsz, 64)
+            rs, ss = sigs[:, :32], sigs[:, 32:]
+            buf = bytearray(bsz * maxlen)
+            lens = np.empty((bsz,), np.int64)
+            for j, (_, m, _) in enumerate(ed_items):
+                buf[j * maxlen:j * maxlen + len(m)] = m
                 lens[j] = len(m)
+            msgs = np.frombuffer(bytes(buf), np.uint8).reshape(bsz, maxlen)
             dev = _device_call(lambda: device_verify_ed25519(
                 pubs, rs, ss, msgs, lens, self._device))
             if dev is None:

@@ -99,7 +99,8 @@ def _backend():
         return None
 
 
-ENABLED = _backend() is not None
+_BACKEND = _backend()                # resolved once at import
+ENABLED = _BACKEND is not None
 
 
 class Bls12381PubKey(PubKey):
@@ -119,7 +120,7 @@ class Bls12381PubKey(PubKey):
         return address_hash(self._raw)
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
-        impl = _backend()
+        impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
         if len(sig) != SIGNATURE_LENGTH:
@@ -139,7 +140,7 @@ class Bls12381PrivKey(PrivKey):
 
     @classmethod
     def generate(cls) -> "Bls12381PrivKey":
-        impl = _backend()
+        impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
         import os as _os
@@ -154,13 +155,13 @@ class Bls12381PrivKey(PrivKey):
         return BLS12381_KEY_TYPE
 
     def sign(self, msg: bytes) -> bytes:
-        impl = _backend()
+        impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
         return impl.sign(int.from_bytes(self._raw, "big"), msg)
 
     def pub_key(self) -> Bls12381PubKey:
-        impl = _backend()
+        impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
         return Bls12381PubKey(

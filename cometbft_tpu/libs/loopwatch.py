@@ -1,0 +1,79 @@
+"""Event-loop responsiveness watchdog — the asyncio analogue of the
+reference's race/deadlock tooling (``libs/sync/deadlock.go``'s build-tag
+mutexes and the ``-race`` CI target, SURVEY §5).
+
+The single-writer asyncio design replaces Go's mutexes, so the failure
+mode shifts from deadlock to *loop stall*: one synchronous call (a cold
+XLA compile, a blocking probe, accidental file IO) freezes every
+subsystem at once, silently.  The watchdog measures scheduling lag from a
+monitor thread and turns stalls into structured log lines + a metric, so
+they show up in tests and production instead of as mystery timeouts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+from . import metrics as _metrics
+from .log import logger
+
+_LOG = logger("loopwatch")
+
+
+class LoopWatchdog:
+    """Heartbeats the loop via ``call_soon_threadsafe``; if a beat takes
+    more than ``stall_threshold_s`` to run, the loop was blocked that
+    long by synchronous work."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop | None = None,
+                 interval_s: float = 0.5,
+                 stall_threshold_s: float = 1.0,
+                 name: str = "node"):
+        self._loop = loop or asyncio.get_event_loop()
+        self.interval_s = interval_s
+        self.stall_threshold_s = stall_threshold_s
+        self.name = name
+        self.stalls = 0
+        self.worst_stall_s = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._gauge = _metrics.gauge(
+            "loop_worst_stall_seconds",
+            "longest observed event-loop stall")
+        self._counter = _metrics.counter(
+            "loop_stalls_total",
+            "event-loop stalls above threshold")
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"loopwatch-{self.name}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            beat = threading.Event()
+            sent = time.monotonic()
+            try:
+                self._loop.call_soon_threadsafe(beat.set)
+            except RuntimeError:
+                return                       # loop closed
+            # wait generously; a stall longer than 60 s is still reported
+            beat.wait(60.0)
+            lag = time.monotonic() - sent
+            if lag >= self.stall_threshold_s:
+                self.stalls += 1
+                self.worst_stall_s = max(self.worst_stall_s, lag)
+                self._counter.inc(node=self.name)
+                self._gauge.set(self.worst_stall_s, node=self.name)
+                _LOG.error("event loop stalled",
+                           node=self.name, stall_s=round(lag, 3),
+                           hint="synchronous work on the loop thread "
+                                "(compile? blocking IO?)")

@@ -95,6 +95,7 @@ class Node:
         self.rpc_addr: tuple[str, int] | None = None
         self.grpc_server = None
         self.prometheus_server = None
+        self.loop_watchdog = None
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
@@ -107,6 +108,7 @@ class Node:
         self.statesync_error = None
         self.name = "node"
         self._started = False
+        self._data_lock = None
 
     # ------------------------------------------------------------- create
 
@@ -135,7 +137,12 @@ class Node:
                            os.path.join(home, "data", filename))
 
         if home is not None:
+            from ..storage.db import DataDirLock
+
             os.makedirs(os.path.join(home, "data"), exist_ok=True)
+            # refuse to double-open a home (and make offline tooling
+            # refuse while this node runs)
+            self._data_lock = DataDirLock(os.path.join(home, "data"))
             wal = WAL(os.path.join(home, "data", "cs.wal"))
         else:
             wal = None
@@ -341,6 +348,15 @@ class Node:
         if self.config.instrumentation.prometheus:
             self.prometheus_server = await _serve_prometheus(
                 self.config.instrumentation.prometheus_listen_addr)
+        if self.config.instrumentation.loop_stall_threshold_s > 0:
+            from ..libs.loopwatch import LoopWatchdog
+
+            self.loop_watchdog = LoopWatchdog(
+                asyncio.get_running_loop(),
+                stall_threshold_s=(
+                    self.config.instrumentation.loop_stall_threshold_s),
+                name=self.name)
+            self.loop_watchdog.start()
         from ..crypto import batch as cryptobatch
 
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
@@ -380,6 +396,11 @@ class Node:
         if self.prometheus_server is not None:
             self.prometheus_server.close()
             await self.prometheus_server.wait_closed()
+        if self.loop_watchdog is not None:
+            self.loop_watchdog.stop()
+        if self._data_lock is not None:
+            self._data_lock.release()
+            self._data_lock = None
         if self.indexer_service is not None:
             await self.indexer_service.stop()
         if self.pruner is not None:

@@ -45,6 +45,41 @@ class KVStore(ABC):
         return self.get(key) is not None
 
 
+class DataDirLock:
+    """Exclusive advisory lock on a node home's data dir, held for the
+    process lifetime (the role of the reference DBs' file locks: offline
+    tooling must refuse to touch a live node's stores).  flock releases
+    automatically on process death, so a crashed node never wedges its
+    home."""
+
+    def __init__(self, data_dir: str):
+        import os as _os
+
+        _os.makedirs(data_dir, exist_ok=True)
+        self.path = _os.path.join(data_dir, "LOCK")
+        self._fd = _os.open(self.path, _os.O_CREAT | _os.O_RDWR, 0o644)
+        import fcntl
+
+        try:
+            fcntl.flock(self._fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            _os.close(self._fd)
+            raise RuntimeError(
+                f"data dir {data_dir} is locked by a running node — "
+                "stop it before running offline tooling") from None
+        _os.write(self._fd, str(_os.getpid()).encode())
+
+    def release(self) -> None:
+        import os as _os
+
+        if self._fd is not None:
+            import fcntl
+
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            _os.close(self._fd)
+            self._fd = None
+
+
 def height_key(prefix: bytes, height: int) -> bytes:
     """Height-ordered key layout shared by block/state stores (the layout
     the reference's storage study found keeps pruning cheap)."""

@@ -83,11 +83,18 @@ class Commit:
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
         """Reconstructed canonical vote bytes for signature idx
         (types/block.go:902 VoteSignBytes) — the message the TPU kernel
-        verifies."""
+        verifies.  Uses a per-commit template encoder (only the timestamp
+        and the commit-vs-nil block id vary between a commit's sigs)."""
         cs = self.signatures[idx]
-        return canonical.canonical_vote_sign_bytes(
-            chain_id, PRECOMMIT_TYPE, self.height, self.round,
-            cs.block_id(self.block_id), cs.timestamp_ns)
+        is_commit = cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+        cache = self.__dict__.setdefault("_sb_encoders", {})
+        enc = cache.get((chain_id, is_commit))
+        if enc is None:
+            enc = canonical.CanonicalVoteEncoder(
+                chain_id, PRECOMMIT_TYPE, self.height, self.round,
+                cs.block_id(self.block_id))
+            cache[(chain_id, is_commit)] = enc
+        return enc.sign_bytes(cs.timestamp_ns)
 
     def to_vote(self, idx: int) -> Vote:
         cs = self.signatures[idx]

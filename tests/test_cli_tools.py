@@ -96,3 +96,36 @@ def test_reindex_and_compact_and_debug_dump(tmp_path):
     assert any("config.toml" in n for n in names)
     assert any("data_listing.txt" in n for n in names)
     assert any("status.err" in n for n in names)  # RPC was down
+
+
+def test_offline_tooling_refuses_running_node(tmp_path):
+    """A live node holds the data-dir flock; compact-db/reindex-event on
+    the same home must refuse instead of corrupting the open LogDB."""
+    import signal as _signal
+
+    home = _prep_home(tmp_path, 28980)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        deadline = time.time() + 30
+        lock_path = os.path.join(home, "data", "LOCK")
+        while not os.path.exists(lock_path) and time.time() < deadline:
+            time.sleep(0.2)
+        time.sleep(1.0)          # let the node actually take the flock
+        res = _run_cli("compact-db", home=home)
+        assert res.returncode == 1, res.stdout
+        assert "locked by a running node" in res.stderr
+        res = _run_cli("reindex-event", home=home)
+        assert res.returncode == 1
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    # after the node exits the lock is free again
+    res = _run_cli("compact-db", home=home)
+    assert res.returncode == 0, res.stdout + res.stderr

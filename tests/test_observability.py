@@ -277,3 +277,31 @@ def test_prometheus_standalone_listener():
         return True
 
     assert run(main())
+
+
+def test_loop_watchdog_detects_stall():
+    """The loop watchdog (libs/loopwatch) reports synchronous work that
+    froze the event loop — the asyncio analogue of deadlock detection."""
+    import asyncio
+    import time as _time
+
+    from cometbft_tpu.libs.loopwatch import LoopWatchdog
+
+    async def main():
+        wd = LoopWatchdog(asyncio.get_running_loop(),
+                          interval_s=0.05, stall_threshold_s=0.2,
+                          name="wdtest")
+        wd.start()
+        try:
+            await asyncio.sleep(0.2)     # healthy: no stalls
+            healthy = wd.stalls
+            _time.sleep(0.8)             # synchronous block ON the loop
+            await asyncio.sleep(0.3)     # let the beat land
+            return healthy, wd.stalls, wd.worst_stall_s
+        finally:
+            wd.stop()
+
+    healthy, stalls, worst = run(main())
+    assert healthy == 0
+    assert stalls >= 1
+    assert worst >= 0.5
