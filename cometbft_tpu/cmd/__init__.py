@@ -229,6 +229,7 @@ def cmd_unsafe_reset_all(args) -> int:
     home = args.home
     data = os.path.join(home, "data")
     if os.path.isdir(data):
+        _lock_data_dir(home)      # refuse to rmtree under a running node
         shutil.rmtree(data)
     os.makedirs(data, exist_ok=True)
     cfg = _load_home(home)

@@ -3,12 +3,13 @@
 The reference gates its real implementation behind the ``bls12381`` build
 tag (cgo -> supranational/blst, ``crypto/bls12381/key_bls12381.go:1-30``);
 default builds ship an error-returning stub with ``Enabled = false``
-(``crypto/bls12381/key.go``).  This module mirrors that surface exactly:
-``ENABLED`` reflects whether a host BLS backend is importable (``py_ecc``
-or ``blspy`` — neither is baked into this image), all operations raise
-:class:`ErrDisabled` otherwise, and the key type is registered either way
-so configs and genesis docs that *name* bls12_381 parse and fail with the
-same actionable error the reference gives.
+(``crypto/bls12381/key.go``).  This module goes further: a bundled
+pure-Python implementation (``_bls12381_py``) makes BLS keys functional
+with no extra dependencies, and the backend seam automatically upgrades
+to a standard-ciphersuite host library (``py_ecc`` or ``blspy``) when one
+is importable.  ``ENABLED`` and :class:`ErrDisabled` are retained for
+surface parity with the reference; with the bundled fallback they are
+always True / never raised.
 
 Sizes follow the min-pubkey-size scheme the reference uses (blst minimal
 public keys): 32-byte private keys, 48-byte compressed G1 public keys,
@@ -83,8 +84,36 @@ class _BlspyBackend:
             m.G1Element.from_bytes(pk), msg, m.G2Element.from_bytes(sig)))
 
 
+class _PurePyBackend:
+    """The bundled pure-Python implementation (``_bls12381_py``):
+    dependency-free and always available, so BLS keys WORK out of the
+    box where the reference's default build only errors.  Slow (seconds
+    per verify — two pairings in CPython) and, because its hash-to-curve
+    uses RFC 9380's SVDW map rather than the standard G2 suite's
+    SSWU+isogeny, self-interop only; the seam prefers a standard-suite
+    host library when one is importable."""
+
+    def __init__(self):
+        from . import _bls12381_py as impl
+
+        self._impl = impl
+
+    def key_gen(self, ikm: bytes) -> int:
+        return self._impl.keygen(ikm)
+
+    def sk_to_pk(self, sk: int) -> bytes:
+        return self._impl.sk_to_pk(sk)
+
+    def sign(self, sk: int, msg: bytes) -> bytes:
+        return self._impl.sign(sk, msg)
+
+    def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        return self._impl.verify(pk, msg, sig)
+
+
 def _backend():
-    """The optional host implementation, or None."""
+    """Best available host implementation; never None — the bundled
+    pure-Python fallback closes the gap."""
     try:
         from py_ecc.bls import G2Basic
 
@@ -96,7 +125,8 @@ def _backend():
 
         return _BlspyBackend(blspy)
     except Exception:
-        return None
+        pass
+    return _PurePyBackend()
 
 
 _BACKEND = _backend()                # resolved once at import

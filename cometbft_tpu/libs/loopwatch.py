@@ -52,10 +52,12 @@ class LoopWatchdog:
         self._thread.start()
 
     def stop(self) -> None:
+        """Signal-only shutdown: stop() is typically called FROM the
+        watched loop, and joining would block the very thread an
+        in-flight heartbeat needs to land on (the daemon thread exits on
+        its own after the wait)."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2)
-            self._thread = None
+        self._thread = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -68,6 +70,8 @@ class LoopWatchdog:
             # wait generously; a stall longer than 60 s is still reported
             beat.wait(60.0)
             lag = time.monotonic() - sent
+            if self._stop.is_set():
+                return              # shutdown lag is not a loop stall
             if lag >= self.stall_threshold_s:
                 self.stalls += 1
                 self.worst_stall_s = max(self.worst_stall_s, lag)
