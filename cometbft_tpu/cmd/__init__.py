@@ -35,6 +35,20 @@ def _join(home: str, rel: str) -> str:
     return rel if os.path.isabs(rel) else os.path.join(home, rel)
 
 
+def _warn_slow_bls(key_type: str) -> None:
+    """bls12_381 on the bundled pure-Python backend costs seconds per
+    verify — fine for tooling, ruinous on the consensus hot path."""
+    if key_type != "bls12_381":
+        return
+    from ..crypto import bls12381 as _bls
+
+    if type(_bls._BACKEND).__name__ == "_PurePyBackend":
+        print("WARNING: bls12_381 is served by the bundled pure-Python "
+              "backend (seconds per verify). A validator with this key "
+              "type will blow consensus timeouts; install py_ecc or "
+              "blspy for a production-grade backend.", file=sys.stderr)
+
+
 # ---------------------------------------------------------------- commands
 
 def cmd_init(args) -> int:
@@ -54,9 +68,11 @@ def cmd_init(args) -> int:
         cfg.save(_cfg_path(home))
 
     nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
+    _warn_slow_bls(getattr(args, "key_type", "ed25519"))
     pv = FilePV.load_or_generate(
         _join(home, cfg.base.priv_validator_key_file),
-        _join(home, cfg.base.priv_validator_state_file))
+        _join(home, cfg.base.priv_validator_state_file),
+        key_type=getattr(args, "key_type", "ed25519"))
 
     gen_path = _join(home, cfg.base.genesis_file)
     if not os.path.exists(gen_path):
@@ -185,11 +201,13 @@ def cmd_testnet(args) -> int:
 
 
 def cmd_gen_validator(args) -> int:
-    from ..crypto.keys import Ed25519PrivKey
+    from ..crypto.keys import gen_priv_key
 
-    sk = Ed25519PrivKey.generate()
+    sk = gen_priv_key(getattr(args, "key_type", "ed25519"))
+    _warn_slow_bls(getattr(args, "key_type", "ed25519"))
     print(json.dumps({
         "address": sk.pub_key().address().hex(),
+        "type": sk.pub_key().type(),
         "pub_key": sk.pub_key().bytes().hex(),
         "priv_key": sk.bytes().hex()}, indent=2))
     return 0
@@ -534,6 +552,8 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("init", help="initialize config/genesis/keys")
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--moniker", default="node")
+    sp.add_argument("--key-type", default="ed25519",
+                    choices=["ed25519", "secp256k1", "bls12_381"])
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
@@ -553,6 +573,9 @@ def build_parser() -> argparse.ArgumentParser:
                      ("unsafe-reset-all", cmd_unsafe_reset_all),
                      ("version", cmd_version)):
         sp = sub.add_parser(name)
+        if name == "gen-validator":
+            sp.add_argument("--key-type", default="ed25519",
+                            choices=["ed25519", "secp256k1", "bls12_381"])
         sp.set_defaults(fn=fn)
 
     sp = sub.add_parser("light", help="light-client RPC proxy daemon")

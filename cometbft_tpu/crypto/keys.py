@@ -30,20 +30,34 @@ SECP256K1_KEY_TYPE = "secp256k1"
 BLS12381_KEY_TYPE = "bls12_381"
 
 
-def pub_key_from_type_bytes(key_type: str, raw: bytes) -> "PubKey":
-    """Key-type registry dispatch (internal/keytypes/keytypes.go:14-33 +
+def _key_classes(key_type: str):
+    """The (PubKey, PrivKey) classes for a key type — the single registry
+    behind every dispatch site (internal/keytypes/keytypes.go:14-33 +
     crypto/encoding/codec.go)."""
     if key_type == ED25519_KEY_TYPE:
-        return Ed25519PubKey(raw)
+        return Ed25519PubKey, Ed25519PrivKey
     if key_type == SECP256K1_KEY_TYPE:
-        from .secp256k1 import Secp256k1PubKey
+        from .secp256k1 import Secp256k1PrivKey, Secp256k1PubKey
 
-        return Secp256k1PubKey(raw)
+        return Secp256k1PubKey, Secp256k1PrivKey
     if key_type == BLS12381_KEY_TYPE:
-        from .bls12381 import Bls12381PubKey
+        from .bls12381 import Bls12381PrivKey, Bls12381PubKey
 
-        return Bls12381PubKey(raw)
+        return Bls12381PubKey, Bls12381PrivKey
     raise ValueError(f"unsupported pubkey type {key_type!r}")
+
+
+def pub_key_from_type_bytes(key_type: str, raw: bytes) -> "PubKey":
+    return _key_classes(key_type)[0](raw)
+
+
+def priv_key_from_type_bytes(key_type: str, raw: bytes) -> "PrivKey":
+    return _key_classes(key_type)[1](raw)
+
+
+def gen_priv_key(key_type: str = ED25519_KEY_TYPE) -> "PrivKey":
+    """Generate a validator key of the given registered type."""
+    return _key_classes(key_type)[1].generate()
 
 ADDRESS_SIZE = 20
 

@@ -16,7 +16,8 @@ from __future__ import annotations
 import json
 import os
 
-from ..crypto.keys import Ed25519PrivKey, PubKey
+from ..crypto.keys import (PubKey, gen_priv_key,
+                           priv_key_from_type_bytes)
 from ..types.canonical import canonical_vote_sign_bytes
 from ..types.priv_validator import PrivValidator
 from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote
@@ -33,7 +34,7 @@ class DoubleSignError(Exception):
 
 
 class FilePV(PrivValidator):
-    def __init__(self, priv_key: Ed25519PrivKey, key_path: str,
+    def __init__(self, priv_key, key_path: str,
                  state_path: str):
         self.priv_key = priv_key
         self.key_path = key_path
@@ -49,8 +50,9 @@ class FilePV(PrivValidator):
     # ------------------------------------------------------------- file io
 
     @classmethod
-    def generate(cls, key_path: str, state_path: str) -> "FilePV":
-        pv = cls(Ed25519PrivKey.generate(), key_path, state_path)
+    def generate(cls, key_path: str, state_path: str,
+                 key_type: str = "ed25519") -> "FilePV":
+        pv = cls(gen_priv_key(key_type), key_path, state_path)
         pv.save_key()
         pv._save_state()
         return pv
@@ -59,8 +61,9 @@ class FilePV(PrivValidator):
     def load(cls, key_path: str, state_path: str) -> "FilePV":
         with open(key_path) as f:
             kd = json.load(f)
-        pv = cls(Ed25519PrivKey(bytes.fromhex(kd["priv_key"])), key_path,
-                 state_path)
+        pv = cls(priv_key_from_type_bytes(kd.get("type", "ed25519"),
+                                          bytes.fromhex(kd["priv_key"])),
+                 key_path, state_path)
         if os.path.exists(state_path):
             with open(state_path) as f:
                 sd = json.load(f)
@@ -73,15 +76,17 @@ class FilePV(PrivValidator):
         return pv
 
     @classmethod
-    def load_or_generate(cls, key_path: str, state_path: str) -> "FilePV":
+    def load_or_generate(cls, key_path: str, state_path: str,
+                         key_type: str = "ed25519") -> "FilePV":
         if os.path.exists(key_path):
             return cls.load(key_path, state_path)
-        return cls.generate(key_path, state_path)
+        return cls.generate(key_path, state_path, key_type)
 
     def save_key(self) -> None:
         pub = self.priv_key.pub_key()
         _atomic_write_json(self.key_path, {
             "address": pub.address().hex(),
+            "type": pub.type(),
             "pub_key": pub.bytes().hex(),
             "priv_key": self.priv_key.bytes().hex(),
         })

@@ -239,3 +239,38 @@ def test_invalid_proposal_is_rejected_and_chain_continues():
         return True
 
     assert run(main())
+
+
+def test_mixed_key_validator_set_commits():
+    """A validator set mixing ed25519 and secp256k1 keys commits blocks:
+    the TpuBatchVerifier's mixed routing (ed25519 lanes batched, secp on
+    the host route) runs inside live consensus — the reference refuses to
+    batch mixed sets (types/validation.go:13-19); here it just works."""
+    from cometbft_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from cometbft_tpu.testing import make_inproc_network
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    def pv_factory(i):
+        if i == 0:
+            return MockPV(Secp256k1PrivKey.from_secret(b"mixsecp%d" % i))
+        return MockPV.from_secret(b"mixed%d" % i)
+
+    async def main():
+        net = await make_inproc_network(4, chain_id="mixed-net",
+                                        pv_factory=pv_factory)
+        try:
+            await net.start()
+            await net.wait_for_height(3, timeout=60)
+            node = net.nodes[0]
+            # the secp validator's signature is in committed commits
+            commit = node.block_store.load_block(3).last_commit
+            types = {node.state_store.load_validators(2)
+                     .get_by_index(i).pub_key.type()
+                     for i, cs in enumerate(commit.signatures)
+                     if cs.is_commit()}
+            assert "secp256k1" in types and "ed25519" in types, types
+        finally:
+            await net.stop()
+        return True
+
+    asyncio.run(main())

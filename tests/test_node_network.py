@@ -106,6 +106,47 @@ def test_four_nodes_commit_over_tcp():
     assert run(main())
 
 
+def test_vote_extensions_over_tcp():
+    """Tier-2 version of the in-proc extensions test: 4 real nodes over
+    TCP with vote_extensions_enable_height=1 store extended commits whose
+    extensions the kvstore app produced and verified across the wire."""
+
+    async def main():
+        doc, pvs = _genesis(4, chain_id="ext-net")
+        doc.consensus_params.feature.vote_extensions_enable_height = 1
+        nodes = []
+        for i in range(4):
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pvs[i],
+                config=_config(), node_key=NodeKey.from_secret(b"ek%d" % i),
+                name=f"ext{i}")
+            nodes.append(node)
+        try:
+            for node in nodes:
+                await node.start()
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    await a.dial_peer(b.listen_addr, persistent=True)
+            await _wait_height(nodes, 4)
+            for n in nodes:
+                ext = n.block_store.load_block_extended_commit(3)
+                if ext is None:
+                    continue        # only the proposer path must store it
+                assert ext.ensure_extensions(True)
+                n_with_ext = sum(1 for e in ext.extended_signatures
+                                 if e.commit_sig.is_commit()
+                                 and e.extension_signature)
+                assert n_with_ext >= 3, "extensions missing over TCP"
+                break
+            else:
+                raise AssertionError("no node stored an extended commit")
+        finally:
+            await _stop_all(nodes)
+        return True
+
+    assert run(main())
+
+
 def test_node_joins_late_and_catches_up_votes():
     """A 4th validator connecting after the others started still joins
     consensus (vote catch-up via gossip; no blocksync needed when it

@@ -221,3 +221,27 @@ def test_consensus_runs_on_filepv(tmp_path):
         return True
 
     assert run(main())
+
+
+def test_filepv_secp256k1_key_type(tmp_path):
+    """FilePV with a secp256k1 validator key round-trips through the key
+    file and signs votes (reference gen-validator --key-type)."""
+    from cometbft_tpu.privval import FilePV
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp, key_type="secp256k1")
+    assert pv.get_pub_key().type() == "secp256k1"
+    pv2 = FilePV.load(kp, sp)
+    assert pv2.get_pub_key() == pv.get_pub_key()
+    # legacy key files without a type field still load as ed25519
+    import json as _json
+
+    pv3 = FilePV.generate(str(tmp_path / "k3.json"),
+                          str(tmp_path / "s3.json"))
+    with open(str(tmp_path / "k3.json")) as f:
+        kd = _json.load(f)
+    kd.pop("type")
+    with open(str(tmp_path / "k3.json"), "w") as f:
+        _json.dump(kd, f)
+    pv4 = FilePV.load(str(tmp_path / "k3.json"), str(tmp_path / "s3.json"))
+    assert pv4.get_pub_key().type() == "ed25519"
