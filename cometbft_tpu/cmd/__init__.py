@@ -116,9 +116,53 @@ async def _start_async(args) -> int:
             return 1
         app = KVStoreApplication()
 
+    state_sync_provider = None
+    if cfg.statesync.enable:
+        # config-driven snapshot bootstrap (statesync.rpc_servers +
+        # trust anchor -> light-client-verified state provider;
+        # node/setup.go's stateProvider wiring)
+        from ..light import Client, TrustOptions
+        from ..light.rpc_provider import RPCProvider
+        from ..statesync import StateProvider
+
+        servers = [s.strip() for s in cfg.statesync.rpc_servers
+                   if s.strip()]
+        if (not servers or cfg.statesync.trust_height <= 0
+                or not cfg.statesync.trust_hash):
+            print("statesync.enable requires rpc_servers, trust_height "
+                  "> 0, and trust_hash", file=sys.stderr)
+            return 1
+        try:
+            trust_hash = bytes.fromhex(cfg.statesync.trust_hash)
+        except ValueError:
+            print(f"bad statesync.trust_hash "
+                  f"{cfg.statesync.trust_hash!r}: expected hex",
+                  file=sys.stderr)
+            return 1
+
+        def _hp(s):
+            h, _, p = s.removeprefix("tcp://").rpartition(":")
+            if not p.isdigit():
+                print(f"bad statesync.rpc_servers entry {s!r}: "
+                      f"expected host:port", file=sys.stderr)
+                raise SystemExit(1)
+            return h or "127.0.0.1", int(p)
+
+        providers = [RPCProvider(*_hp(s), f"ss{i}")
+                     for i, s in enumerate(servers)]
+        light = Client(
+            doc.chain_id,
+            TrustOptions(cfg.statesync.trust_period,
+                         cfg.statesync.trust_height,
+                         trust_hash),
+            providers[0], witnesses=providers[1:],
+            backend=cfg.base.signature_backend)
+        state_sync_provider = StateProvider(light, doc)
+
     node = await Node.create(doc, app, priv_validator=pv, config=cfg,
                              node_key=nk, home=home,
                              fast_sync=cfg.blocksync.enable,
+                             state_sync_provider=state_sync_provider,
                              name=cfg.base.moniker)
     await node.start()
     print(f"Node {nk.id} started: p2p {node.listen_addr}, "
