@@ -104,9 +104,37 @@ async def _start_async(args) -> int:
     cfg = _load_home(home)
     doc = GenesisDoc.load(_join(home, cfg.base.genesis_file))
     nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
-    pv = FilePV.load_or_generate(
-        _join(home, cfg.base.priv_validator_key_file),
-        _join(home, cfg.base.priv_validator_state_file))
+    signer_listener = None
+    if cfg.base.priv_validator_laddr:
+        # node listens; the remote signer process dials in
+        # (privval/signer_listener_endpoint.go)
+        from ..privval.signer import SignerListener
+
+        lhost, _, lport = (cfg.base.priv_validator_laddr
+                           .removeprefix("tcp://").rpartition(":"))
+        if not lport.isdigit():
+            print(f"bad priv_validator_laddr "
+                  f"{cfg.base.priv_validator_laddr!r}: expected host:port",
+                  file=sys.stderr)
+            return 1
+        from ..privval.signer import RemoteSignerError
+
+        signer_listener = SignerListener()
+        await signer_listener.listen(lhost or "127.0.0.1", int(lport))
+        print(f"Waiting for remote signer on "
+              f"{cfg.base.priv_validator_laddr} ...")
+        try:
+            await signer_listener.wait_for_signer(timeout=120.0)
+        except RemoteSignerError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        # the listener itself is the PrivValidator: it re-accepts the
+        # signer's redial if the connection drops
+        pv = signer_listener
+    else:
+        pv = FilePV.load_or_generate(
+            _join(home, cfg.base.priv_validator_key_file),
+            _join(home, cfg.base.priv_validator_state_file))
 
     app = None
     if cfg.base.abci == "builtin":
@@ -195,6 +223,8 @@ async def _start_async(args) -> int:
     for t in dial_tasks:
         t.cancel()
     await node.stop()
+    if signer_listener is not None:
+        await signer_listener.close()
     return 0
 
 
@@ -508,6 +538,36 @@ def cmd_debug_dump(args) -> int:
     return 0
 
 
+def cmd_signer(args) -> int:
+    """Remote signer daemon: load this home's FilePV and dial the node's
+    priv_validator_laddr, serving sign requests over the connection
+    (privval/signer_dialer_endpoint.go + signer_server.go)."""
+    from ..privval import FilePV
+    from ..privval.signer import serve_dialer
+
+    cfg = _load_home(args.home)
+    pv = FilePV.load_or_generate(
+        _join(args.home, cfg.base.priv_validator_key_file),
+        _join(args.home, cfg.base.priv_validator_state_file))
+    host, _, port = args.address.removeprefix("tcp://").rpartition(":")
+    if not port.isdigit():
+        print(f"bad --address {args.address!r}: expected host:port",
+              file=sys.stderr)
+        return 1
+    print(f"Serving validator {pv.get_pub_key().address().hex()} to "
+          f"{args.address}", flush=True)
+
+    async def main():
+        await serve_dialer(pv, host or "127.0.0.1", int(port),
+                           max_retries=args.max_retries)
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """commands/inspect.go: read-only RPC over a crashed node's data dir."""
     return asyncio.run(_inspect_async(args))
@@ -635,6 +695,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="trusting period in seconds")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("signer", help="remote signer daemon: serve this "
+                        "home's validator key to a node's "
+                        "priv_validator_laddr")
+    sp.add_argument("--address", required=True,
+                    help="node's priv_validator_laddr (tcp://host:port)")
+    sp.add_argument("--max-retries", type=int, default=0,
+                    help="dial attempts before giving up (0 = forever)")
+    sp.set_defaults(fn=cmd_signer)
 
     sp = sub.add_parser("inspect",
                         help="read-only RPC over the data directory")

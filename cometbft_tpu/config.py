@@ -90,6 +90,7 @@ class RPCConfig:
     grpc_laddr: str = ""              # block/version/pruning gRPC services
     max_open_connections: int = 900
     max_subscription_clients: int = 100
+    unsafe: bool = False              # dial_seeds/dial_peers/flush_mempool
 
 
 @dataclass
@@ -135,6 +136,10 @@ class BaseConfig:
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
     node_key_file: str = "config/node_key.json"
+    # when set (tcp://host:port), the node listens here and uses the
+    # remote signer that dials in instead of the file PV
+    # (privval/signer_listener_endpoint.go)
+    priv_validator_laddr: str = ""
     abci: str = "builtin"             # builtin | socket
     proxy_app: str = "kvstore"
     signature_backend: str = "auto"   # auto | tpu | jax | cpu  <- TPU seam

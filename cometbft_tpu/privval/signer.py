@@ -17,7 +17,8 @@ import struct
 
 import msgpack
 
-from ..crypto.keys import Ed25519PubKey, PubKey
+from ..crypto.keys import (ED25519_KEY_TYPE, PubKey,
+                           pub_key_from_type_bytes)
 from ..types import codec
 from ..types.priv_validator import PrivValidator
 from ..types.vote import Proposal, Vote
@@ -79,8 +80,9 @@ class SignerServer:
             if tag == "ping":
                 return {"@": "pong"}
             if tag == "pubkey_req":
-                return {"@": "pubkey_res",
-                        "pub": self.pv.get_pub_key().bytes()}
+                pub = self.pv.get_pub_key()
+                return {"@": "pubkey_res", "pub": pub.bytes(),
+                        "type": pub.type()}
             if tag == "sign_vote_req":
                 vote: Vote = codec.from_dict(req["vote"])
                 await self.pv.sign_vote(req["chain_id"], vote,
@@ -109,11 +111,19 @@ class SignerClient(PrivValidator):
     @classmethod
     async def connect(cls, host: str, port: int) -> "SignerClient":
         reader, writer = await asyncio.open_connection(host, port)
+        return await cls.from_streams(reader, writer)
+
+    @classmethod
+    async def from_streams(cls, reader, writer) -> "SignerClient":
+        """Handshake over an already-open connection (either dial
+        direction ends up here)."""
         await _send(writer, {"@": "pubkey_req"})
         res = await _recv(reader)
         if res.get("@") != "pubkey_res":
             raise RemoteSignerError(f"bad pubkey response: {res}")
-        return cls(reader, writer, Ed25519PubKey(res["pub"]))
+        pub = pub_key_from_type_bytes(res.get("type", ED25519_KEY_TYPE),
+                                      res["pub"])
+        return cls(reader, writer, pub)
 
     async def close(self) -> None:
         self._writer.close()
@@ -149,3 +159,133 @@ class SignerClient(PrivValidator):
         signed: Proposal = codec.from_dict(res["proposal"])
         proposal.signature = signed.signature
         proposal.timestamp_ns = signed.timestamp_ns
+
+
+class SignerListener(PrivValidator):
+    """Node side of the reference topology: the node LISTENS on
+    ``priv_validator_laddr`` and the remote signer dials in
+    (``privval/signer_listener_endpoint.go``).
+
+    Itself a PrivValidator: every operation runs against the currently
+    connected signer, and a dropped connection triggers a re-accept of
+    the signer's redial (the reference endpoint's WaitForConnection), so
+    a signer restart does not halt the validator."""
+
+    def __init__(self, accept_timeout: float = 30.0):
+        self._server: asyncio.Server | None = None
+        self._accepted: asyncio.Queue = asyncio.Queue()
+        self._client: SignerClient | None = None
+        self._accept_timeout = accept_timeout
+        self._lock = asyncio.Lock()
+
+    async def listen(self, host: str = "127.0.0.1",
+                     port: int = 0) -> tuple[str, int]:
+        async def on_conn(reader, writer):
+            await self._accepted.put((reader, writer))
+
+        self._server = await asyncio.start_server(on_conn, host, port)
+        addr = self._server.sockets[0].getsockname()
+        return addr[0], addr[1]
+
+    async def wait_for_signer(self, timeout: float | None = None
+                              ) -> SignerClient:
+        """Accept connections until one completes the pubkey handshake
+        (a stray probe that connects without speaking is dropped)."""
+        deadline = asyncio.get_event_loop().time() + (
+            timeout if timeout is not None else self._accept_timeout)
+        while True:
+            remaining = deadline - asyncio.get_event_loop().time()
+            if remaining <= 0:
+                raise RemoteSignerError(
+                    "timed out waiting for the remote signer to connect")
+            try:
+                reader, writer = await asyncio.wait_for(
+                    self._accepted.get(), remaining)
+            except asyncio.TimeoutError:
+                raise RemoteSignerError(
+                    "timed out waiting for the remote signer to connect")
+            try:
+                self._client = await asyncio.wait_for(
+                    SignerClient.from_streams(reader, writer),
+                    min(5.0, max(0.1, remaining)))
+                return self._client
+            except Exception:
+                writer.close()
+
+    async def _reconnect(self) -> SignerClient:
+        old, self._client = self._client, None
+        if old is not None:
+            await old.close()
+        return await self.wait_for_signer()
+
+    async def _with_signer(self, op):
+        """Run op against the live client; on a dropped connection,
+        re-accept the signer's redial and retry once."""
+        async with self._lock:
+            if self._client is None:
+                await self.wait_for_signer()
+            try:
+                return await op(self._client)
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                await self._reconnect()
+                return await op(self._client)
+
+    # PrivValidator surface (delegates with reconnect)
+
+    def get_pub_key(self) -> PubKey:
+        if self._client is None:
+            raise RemoteSignerError("remote signer is not connected")
+        return self._client.get_pub_key()
+
+    async def sign_vote(self, chain_id: str, vote: Vote,
+                        sign_extension: bool) -> None:
+        await self._with_signer(
+            lambda c: c.sign_vote(chain_id, vote, sign_extension))
+
+    async def sign_proposal(self, chain_id: str, proposal) -> None:
+        await self._with_signer(
+            lambda c: c.sign_proposal(chain_id, proposal))
+
+    async def ping(self) -> None:
+        await self._with_signer(lambda c: c.ping())
+
+    async def close(self) -> None:
+        # close live + queued connections BEFORE wait_closed(): on 3.12
+        # the server waits for every connection transport to finish, so
+        # the reversed order deadlocks
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+        while not self._accepted.empty():
+            _, writer = self._accepted.get_nowait()
+            writer.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def serve_dialer(pv: PrivValidator, host: str, port: int,
+                       max_retries: int = 0,
+                       retry_interval: float = 1.0) -> None:
+    """Signer side of the reference topology: dial the node's
+    ``priv_validator_laddr`` and serve signing requests over the dialed
+    connection until it closes (``privval/signer_dialer_endpoint.go`` +
+    ``signer_server.go``).  Reconnects up to ``max_retries`` times
+    (0 = forever), covering node restarts."""
+    server = SignerServer(pv)
+    attempts = 0
+    while True:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError:
+            attempts += 1
+            if max_retries and attempts >= max_retries:
+                raise
+            await asyncio.sleep(retry_interval)
+            continue
+        attempts = 0
+        try:
+            await server._serve(reader, writer)
+        except Exception:        # malformed frame must not kill the daemon
+            writer.close()
+        await asyncio.sleep(retry_interval)

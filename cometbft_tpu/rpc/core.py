@@ -30,6 +30,7 @@ class Environment:
 
     def __init__(self, node):
         self.node = node
+        self._gen_chunks: list[bytes] | None = None   # computed once
 
     @property
     def block_store(self):
@@ -98,10 +99,37 @@ async def net_info(env: Environment) -> dict:
             "n_peers": len(peers), "peers": peers}
 
 
+_GENESIS_CHUNK_SIZE = 16 * 1024 * 1024   # rpc/core/env.go:32
+
+
 async def genesis(env: Environment) -> dict:
     import json as _json
 
-    return {"genesis": _json.loads(env.node.genesis.to_json())}
+    raw = env.node.genesis.to_json()
+    if len(raw.encode()) > _GENESIS_CHUNK_SIZE:
+        raise RPCError(-32603, "genesis response is large, please use the "
+                       "genesis_chunked API instead")
+    return {"genesis": _json.loads(raw)}
+
+
+async def genesis_chunked(env: Environment, chunk=0) -> dict:
+    """rpc/core/net.go:111 GenesisChunked: base64 16MB slices of the
+    genesis JSON, so arbitrarily large app_state stays servable.  The
+    chunk list is computed once (the genesis doc is immutable)."""
+    import base64
+
+    if env._gen_chunks is None:
+        raw = env.node.genesis.to_json().encode()
+        env._gen_chunks = [raw[i:i + _GENESIS_CHUNK_SIZE]
+                           for i in range(0, len(raw),
+                                          _GENESIS_CHUNK_SIZE)] or [b""]
+    chunks = env._gen_chunks
+    cid = int(chunk)
+    if not 0 <= cid < len(chunks):
+        raise RPCError(-32603, f"there are {len(chunks) - 1} chunks, "
+                       f"{cid} is invalid")
+    return {"chunk": cid, "total": len(chunks),
+            "data": base64.b64encode(chunks[cid]).decode()}
 
 
 # ---------------------------------------------------------------- blocks
@@ -115,14 +143,20 @@ async def block(env: Environment, height=None) -> dict:
     return {"block_id": jsonable(meta.block_id), "block": jsonable(blk)}
 
 
-async def block_by_hash(env: Environment, hash=None) -> dict:
-    want = bytes.fromhex(hash) if isinstance(hash, str) else hash
+def _height_by_hash(env: Environment, hash) -> int:
+    if hash is None:
+        raise RPCError(-32602, "missing block hash")
+    want = bytes.fromhex(hash) if isinstance(hash, str) else bytes(hash)
     bs = env.block_store
     for h in range(bs.height(), bs.base() - 1, -1):
         meta = bs.load_block_meta(h)
         if meta is not None and meta.block_id.hash == want:
-            return await block(env, h)
+            return h
     raise RPCError(-32603, f"block with hash {want.hex()} not found")
+
+
+async def block_by_hash(env: Environment, hash=None) -> dict:
+    return await block(env, _height_by_hash(env, hash))
 
 
 async def header(env: Environment, height=None) -> dict:
@@ -131,6 +165,10 @@ async def header(env: Environment, height=None) -> dict:
     if blk is None:
         raise RPCError(-32603, f"no block at height {h}")
     return {"header": jsonable(blk.header)}
+
+
+async def header_by_hash(env: Environment, hash=None) -> dict:
+    return await header(env, _height_by_hash(env, hash))
 
 
 async def commit(env: Environment, height=None) -> dict:
@@ -355,6 +393,14 @@ async def broadcast_tx_commit(env: Environment, tx=None,
         env.node.event_bus.unsubscribe(sub_id)
 
 
+async def check_tx(env: Environment, tx=None) -> dict:
+    """rpc/core/mempool.go:215 CheckTx: run the app's CheckTx without
+    adding the tx to the mempool."""
+    res = await env.node.app_conns.mempool.check_tx(_tx_bytes(tx))
+    return {"code": res.code, "data": res.data.hex(), "log": res.log,
+            "gas_wanted": res.gas_wanted}
+
+
 # ------------------------------------------------------------------ abci
 
 async def abci_info(env: Environment) -> dict:
@@ -455,6 +501,39 @@ async def block_search(env: Environment, query="", page=1,
         raise RPCError(-32602, f"bad query: {e}") from e
 
 
+# ---------------------------------------------------- unsafe (dev-only)
+
+async def dial_seeds(env: Environment, seeds=None) -> dict:
+    """rpc/core/net.go:46 UnsafeDialSeeds."""
+    from ..libs import log as tmlog
+
+    for addr in seeds or []:
+        try:
+            await env.node.switch.dial_peer(addr)
+        except Exception as e:          # best-effort, like the reference
+            tmlog.logger("rpc").error("dial_seeds", addr=addr, err=str(e))
+    return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+
+async def dial_peers(env: Environment, peers=None,
+                     persistent=False) -> dict:
+    """rpc/core/net.go:59 UnsafeDialPeers."""
+    from ..libs import log as tmlog
+
+    for addr in peers or []:
+        try:
+            await env.node.switch.dial_peer(addr, persistent=bool(persistent))
+        except Exception as e:
+            tmlog.logger("rpc").error("dial_peers", addr=addr, err=str(e))
+    return {"log": "Dialing peers in progress. See /net_info for details"}
+
+
+async def unsafe_flush_mempool(env: Environment) -> dict:
+    """rpc/core/dev.go:9 UnsafeFlushMempool."""
+    await env.node.mempool.flush()
+    return {}
+
+
 ROUTES = {
     "health": health,
     "status": status,
@@ -483,4 +562,14 @@ ROUTES = {
     "tx": tx,
     "tx_search": tx_search,
     "block_search": block_search,
+    "header_by_hash": header_by_hash,
+    "genesis_chunked": genesis_chunked,
+    "check_tx": check_tx,
+}
+
+# registered only when config rpc.unsafe is set (rpc/core/routes.go:57-62)
+UNSAFE_ROUTES = {
+    "dial_seeds": dial_seeds,
+    "dial_peers": dial_peers,
+    "unsafe_flush_mempool": unsafe_flush_mempool,
 }

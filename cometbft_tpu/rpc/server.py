@@ -91,7 +91,15 @@ class RPCServer:
         """``routes`` overrides the default route table (the light proxy
         serves verified routes against a light client instead)."""
         self.env = Environment(node)
-        self.routes = routes if routes is not None else ROUTES
+        if routes is not None:
+            self.routes = routes
+        else:
+            self.routes = dict(ROUTES)
+            cfg = getattr(node, "config", None)
+            if cfg is not None and getattr(cfg.rpc, "unsafe", False):
+                from .core import UNSAFE_ROUTES
+
+                self.routes.update(UNSAFE_ROUTES)
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._ws_counter = 0

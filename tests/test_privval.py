@@ -200,6 +200,46 @@ def test_remote_signer_roundtrip(tmp_path):
     assert run(main())
 
 
+def test_signer_listener_dialer_topology(tmp_path):
+    """Reference direction (privval/signer_listener_endpoint.go): the node
+    listens on priv_validator_laddr, the remote signer dials in and serves
+    the key over the dialed connection."""
+    from cometbft_tpu.privval.signer import SignerListener, serve_dialer
+
+    pv = _pv(tmp_path)
+
+    async def main():
+        listener = SignerListener()
+        host, port = await listener.listen()
+        dial_task = asyncio.create_task(
+            serve_dialer(pv, host, port, max_retries=5))
+        try:
+            await listener.wait_for_signer(timeout=10)
+            assert listener.get_pub_key().bytes() == pv.get_pub_key().bytes()
+            await listener.ping()
+            v = _vote(listener)
+            await listener.sign_vote(CHAIN, v, sign_extension=False)
+            assert listener.get_pub_key().verify_signature(
+                v.sign_bytes(CHAIN), v.signature)
+
+            # signer restart: the node re-accepts the redial and keeps
+            # signing (privval/signer_listener_endpoint.go semantics)
+            dial_task.cancel()
+            await asyncio.sleep(0)
+            dial_task = asyncio.create_task(
+                serve_dialer(pv, host, port, max_retries=5))
+            v2 = _vote(listener, height=6)
+            await listener.sign_vote(CHAIN, v2, sign_extension=False)
+            assert listener.get_pub_key().verify_signature(
+                v2.sign_bytes(CHAIN), v2.signature)
+        finally:
+            await listener.close()
+            dial_task.cancel()
+        return True
+
+    assert run(main())
+
+
 def test_consensus_runs_on_filepv(tmp_path):
     """The in-proc network commits with FilePV signers: double-sign
     protection is compatible with the live state machine."""

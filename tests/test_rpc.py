@@ -92,10 +92,12 @@ def test_rpc_full_surface_over_http():
             txs = blk["block"]["data"]["txs"]
             assert {"~b": b"rk=rv".hex()} in txs
 
-            # block_by_hash round-trips
+            # block_by_hash / header_by_hash round-trip
             bh = blk["block_id"]["hash"]["~b"]
             blk2 = await cli.call("block_by_hash", hash=bh)
             assert blk2["block"]["hdr"]["h"] == committed_h
+            hd = await cli.call("header_by_hash", hash=bh)
+            assert hd["header"]["h"] == committed_h
 
             cm = await cli.call("commit", height=committed_h)
             assert cm["commit"]["h"] == committed_h
@@ -130,6 +132,27 @@ def test_rpc_full_surface_over_http():
             gen = await cli.call("genesis")
             assert gen["genesis"]["chain_id"] == "rpc-net"
 
+            # chunked genesis reassembles to the same doc
+            import base64
+            gc = await cli.call("genesis_chunked", chunk=0)
+            raw = b""
+            for i in range(gc["total"]):
+                part = await cli.call("genesis_chunked", chunk=i)
+                raw += base64.b64decode(part["data"])
+            assert json.loads(raw)["chain_id"] == "rpc-net"
+            with pytest.raises(RPCError):
+                await cli.call("genesis_chunked", chunk=gc["total"])
+
+            # check_tx runs CheckTx without mempool insertion
+            ct = await cli.call("check_tx", tx=b"ck=cv".hex())
+            assert ct["code"] == 0
+            ct_bad = await cli.call("check_tx", tx=b"notakv".hex())
+            assert ct_bad["code"] != 0
+
+            # unsafe routes are not registered without rpc.unsafe
+            with pytest.raises(RPCError):
+                await cli.call("unsafe_flush_mempool")
+
             nut = await cli.call("num_unconfirmed_txs")
             assert nut["n_txs"] >= 0
 
@@ -142,6 +165,53 @@ def test_rpc_full_surface_over_http():
                 await cli.call("tx", hash="00" * 32)
             with pytest.raises(RPCError):
                 await cli.call("nonexistent_method")
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
+def test_rpc_unsafe_routes():
+    """rpc/core/{net,dev}.go unsafe routes, gated by rpc.unsafe: wire two
+    isolated validators together via dial_peers, then flush the mempool."""
+    async def main():
+        pvs = [MockPV.from_secret(b"unsafe%d" % i) for i in range(2)]
+        doc = GenesisDoc(chain_id="unsafe-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = _config()
+            cfg.rpc.unsafe = True
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+                node_key=NodeKey.from_secret(b"uk%d" % i), name=f"un{i}")
+            nodes.append(node)
+            await node.start()
+        try:
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            ni = await cli.call("net_info")
+            assert ni["n_peers"] == 0
+
+            await cli.call("dial_peers", peers=[nodes[1].listen_addr],
+                           persistent=True)
+            deadline = asyncio.get_event_loop().time() + 30
+            while (await cli.call("net_info"))["n_peers"] < 1:
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+            # with both validators wired, blocks start committing
+            while True:
+                st = await cli.call("status")
+                if st["sync_info"]["latest_block_height"] >= 1:
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+            assert await cli.call("unsafe_flush_mempool") == {}
+            nut = await cli.call("num_unconfirmed_txs")
+            assert nut["n_txs"] == 0
         finally:
             await _stop(nodes)
         return True

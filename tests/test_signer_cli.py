@@ -1,0 +1,106 @@
+"""Remote signer e2e across OS processes: a validator's key lives in a
+separate `signer` daemon that dials the node's priv_validator_laddr
+(reference topology: ``privval/signer_listener_endpoint.go`` on the node,
+``signer_dialer_endpoint.go`` + ``signer_server.go`` in the signer)."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytestmark = pytest.mark.timeout(150)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE_PORT = 29260
+SIGNER_PORT = 29280
+
+
+def _run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "cometbft_tpu", *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+
+
+def _spawn(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", *args],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env, cwd=REPO)
+
+
+def test_remote_signer_validator_commits(tmp_path):
+    """2-of-2 validator net where node1 signs through the remote signer
+    daemon: blocks can only commit if the remote signing path works."""
+    from cometbft_tpu.config import Config
+
+    base = str(tmp_path / "net")
+    res = _run_cli("testnet", "--v", "2", "--output-dir", base,
+                   "--base-port", str(BASE_PORT), "--chain-id", "signer-net")
+    assert res.returncode == 0, res.stderr
+
+    for i in range(2):
+        cfgp = f"{base}/node{i}/config/config.toml"
+        cfg = Config.load(cfgp)
+        cfg.consensus.timeout_propose = 300_000_000
+        cfg.consensus.timeout_prevote = 150_000_000
+        cfg.consensus.timeout_precommit = 150_000_000
+        cfg.consensus.timeout_commit = 100_000_000
+        cfg.base.signature_backend = "cpu"
+        if i == 1:
+            cfg.base.priv_validator_laddr = \
+                f"tcp://127.0.0.1:{SIGNER_PORT}"
+        cfg.save(cfgp)
+
+    procs = []
+    try:
+        procs.append(_spawn("--home", f"{base}/node0", "start"))
+        procs.append(_spawn("--home", f"{base}/node1", "start"))
+        # the signer daemon holds node1's key and dials the node
+        procs.append(_spawn("--home", f"{base}/node1", "signer",
+                            "--address", f"tcp://127.0.0.1:{SIGNER_PORT}"))
+
+        async def scenario():
+            from cometbft_tpu.rpc import HTTPClient, RPCError
+
+            clis = [HTTPClient("127.0.0.1", BASE_PORT + 2 * i + 1)
+                    for i in range(2)]
+
+            async def call(cli, method, timeout=90.0, **kw):
+                deadline = time.monotonic() + timeout
+                while True:
+                    try:
+                        return await cli.call(method, **kw)
+                    except (OSError, RPCError, asyncio.TimeoutError):
+                        if time.monotonic() > deadline:
+                            raise
+                        await asyncio.sleep(0.3)
+
+            res = await call(clis[0], "broadcast_tx_commit",
+                             tx=b"sgk=sgv".hex())
+            assert res["tx_result"]["code"] == 0
+            h = res["height"]
+            for cli in clis:
+                while True:
+                    st = await call(cli, "status")
+                    if st["sync_info"]["latest_block_height"] >= h:
+                        break
+                    await asyncio.sleep(0.3)
+            b0 = await call(clis[0], "block", height=h)
+            b1 = await call(clis[1], "block", height=h)
+            assert b0["block_id"]["hash"] == b1["block_id"]["hash"]
+
+        asyncio.run(scenario())
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
