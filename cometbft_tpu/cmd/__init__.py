@@ -739,6 +739,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="force-compact the data-dir stores")
     sp.set_defaults(fn=cmd_compact_db)
 
+    from .abci import register as register_abci
+
+    register_abci(sub)
+
     sp = sub.add_parser("debug", help="post-mortem capture")
     dsub = sp.add_subparsers(dest="debug_command", required=True)
     dp = dsub.add_parser("dump", help="capture an introspection bundle")
