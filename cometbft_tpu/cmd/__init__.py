@@ -487,6 +487,30 @@ def cmd_compact_db(args) -> int:
     return 0
 
 
+def cmd_debug_wal(args) -> int:
+    """scripts/wal2json analogue: dump consensus WAL records as JSON
+    lines.  Strictly read-only — safe on a crashed node's torn WAL (the
+    node's own open would truncate the torn tail; this never writes)."""
+    import json as _json
+
+    cfg = _load_home(args.home)
+    from ..consensus.wal import WALError, iter_wal_records_readonly
+    from ..rpc.json import _hexify
+
+    n = 0
+    try:
+        for rec in iter_wal_records_readonly(
+                _join(args.home, cfg.consensus.wal_path)):
+            print(_json.dumps(_hexify(rec)))
+            n += 1
+    except WALError as e:
+        print(f"# {n} records", file=sys.stderr)
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"# {n} records", file=sys.stderr)
+    return 0
+
+
 def cmd_debug_dump(args) -> int:
     """commands/debug: capture a post-mortem bundle — node introspection
     over RPC when the node is up, plus config and WAL/data listings."""
@@ -749,6 +773,9 @@ def build_parser() -> argparse.ArgumentParser:
     dp.add_argument("--rpc", default="127.0.0.1:26657")
     dp.add_argument("--output-dir", default="")
     dp.set_defaults(fn=cmd_debug_dump)
+    dp = dsub.add_parser("wal", help="dump consensus WAL records as "
+                         "JSON lines (scripts/wal2json)")
+    dp.set_defaults(fn=cmd_debug_wal)
     return p
 
 

@@ -82,6 +82,16 @@ class P2PConfig:
     # (reference test/e2e/runner/latency_emulation.go)
     emulated_latency_ms: float = 0.0
     addr_book_path: str = "config/addrbook.json"
+    # fault injection on every peer stream (p2p/fuzz.go FuzzedConnection,
+    # config.FuzzConnConfig); fuzzing starts 10s after connect like
+    # p2p/transport.go:223
+    test_fuzz: bool = False
+    fuzz_mode: str = "drop"           # drop | delay
+    fuzz_max_delay_s: float = 3.0
+    fuzz_prob_drop_rw: float = 0.01
+    fuzz_prob_drop_conn: float = 0.0
+    fuzz_prob_sleep: float = 0.0
+    fuzz_start_after_s: float = 10.0
 
 
 @dataclass
@@ -235,6 +245,9 @@ class Config:
             raise ConfigError(
                 f"tx_index.indexer must be kv|null, "
                 f"got {self.tx_index.indexer!r}")
+        if self.p2p.fuzz_mode not in ("drop", "delay"):
+            raise ConfigError(f"p2p.fuzz_mode must be drop|delay, "
+                              f"got {self.p2p.fuzz_mode!r}")
 
 
 class ConfigError(Exception):

@@ -31,6 +31,69 @@ class WALError(Exception):
     pass
 
 
+def wal_segments(path: str) -> list[str]:
+    """Existing segment paths in write order (directory scan: pruning may
+    leave index gaps)."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    found = []          # (index, path); the bare path is index 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        names = []
+    for name in names:
+        if name == base:
+            found.append((0, path))
+        elif name.startswith(base + "."):
+            suffix = name[len(base) + 1:]
+            if suffix.isdigit():
+                found.append((int(suffix), os.path.join(d, name)))
+    return [p for _, p in sorted(found)]
+
+
+def _iter_segment_file(path: str):
+    """Yields records; final item is the sentinel True when the whole
+    segment decoded cleanly, False when it ended in corruption."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        yield False
+        return
+    off = 0
+    while off + _HDR.size <= len(raw):
+        crc, ln = _HDR.unpack_from(raw, off)
+        end = off + _HDR.size + ln
+        if ln > MAX_BODY or end > len(raw) or \
+                zlib.crc32(raw[off + _HDR.size:end]) != crc:
+            yield off == len(raw)
+            return
+        yield msgpack.unpackb(raw[off + _HDR.size:end], raw=False)
+        off = end
+    yield True
+
+
+def iter_wal_records_readonly(path: str):
+    """Strictly read-only record stream across segments for tooling
+    (scripts/wal2json): no truncation, no append handle, no fsync, no
+    directory creation.  Raises WALError if the WAL does not exist;
+    raises WALError at a corrupt record (after yielding everything intact
+    before it) so callers can report instead of silently stopping."""
+    segs = wal_segments(path)
+    if not segs:
+        raise WALError(f"no WAL at {path}")
+    for seg in segs:
+        clean = False
+        for item in _iter_segment_file(seg):
+            if isinstance(item, bool):
+                clean = item
+                break
+            yield item
+        if not clean:
+            raise WALError(f"corrupt record in {seg}; later segments "
+                           f"not decoded")
+
+
 class WAL:
     def __init__(self, path: str,
                  max_segment_bytes: int = DEFAULT_SEGMENT_BYTES):
@@ -51,23 +114,7 @@ class WAL:
     # ------------------------------------------------------------ segments
 
     def _segments(self) -> list[str]:
-        """Existing segment paths in write order (directory scan: pruning
-        may leave index gaps)."""
-        d = os.path.dirname(self.path) or "."
-        base = os.path.basename(self.path)
-        found = []          # (index, path); the bare path is index 0
-        try:
-            names = os.listdir(d)
-        except OSError:
-            names = []
-        for name in names:
-            if name == base:
-                found.append((0, self.path))
-            elif name.startswith(base + "."):
-                suffix = name[len(base) + 1:]
-                if suffix.isdigit():
-                    found.append((int(suffix), os.path.join(d, name)))
-        return [p for _, p in sorted(found)]
+        return wal_segments(self.path)
 
     def _next_segment_path(self) -> str:
         segs = self._segments()
@@ -160,25 +207,7 @@ class WAL:
     # --------------------------------------------------------------- read
 
     def _iter_segment(self, path: str):
-        """Yields records; final item is the sentinel True when the whole
-        segment decoded cleanly, False when it ended in corruption."""
-        try:
-            with open(path, "rb") as f:
-                raw = f.read()
-        except OSError:
-            yield False
-            return
-        off = 0
-        while off + _HDR.size <= len(raw):
-            crc, ln = _HDR.unpack_from(raw, off)
-            end = off + _HDR.size + ln
-            if ln > MAX_BODY or end > len(raw) or \
-                    zlib.crc32(raw[off + _HDR.size:end]) != crc:
-                yield off == len(raw)
-                return
-            yield msgpack.unpackb(raw[off + _HDR.size:end], raw=False)
-            off = end
-        yield True
+        return _iter_segment_file(path)
 
     def iter_records(self):
         """All intact records across segments, oldest first.  Stops at the

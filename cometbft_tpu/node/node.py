@@ -235,7 +235,19 @@ class Node:
             self.blocksync_reactor.hold = True
 
         self.node_key = node_key or NodeKey.generate()
-        self.transport = Transport(self.node_key, self._node_info)
+        fuzz_cfg = None
+        if cfg.p2p.test_fuzz:
+            from ..p2p.fuzz import FuzzConnConfig
+
+            fuzz_cfg = FuzzConnConfig(
+                mode=cfg.p2p.fuzz_mode,
+                max_delay_s=cfg.p2p.fuzz_max_delay_s,
+                prob_drop_rw=cfg.p2p.fuzz_prob_drop_rw,
+                prob_drop_conn=cfg.p2p.fuzz_prob_drop_conn,
+                prob_sleep=cfg.p2p.fuzz_prob_sleep,
+                start_after_s=cfg.p2p.fuzz_start_after_s)
+        self.transport = Transport(self.node_key, self._node_info,
+                                   fuzz_config=fuzz_cfg)
         self.switch = Switch(
             self.transport,
             emulated_latency=cfg.p2p.emulated_latency_ms / 1e3)

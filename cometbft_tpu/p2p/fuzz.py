@@ -1,0 +1,121 @@
+"""Fuzzed peer connections (reference: ``p2p/fuzz.go`` FuzzedConnection
++ ``config.FuzzConnConfig``): wrap the raw stream pair under the
+SecretConnection and, per IO, randomly delay, drop writes, or kill the
+connection.
+
+Dropping an *encrypted frame* write desynchronizes the AEAD nonce
+sequence, so the peer's next decrypt fails and the connection tears down
+through the real error path — exactly the class of fault the production
+stack must absorb (switch reconnect with backoff, mempool/consensus
+gossip resume)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+MODE_DROP = "drop"
+MODE_DELAY = "delay"
+
+
+class FuzzConnConfig:
+    """config.FuzzConnConfig defaults (config/config.go
+    DefaultFuzzConnConfig): drop mode, 3s max delay, 1% drop/kill."""
+
+    def __init__(self, mode: str = MODE_DROP,
+                 max_delay_s: float = 3.0,
+                 prob_drop_rw: float = 0.01,
+                 prob_drop_conn: float = 0.0,
+                 prob_sleep: float = 0.0,
+                 start_after_s: float = 0.0,
+                 seed: int | None = None):
+        self.mode = mode
+        self.max_delay_s = max_delay_s
+        self.prob_drop_rw = prob_drop_rw
+        self.prob_drop_conn = prob_drop_conn
+        self.prob_sleep = prob_sleep
+        self.start_after_s = start_after_s
+        self.rng = random.Random(seed)
+
+
+class _Fuzzer:
+    def __init__(self, cfg: FuzzConnConfig, writer):
+        self.cfg = cfg
+        self.writer = writer
+        self._t0 = time.monotonic()
+
+    def _active(self) -> bool:
+        return (time.monotonic() - self._t0) >= self.cfg.start_after_s
+
+    async def fuzz(self) -> bool:
+        """Returns True if this IO should be swallowed (fuzz.go:110)."""
+        if not self._active():
+            return False
+        cfg = self.cfg
+        if cfg.mode == MODE_DELAY:
+            await asyncio.sleep(cfg.rng.random() * cfg.max_delay_s)
+            return False
+        r = cfg.rng.random()
+        if r <= cfg.prob_drop_rw:
+            return True
+        if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
+            self.writer.close()
+            return True
+        if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
+            await asyncio.sleep(cfg.rng.random() * cfg.max_delay_s)
+        return False
+
+
+class FuzzedReader:
+    """Duck-types the StreamReader surface SecretConnection uses."""
+
+    def __init__(self, reader: asyncio.StreamReader, fuzzer: _Fuzzer):
+        self._reader = reader
+        self._fuzzer = fuzzer
+
+    async def readexactly(self, n: int) -> bytes:
+        # reads can only be delayed, not dropped: a swallowed read on a
+        # reliable stream would silently shift the frame boundary
+        f = self._fuzzer
+        if f._active() and f.cfg.mode == MODE_DELAY:
+            await asyncio.sleep(f.cfg.rng.random() * f.cfg.max_delay_s)
+        return await self._reader.readexactly(n)
+
+    def __getattr__(self, name):
+        return getattr(self._reader, name)
+
+
+class FuzzedWriter:
+    """Duck-types the StreamWriter surface SecretConnection uses."""
+
+    def __init__(self, writer: asyncio.StreamWriter, fuzzer: _Fuzzer):
+        self._writer = writer
+        self._fuzzer = fuzzer
+        self._buffer = b""
+
+    def write(self, data: bytes) -> None:
+        # write() is sync in asyncio; the probabilistic decision is taken
+        # at drain() (the flush point), dropping everything buffered since
+        self._buffer += bytes(data)
+
+    async def drain(self) -> None:
+        data, self._buffer = self._buffer, b""
+        if await self._fuzzer.fuzz():
+            return                     # swallowed: peer never sees it
+        if data:
+            self._writer.write(data)
+        await self._writer.drain()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def __getattr__(self, name):
+        return getattr(self._writer, name)
+
+
+def fuzz_streams(reader, writer, cfg: FuzzConnConfig):
+    """Wrap a stream pair (FuzzConnAfterFromConfig when
+    cfg.start_after_s > 0, FuzzConnFromConfig otherwise)."""
+    fz = _Fuzzer(cfg, writer)
+    return FuzzedReader(reader, fz), FuzzedWriter(writer, fz)

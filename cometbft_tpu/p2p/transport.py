@@ -24,13 +24,24 @@ class TransportError(Exception):
 
 class Transport:
     def __init__(self, node_key: NodeKey, node_info_fn,
-                 handshake_timeout: float = HANDSHAKE_TIMEOUT):
+                 handshake_timeout: float = HANDSHAKE_TIMEOUT,
+                 fuzz_config=None):
         self.node_key = node_key
         self.node_info_fn = node_info_fn      # () -> NodeInfo (fresh copy)
         self.handshake_timeout = handshake_timeout
+        # p2p/transport.go:223 — fault-injection wrapper around every raw
+        # stream pair (a p2p.fuzz.FuzzConnConfig, or None)
+        self.fuzz_config = fuzz_config
         self._server: asyncio.AbstractServer | None = None
         self.listen_addr: str | None = None
         self.on_accept = None   # async (SecretConnection, NodeInfo) -> None
+
+    def _maybe_fuzz(self, reader, writer):
+        if self.fuzz_config is None:
+            return reader, writer
+        from .fuzz import fuzz_streams
+
+        return fuzz_streams(reader, writer, self.fuzz_config)
 
     # ------------------------------------------------------------- listen
 
@@ -44,8 +55,9 @@ class Transport:
 
     async def _handle_accept(self, reader, writer) -> None:
         try:
+            freader, fwriter = self._maybe_fuzz(reader, writer)
             conn, ni = await asyncio.wait_for(
-                self._upgrade(reader, writer), self.handshake_timeout)
+                self._upgrade(freader, fwriter), self.handshake_timeout)
         except Exception:
             writer.close()
             return
@@ -64,8 +76,9 @@ class Transport:
         host, port = addr.removeprefix("tcp://").rsplit(":", 1)
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
+            freader, fwriter = self._maybe_fuzz(reader, writer)
             return await asyncio.wait_for(
-                self._upgrade(reader, writer), self.handshake_timeout)
+                self._upgrade(freader, fwriter), self.handshake_timeout)
         except Exception:
             writer.close()
             raise

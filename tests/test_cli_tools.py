@@ -85,6 +85,14 @@ def test_reindex_and_compact_and_debug_dump(tmp_path):
     assert bs.height() >= 1
     assert bs.load_block(bs.height()) is not None
 
+    # -------- debug wal dumps JSON-lines records from the consensus WAL
+    res = _run_cli("debug", "wal", home=home)
+    assert res.returncode == 0, res.stdout + res.stderr
+    recs = [json.loads(line) for line in res.stdout.splitlines() if line]
+    assert len(recs) >= 1
+    kinds = {r.get("#") for r in recs}
+    assert "endheight" in kinds, kinds        # height sentinels present
+
     # -------- debug dump produces a bundle even with the node down
     out_dir = str(tmp_path / "bundle")
     res = _run_cli("debug", "dump", "--rpc", "127.0.0.1:1",  # unreachable

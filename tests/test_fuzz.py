@@ -207,3 +207,116 @@ def test_fuzz_jsonrpc_server():
         return True
 
     assert run(main())
+
+
+# ----------------------------------------------- fuzzed peer connection
+
+def test_fuzzed_connection_drop_and_kill():
+    """p2p/fuzz.go FuzzedConnection semantics: dropped writes are
+    swallowed whole, prob_drop_conn kills the stream, delay mode only
+    slows IO down."""
+    from cometbft_tpu.p2p.fuzz import (FuzzConnConfig, MODE_DELAY,
+                                       fuzz_streams)
+
+    async def main():
+        async def pair():
+            q = asyncio.Queue()
+
+            async def on_conn(r, w):
+                await q.put((r, w))
+
+            server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+            host, port = server.sockets[0].getsockname()
+            cr, cw = await asyncio.open_connection(host, port)
+            sr, sw = await q.get()
+            return server, (cr, cw), (sr, sw)
+
+        # 1) drop everything: the peer never sees the write
+        server, (cr, cw), (sr, sw) = await pair()
+        fr, fw = fuzz_streams(cr, cw, FuzzConnConfig(
+            prob_drop_rw=1.0, start_after_s=0.0, seed=1))
+        fw.write(b"swallowed")
+        await fw.drain()
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(sr.readexactly(9), 0.5)
+        server.close()
+
+        # 2) kill the connection
+        server, (cr, cw), (sr, sw) = await pair()
+        fr, fw = fuzz_streams(cr, cw, FuzzConnConfig(
+            prob_drop_rw=0.0, prob_drop_conn=1.0, start_after_s=0.0,
+            seed=2))
+        fw.write(b"x")
+        await fw.drain()
+        assert await sr.read(16) == b""      # EOF: conn was closed
+        server.close()
+
+        # 3) delay mode delivers everything, just late
+        server, (cr, cw), (sr, sw) = await pair()
+        fr, fw = fuzz_streams(cr, cw, FuzzConnConfig(
+            mode=MODE_DELAY, max_delay_s=0.05, start_after_s=0.0, seed=3))
+        for _ in range(5):
+            fw.write(b"abc")
+            await fw.drain()
+        assert await sr.readexactly(15) == b"abc" * 5
+        sw.close(); cw.close(); server.close()
+        return True
+
+    assert run(main())
+
+
+def test_network_commits_under_connection_fuzzing():
+    """4 in-proc nodes with p2p.test_fuzz dropping ~3% of logical writes
+    (AEAD nonce desync -> real teardown path) still commit blocks via
+    persistent-peer reconnect."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.config import test_consensus_config as _tcc
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    async def main():
+        pvs = [MockPV.from_secret(b"fz%d" % i) for i in range(4)]
+        doc = GenesisDoc(chain_id="fuzz-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = Config(consensus=_tcc())
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            cfg.p2p.test_fuzz = True
+            cfg.p2p.fuzz_start_after_s = 0.0
+            cfg.p2p.fuzz_prob_drop_rw = 0.03
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+                node_key=NodeKey.from_secret(b"fzk%d" % i), name=f"fz{i}")
+            nodes.append(node)
+            await node.start()
+        try:
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    try:
+                        await a.dial_peer(b.listen_addr, persistent=True)
+                    except Exception:
+                        pass        # fuzz may kill the first handshake
+            deadline = asyncio.get_event_loop().time() + 90
+            while True:
+                h = max(n.consensus.rs.height for n in nodes
+                        if n.consensus is not None)
+                if h >= 4:
+                    break
+                assert asyncio.get_event_loop().time() < deadline, \
+                    f"stuck at height {h} under fuzzing"
+                await asyncio.sleep(0.3)
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
