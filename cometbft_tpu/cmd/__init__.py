@@ -214,6 +214,24 @@ async def _start_async(args) -> int:
     dial_tasks = [asyncio.create_task(dial_with_retry(a.strip()))
                   for a in cfg.p2p.persistent_peers.split(",") if a.strip()]
 
+    async def dial_seed(addr: str) -> None:
+        # seeds bootstrap the address book; discovery continues via PEX
+        # (p2p/pex reactor ensure-peers), so one successful exchange is
+        # enough — no persistence
+        delay = 0.5
+        for _ in range(30):
+            try:
+                await node.dial_peer(addr, persistent=False)
+                return
+            except Exception as e:
+                if "duplicate peer" in str(e):
+                    return
+                await asyncio.sleep(delay)
+                delay = min(delay * 1.5, 5.0)
+
+    dial_tasks += [asyncio.create_task(dial_seed(a.strip()))
+                   for a in cfg.p2p.seeds.split(",") if a.strip()]
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -230,46 +248,17 @@ async def _start_async(args) -> int:
 
 def cmd_testnet(args) -> int:
     """commands/testnet.go: N wired node homes under one directory."""
-    from ..config import Config
-    from ..p2p import NodeKey
-    from ..privval import FilePV
-    from ..types.genesis import GenesisDoc, GenesisValidator
+    from ..e2e.gen import HomeSpec, generate_homes
 
     n = args.v
-    base = args.output_dir
-    keys, pvs = [], []
-    for i in range(n):
-        home = os.path.join(base, f"node{i}")
-        os.makedirs(os.path.join(home, "config"), exist_ok=True)
-        os.makedirs(os.path.join(home, "data"), exist_ok=True)
-        cfg = Config()
-        nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
-        pv = FilePV.load_or_generate(
-            _join(home, cfg.base.priv_validator_key_file),
-            _join(home, cfg.base.priv_validator_state_file))
-        keys.append(nk)
-        pvs.append(pv)
-
-    import time
-
-    doc = GenesisDoc(
-        chain_id=args.chain_id or "testnet",
-        genesis_time_ns=time.time_ns(),
-        validators=[GenesisValidator(pv.get_pub_key(), 10, f"node{i}")
-                    for i, pv in enumerate(pvs)])
-
-    for i in range(n):
-        home = os.path.join(base, f"node{i}")
-        cfg = Config()
-        cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{args.base_port + 2 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{args.base_port + 2 * i + 1}"
-        cfg.p2p.persistent_peers = ",".join(
-            f"tcp://127.0.0.1:{args.base_port + 2 * j}"
-            for j in range(n) if j != i)
-        cfg.save(_cfg_path(home))
-        doc.save(_join(home, cfg.base.genesis_file))
-    print(f"Generated {n}-node testnet in {base} "
+    specs = [HomeSpec(name=f"node{i}",
+                      p2p_port=args.base_port + 2 * i,
+                      rpc_port=args.base_port + 2 * i + 1,
+                      power=10)
+             for i in range(n)]
+    generate_homes(args.output_dir, specs,
+                   args.chain_id or "testnet")
+    print(f"Generated {n}-node testnet in {args.output_dir} "
           f"(ports {args.base_port}..{args.base_port + 2 * n - 1})")
     return 0
 
@@ -485,6 +474,29 @@ def cmd_compact_db(args) -> int:
         print(f"{name}: {before} -> {after} bytes")
     print(f"Reclaimed {total} bytes")
     return 0
+
+
+def cmd_e2e(args) -> int:
+    """test/e2e/runner analogue: run a manifest-described testnet of OS
+    processes, apply its perturbation schedule, check invariants."""
+    from ..e2e import Runner, RunnerError, load_manifest
+
+    try:
+        manifest = load_manifest(args.manifest)
+    except Exception as e:
+        print(f"bad manifest: {e}", file=sys.stderr)
+        return 1
+    runner = Runner(manifest, args.dir, base_port=args.base_port)
+    runner.setup()
+    try:
+        report = asyncio.run(runner.run(deadline_s=args.deadline))
+        print(json.dumps(report, indent=2))
+        return 0
+    except RunnerError as e:
+        print(f"e2e FAILED: {e}", file=sys.stderr)
+        return 1
+    finally:
+        runner.stop()
 
 
 def cmd_debug_wal(args) -> int:
@@ -766,6 +778,14 @@ def build_parser() -> argparse.ArgumentParser:
     from .abci import register as register_abci
 
     register_abci(sub)
+
+    sp = sub.add_parser("e2e", help="manifest-driven multi-process "
+                        "testnet runner (test/e2e)")
+    sp.add_argument("--manifest", required=True, help="TOML manifest path")
+    sp.add_argument("--dir", default="./e2e-net")
+    sp.add_argument("--base-port", type=int, default=26656)
+    sp.add_argument("--deadline", type=float, default=240.0)
+    sp.set_defaults(fn=cmd_e2e)
 
     sp = sub.add_parser("debug", help="post-mortem capture")
     dsub = sp.add_subparsers(dest="debug_command", required=True)

@@ -1,0 +1,8 @@
+"""Manifest-driven e2e testnets (reference: ``test/e2e``)."""
+
+from .manifest import (Manifest, ManifestError, NodeManifest,
+                       load_manifest, manifest_from_dict)
+from .runner import Runner, RunnerError
+
+__all__ = ["Manifest", "ManifestError", "NodeManifest", "Runner",
+           "RunnerError", "load_manifest", "manifest_from_dict"]

@@ -1,0 +1,135 @@
+"""e2e testnet manifest (reference: ``test/e2e/pkg/manifest.go``): a TOML
+description of a network — node roles, validator powers, late starts,
+perturbation schedule, load — that the runner turns into a live
+multi-process testnet on localhost.
+
+Example::
+
+    initial_height = 1
+    [validators]
+    validator01 = 10
+    validator02 = 10
+    validator03 = 10
+
+    [node.validator01]
+    [node.validator02]
+    perturb = ["kill:5", "restart:8"]
+    [node.validator03]
+    [node.full01]
+    mode = "full"
+    start_at = 4
+    [node.light01]
+    mode = "light"
+    start_at = 6
+
+    [load]
+    rate = 20.0
+    duration = 15.0
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MODES = ("validator", "full", "seed", "light")
+PERTURBATIONS = ("kill", "restart", "pause", "resume")
+
+
+class ManifestError(Exception):
+    pass
+
+
+@dataclass
+class NodeManifest:
+    name: str = ""
+    mode: str = "validator"            # manifest.go:158 ModeStr
+    start_at: int = 0                  # join when the chain reaches this
+    key_type: str = "ed25519"
+    # "action:height" entries, applied when the chain passes height
+    perturb: list[str] = field(default_factory=list)
+
+    def schedule(self) -> list[tuple[int, str]]:
+        out = []
+        for p in self.perturb:
+            action, _, h = p.partition(":")
+            if action not in PERTURBATIONS or not h.isdigit():
+                raise ManifestError(
+                    f"bad perturbation {p!r} on {self.name} "
+                    f"(want action:height, action in {PERTURBATIONS})")
+            out.append((int(h), action))
+        return sorted(out)
+
+
+@dataclass
+class LoadManifest:
+    rate: float = 10.0                 # tx/s
+    duration: float = 10.0
+    size: int = 64
+
+
+@dataclass
+class Manifest:
+    initial_height: int = 1
+    chain_id: str = "e2e-net"
+    validators: dict = field(default_factory=dict)   # name -> power
+    nodes: dict = field(default_factory=dict)        # name -> NodeManifest
+    load: LoadManifest = field(default_factory=LoadManifest)
+    # network-wide knobs
+    emulated_latency_ms: float = 0.0
+    fuzz: bool = False
+    final_height: int = 10             # success bar: all nodes reach this
+
+    def validate(self) -> None:
+        if not self.nodes:
+            raise ManifestError("manifest has no nodes")
+        vals = [n for n in self.nodes.values() if n.mode == "validator"]
+        if not vals:
+            raise ManifestError("manifest has no validator nodes")
+        for name in self.validators:
+            if name not in self.nodes:
+                raise ManifestError(f"validators entry {name!r} is not a "
+                                    f"node")
+        for n in self.nodes.values():
+            if n.mode not in MODES:
+                raise ManifestError(f"bad mode {n.mode!r} for {n.name}")
+            n.schedule()
+
+    def validator_powers(self) -> dict:
+        """Explicit [validators] map, else all validator-mode nodes at
+        power 100 (manifest.go:28 default)."""
+        if self.validators:
+            return dict(self.validators)
+        return {name: 100 for name, n in self.nodes.items()
+                if n.mode == "validator"}
+
+
+def load_manifest(path: str) -> Manifest:
+    import tomllib
+
+    with open(path, "rb") as f:
+        doc = tomllib.load(f)
+    return manifest_from_dict(doc)
+
+
+def manifest_from_dict(doc: dict) -> Manifest:
+    m = Manifest()
+    m.initial_height = int(doc.get("initial_height", 1))
+    m.chain_id = doc.get("chain_id", "e2e-net")
+    m.final_height = int(doc.get("final_height", 10))
+    m.emulated_latency_ms = float(doc.get("emulated_latency_ms", 0.0))
+    m.fuzz = bool(doc.get("fuzz", False))
+    m.validators = {k: int(v) for k, v in doc.get("validators", {}).items()}
+    for name, nd in doc.get("node", {}).items():
+        nm = NodeManifest(name=name)
+        nm.mode = nd.get("mode", "validator")
+        nm.start_at = int(nd.get("start_at", 0))
+        nm.key_type = nd.get("key_type", "ed25519")
+        nm.perturb = list(nd.get("perturb", []))
+        m.nodes[name] = nm
+    if "load" in doc:
+        ld = doc["load"]
+        m.load = LoadManifest(rate=float(ld.get("rate", 10.0)),
+                              duration=float(ld.get("duration", 10.0)),
+                              size=int(ld.get("size", 64)))
+    m.validate()
+    return m

@@ -1,0 +1,325 @@
+"""e2e testnet runner (reference: ``test/e2e/runner``): turn a Manifest
+into a live multi-OS-process testnet on localhost — generate wired homes,
+spawn node processes through the CLI, start late joiners when the chain
+reaches their height, apply the perturbation schedule, drive load, and
+check the end-state invariants (progress, agreement, light-client
+verification).
+
+The reference orchestrates docker containers; one machine with OS
+processes exercises the same protocol surface (real TCP, real processes,
+real kill/pause signals)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .manifest import Manifest
+
+_REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class RunnerError(Exception):
+    pass
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, base_dir: str,
+                 base_port: int = 26656, fast_timeouts: bool = True,
+                 log=print):
+        self.m = manifest
+        self.base_dir = base_dir
+        self.base_port = base_port
+        self.fast_timeouts = fast_timeouts
+        self.log = log
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.paused: set[str] = set()
+        # stable order: validators first so port 0 is a validator RPC
+        self.names = sorted(
+            self.m.nodes,
+            key=lambda n: (self.m.nodes[n].mode != "validator", n))
+        self.ports = {name: base_port + 2 * i
+                      for i, name in enumerate(self.names)}
+
+    # ---------------------------------------------------------- setup
+
+    def home(self, name: str) -> str:
+        return os.path.join(self.base_dir, name)
+
+    def rpc_port(self, name: str) -> int:
+        return self.ports[name] + 1
+
+    def setup(self) -> None:
+        """testnet generation per manifest roles (runner/setup.go)."""
+        from .gen import HomeSpec, generate_homes
+
+        powers = self.m.validator_powers()
+        backing = [n for n in self.names
+                   if self.m.nodes[n].mode != "light"]
+        seeds = [n for n in backing if self.m.nodes[n].mode == "seed"]
+        specs = [HomeSpec(name=n, p2p_port=self.ports[n],
+                          rpc_port=self.rpc_port(n),
+                          power=powers.get(n),
+                          key_type=self.m.nodes[n].key_type)
+                 for n in backing]
+
+        def peers(spec) -> str:
+            # with seeds in the topology, non-seed nodes discover the
+            # network through them via PEX (manifest.go seed semantics);
+            # otherwise everyone wires to everyone
+            if seeds and spec.name not in seeds:
+                return ""
+            return ",".join(f"tcp://127.0.0.1:{self.ports[o]}"
+                            for o in backing if o != spec.name)
+
+        def tweak(spec, cfg) -> None:
+            cfg.base.signature_backend = "cpu"
+            cfg.p2p.emulated_latency_ms = self.m.emulated_latency_ms
+            if seeds and spec.name not in seeds:
+                cfg.p2p.seeds = ",".join(
+                    f"tcp://127.0.0.1:{self.ports[s]}" for s in seeds)
+            if self.m.fuzz:
+                cfg.p2p.test_fuzz = True
+                cfg.p2p.fuzz_start_after_s = 5.0
+            if self.fast_timeouts:
+                cfg.consensus.timeout_propose = 300_000_000
+                cfg.consensus.timeout_prevote = 150_000_000
+                cfg.consensus.timeout_precommit = 150_000_000
+                cfg.consensus.timeout_commit = 100_000_000
+
+        generate_homes(self.base_dir, specs, self.m.chain_id,
+                       initial_height=self.m.initial_height,
+                       persistent_peers=peers, tweak=tweak)
+
+    # ---------------------------------------------------------- process
+
+    def _spawn(self, name: str) -> None:
+        node = self.m.nodes[name]
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+        if node.mode == "light":
+            cmd = self._light_cmd(name)
+        else:
+            cmd = [sys.executable, "-m", "cometbft_tpu",
+                   "--home", self.home(name), "start"]
+        self.log(f"[e2e] starting {name} ({node.mode})")
+        self.procs[name] = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            env=env, cwd=_REPO)
+
+    def _light_cmd(self, name: str) -> list[str]:
+        primary = self._primary_name()
+        anchor = self._trust_anchor
+        return [sys.executable, "-m", "cometbft_tpu", "light",
+                "--primary", f"127.0.0.1:{self.rpc_port(primary)}",
+                "--chain-id", self.m.chain_id,
+                "--trust-height", str(anchor[0]),
+                "--trust-hash", anchor[1],
+                "--port", str(self.rpc_port(name))]
+
+    def _primary_name(self) -> str:
+        for n in self.names:
+            if self.m.nodes[n].mode in ("validator", "full"):
+                return n
+        raise RunnerError("no primary for light node")
+
+    # ------------------------------------------------------------- run
+
+    async def run(self, deadline_s: float = 240.0) -> dict:
+        from ..rpc import HTTPClient, RPCError
+
+        async def call(port, method, timeout=30.0, **kw):
+            cli = HTTPClient("127.0.0.1", port)
+            end = time.monotonic() + timeout
+            while True:
+                try:
+                    # per-attempt bound: a SIGSTOPped node accepts the TCP
+                    # connection but never answers, and the retry-loop
+                    # timeout only runs between attempts
+                    return await asyncio.wait_for(cli.call(method, **kw),
+                                                  10.0)
+                except (OSError, RPCError, asyncio.TimeoutError):
+                    if time.monotonic() > end:
+                        raise
+                    await asyncio.sleep(0.3)
+
+        pending_start = {n for n in self.names
+                         if self.m.nodes[n].start_at > 0
+                         or self.m.nodes[n].mode == "light"}
+        for name in self.names:
+            if name not in pending_start:
+                self._spawn(name)
+
+        schedule = []          # (height, action, node) not yet applied
+        for name in self.names:
+            for h, action in self.m.nodes[name].schedule():
+                schedule.append((h, action, name))
+        schedule.sort()
+
+        watch_port = self.rpc_port(self._primary_name())
+        await call(watch_port, "status", timeout=60.0)
+        load_task = asyncio.create_task(self._drive_load(watch_port))
+        self._trust_anchor = None
+        last_perturb_t = time.monotonic()
+        deadline = time.monotonic() + deadline_s
+        try:
+            while True:
+                st = await call(watch_port, "status")
+                h = st["sync_info"]["latest_block_height"]
+
+                anchor_h = self.m.initial_height + 1
+                if (self._trust_anchor is None and h >= anchor_h
+                        and any(self.m.nodes[n].mode == "light"
+                                for n in self.names)):
+                    blk = await call(watch_port, "block", height=anchor_h)
+                    self._trust_anchor = (anchor_h,
+                                          blk["block_id"]["hash"]["~b"])
+
+                for name in sorted(pending_start):
+                    node = self.m.nodes[name]
+                    needs_anchor = node.mode == "light"
+                    if h >= node.start_at and (
+                            not needs_anchor or self._trust_anchor):
+                        pending_start.discard(name)
+                        self._spawn(name)
+
+                # apply due perturbations anywhere in the schedule (not
+                # just the head): recovery actions (restart/resume) also
+                # fire after a stall grace, because a kill/pause may have
+                # cost the chain its quorum and made their trigger height
+                # unreachable — per-node order is still preserved
+                fired = True
+                while fired:
+                    fired = False
+                    for i, (sched_h, action, name) in enumerate(schedule):
+                        earlier_same_node = any(
+                            n2 == name for _, _, n2 in schedule[:i])
+                        due = sched_h <= h or (
+                            action in ("restart", "resume")
+                            and not earlier_same_node
+                            and time.monotonic() - last_perturb_t > 10.0)
+                        if due:
+                            schedule.pop(i)
+                            self._perturb(name, action)
+                            last_perturb_t = time.monotonic()
+                            fired = True
+                            break
+
+                if (h >= self.m.final_height and not pending_start
+                        and not schedule):
+                    break
+                if time.monotonic() > deadline:
+                    raise RunnerError(
+                        f"deadline: h={h}, pending={pending_start}, "
+                        f"schedule={schedule}")
+                await asyncio.sleep(0.5)
+        finally:
+            load_task.cancel()
+
+        return await self._check_invariants(call)
+
+    def _perturb(self, name: str, action: str) -> None:
+        self.log(f"[e2e] perturb {action} {name}")
+        proc = self.procs.get(name)
+        if action == "kill" and proc is not None:
+            if name in self.paused:          # SIGKILL works on stopped too
+                self.paused.discard(name)
+            proc.kill()
+            proc.wait()
+        elif action == "restart":
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            self.paused.discard(name)        # a fresh process is running
+            self._spawn(name)
+        elif action == "pause" and proc is not None:
+            proc.send_signal(signal.SIGSTOP)
+            self.paused.add(name)
+        elif action == "resume" and proc is not None:
+            proc.send_signal(signal.SIGCONT)
+            self.paused.discard(name)
+
+    async def _drive_load(self, port: int) -> None:
+        from ..rpc import HTTPClient, RPCError
+
+        cli = HTTPClient("127.0.0.1", port)
+        ld = self.m.load
+        if ld.rate <= 0 or ld.duration <= 0:
+            return                     # load disabled
+        end = time.monotonic() + ld.duration
+        i = 0
+        while time.monotonic() < end:
+            tx = (b"e2e%06d=" % i) + os.urandom(max(1, ld.size // 2)).hex(
+                ).encode()[:ld.size]
+            try:
+                await cli.call("broadcast_tx_async", tx=tx.hex())
+            except Exception:
+                pass
+            i += 1
+            await asyncio.sleep(1.0 / ld.rate)
+
+    # ------------------------------------------------------ invariants
+
+    async def _check_invariants(self, call) -> dict:
+        """runner/test.go: every live full/validator node reaches the
+        final height and agrees on block hashes; light proxies serve
+        verified headers matching the chain."""
+        target = self.m.final_height
+        heights = {}
+        hashes = {}
+        for name in self.names:
+            node = self.m.nodes[name]
+            if node.mode == "light" or name in self.paused:
+                continue
+            if self.procs.get(name) is None or \
+                    self.procs[name].poll() is not None:
+                continue               # killed and never restarted
+            port = self.rpc_port(name)
+            end = time.monotonic() + 90
+            while True:
+                st = await call(port, "status", timeout=60.0)
+                heights[name] = st["sync_info"]["latest_block_height"]
+                if heights[name] >= target:
+                    break
+                if time.monotonic() > end:
+                    raise RunnerError(f"{name} stuck at {heights[name]} "
+                                      f"< {target}")
+                await asyncio.sleep(0.3)
+            blk = await call(port, "block", height=target)
+            hashes[name] = blk["block_id"]["hash"]["~b"]
+
+        if len(set(hashes.values())) > 1:
+            raise RunnerError(f"fork at {target}: {hashes}")
+
+        light_ok = {}
+        for name in self.names:
+            if self.m.nodes[name].mode != "light":
+                continue
+            port = self.rpc_port(name)
+            blk = await call(port, "block", height=target, timeout=60.0)
+            got = blk["block_id"]["hash"]["~b"]
+            if hashes and got not in set(hashes.values()):
+                raise RunnerError(f"light {name} diverges at {target}")
+            light_ok[name] = True
+
+        return {"final_height": target, "heights": heights,
+                "agreement_hash": next(iter(hashes.values()), None),
+                "light_verified": light_ok}
+
+    # --------------------------------------------------------- teardown
+
+    def stop(self) -> None:
+        for name, proc in self.procs.items():
+            if proc.poll() is None:
+                if name in self.paused:
+                    proc.send_signal(signal.SIGCONT)
+                proc.send_signal(signal.SIGTERM)
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
