@@ -13,6 +13,11 @@ import struct
 from .core import RPCError
 
 
+def _err(err: dict) -> "RPCError":
+    return RPCError(err.get("code", -1), err.get("message", ""),
+                    err.get("data", ""))
+
+
 class HTTPClient:
     def __init__(self, host: str, port: int):
         self.host = host
@@ -21,8 +26,32 @@ class HTTPClient:
 
     async def call(self, method: str, **params):
         self._id += 1
-        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
-                           "method": method, "params": params}).encode()
+        resp = await self._post(json.dumps(
+            {"jsonrpc": "2.0", "id": self._id,
+             "method": method, "params": params}).encode())
+        if "error" in resp:
+            raise _err(resp["error"])
+        return resp["result"]
+
+    async def call_batch(self, calls: list[tuple[str, dict]]) -> list:
+        """JSON-RPC batch (rpc/jsonrpc/client BatchHTTPClient): one HTTP
+        round-trip for many requests.  Returns per-call results in
+        request order; an errored call's slot holds the RPCError."""
+        reqs = []
+        for method, params in calls:
+            self._id += 1
+            reqs.append({"jsonrpc": "2.0", "id": self._id,
+                         "method": method, "params": params})
+        resps = await self._post(json.dumps(reqs).encode())
+        by_id = {r.get("id"): r for r in resps}
+        out = []
+        for req in reqs:
+            r = by_id.get(req["id"], {})
+            out.append(_err(r["error"]) if "error" in r
+                       else r.get("result"))
+        return out
+
+    async def _post(self, body: bytes):
         reader, writer = await asyncio.open_connection(self.host, self.port)
         try:
             writer.write(
@@ -44,12 +73,7 @@ class HTTPClient:
             raw = await reader.readexactly(int(headers["content-length"]))
         finally:
             writer.close()
-        resp = json.loads(raw)
-        if "error" in resp:
-            err = resp["error"]
-            raise RPCError(err.get("code", -1), err.get("message", ""),
-                           err.get("data", ""))
-        return resp["result"]
+        return json.loads(raw)
 
 
 class WSClient:

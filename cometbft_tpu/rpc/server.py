@@ -197,11 +197,25 @@ class RPCServer:
             self._conn_tasks.discard(task)
             writer.close()
 
-    async def _handle_jsonrpc_body(self, body: bytes) -> dict:
+    async def _handle_jsonrpc_body(self, body: bytes):
         try:
             req = json.loads(body)
         except json.JSONDecodeError as e:
             return _rpc_error(None, -32700, f"parse error: {e}")
+        if isinstance(req, list):
+            # JSON-RPC batch (rpc/jsonrpc/server/http_json_handler.go:46);
+            # notifications (no id) get no response entry
+            out = []
+            for r in req:
+                if not isinstance(r, dict) or r.get("id") is None:
+                    continue
+                out.append(await self._dispatch(
+                    r.get("id"), r.get("method", ""),
+                    r.get("params") or {}))
+            return out
+        if not isinstance(req, dict):
+            return _rpc_error(None, -32600,
+                              f"invalid request: {type(req).__name__}")
         return await self._dispatch(req.get("id"), req.get("method", ""),
                                     req.get("params") or {})
 

@@ -172,6 +172,56 @@ def test_rpc_full_surface_over_http():
     assert run(main())
 
 
+def test_rpc_batch_requests():
+    """JSON-RPC batch over one HTTP round-trip
+    (rpc/jsonrpc/server/http_json_handler.go:46): ordered results,
+    per-call errors, notifications skipped."""
+    async def main():
+        nodes = await _net(2)
+        try:
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            deadline = asyncio.get_event_loop().time() + 60
+            while True:
+                st = await cli.call("status")
+                if st["sync_info"]["latest_block_height"] >= 2:
+                    break
+                assert asyncio.get_event_loop().time() < deadline
+                await asyncio.sleep(0.2)
+
+            res = await cli.call_batch([
+                ("status", {}),
+                ("block", {"height": 1}),
+                ("bogus_method", {}),
+                ("health", {}),
+            ])
+            assert res[0]["node_info"]["network"] == "rpc-net"
+            assert res[1]["block"]["hdr"]["h"] == 1
+            assert isinstance(res[2], RPCError) and res[2].code == -32601
+            assert res[3] == {}
+
+            # raw batch with a notification (no id): no response entry
+            import urllib.request
+            raw = json.dumps([
+                {"jsonrpc": "2.0", "method": "health"},          # notif
+                {"jsonrpc": "2.0", "id": 7, "method": "health"},
+            ]).encode()
+            host, port = nodes[0].rpc_addr
+            loop = asyncio.get_event_loop()
+            body = await loop.run_in_executor(
+                None, lambda: urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://{host}:{port}/", data=raw,
+                        headers={"Content-Type": "application/json"}),
+                    timeout=10).read())
+            out = json.loads(body)
+            assert out == [{"jsonrpc": "2.0", "id": 7, "result": {}}]
+        finally:
+            await _stop(nodes)
+        return True
+
+    assert run(main())
+
+
 def test_rpc_unsafe_routes():
     """rpc/core/{net,dev}.go unsafe routes, gated by rpc.unsafe: wire two
     isolated validators together via dial_peers, then flush the mempool."""
