@@ -33,7 +33,8 @@ class BlockIndexer:
     def has(self, height: int) -> bool:
         return self.db.get(K_HEIGHT + height.to_bytes(8, "big")) is not None
 
-    def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+    def search(self, query: str, page: int = 1, per_page: int = 30,
+               order_by: str = "asc") -> dict:
         """Full-grammar search; equality clauses use postings, the rest
         post-filters against stored events (see TxIndexer.search)."""
         import msgpack
@@ -78,7 +79,7 @@ class BlockIndexer:
                 conds = [c for c in q.conditions if c.key == "block.height"]
             if all(c.matches(m.get(c.key)) for c in conds):
                 kept.append(h)
-        ordered = sorted(kept)
+        ordered = sorted(kept, reverse=(order_by == "desc"))
         page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
         start = (page - 1) * per_page
         return {"heights": ordered[start:start + per_page],

@@ -48,7 +48,8 @@ class TxIndexer:
             return None
         return _record(tx_hash, msgpack.unpackb(raw, raw=False))
 
-    def search(self, query: str, page: int = 1, per_page: int = 30) -> dict:
+    def search(self, query: str, page: int = 1, per_page: int = 30,
+               order_by: str = "asc") -> dict:
         """Full-grammar search (``libs/query``): plain string-equality
         clauses narrow candidates via the posting index; every remaining
         condition (ranges, CONTAINS, EXISTS, numeric equality) post-filters
@@ -79,7 +80,8 @@ class TxIndexer:
             d = msgpack.unpackb(raw, raw=False)
             if q.matches(_event_map(h, d)):
                 records.append(_record(h, d))
-        records.sort(key=lambda r: (r["height"], r["index"]))
+        records.sort(key=lambda r: (r["height"], r["index"]),
+                     reverse=(order_by == "desc"))
         page, per_page = max(1, int(page)), min(100, max(1, int(per_page)))
         start = (page - 1) * per_page
         return {"txs": records[start:start + per_page],

@@ -464,6 +464,44 @@ async def set_companion_retain_height(env: Environment, height=0) -> dict:
 
 # --------------------------------------------------------------- indexer
 
+def _check_order_by(order_by) -> str:
+    if order_by not in ("", "asc", "desc"):
+        raise RPCError(-32602, f"order_by must be asc|desc, "
+                       f"got {order_by!r}")
+    return order_by or "asc"
+
+
+def _tx_proof_provider(env: Environment):
+    """Per-request provider of tx inclusion proofs under the block's
+    data_hash (rpc/core/tx.go:40 — Data.Txs proof at the tx's index).
+    Caches the (root, proofs) tree per height so a search page touching
+    one block hashes its tx tree once.  Returns None for pruned blocks
+    (the reference skips the proof when the block is nil)."""
+    from ..crypto import merkle
+    from ..types.header import tx_hash as _txh
+
+    trees: dict[int, tuple] = {}
+
+    def prove(res: dict) -> dict | None:
+        h = res["height"]
+        if h not in trees:
+            blk = env.block_store.load_block(h)
+            trees[h] = (None if blk is None else
+                        merkle.proofs_from_byte_slices(
+                            [_txh(t) for t in blk.data.txs]))
+        tree = trees[h]
+        if tree is None:
+            return None
+        root, proofs = tree
+        pf = proofs[res["index"]]
+        return {"root_hash": root.hex(), "data": res["tx"],
+                "proof": {"total": pf.total, "index": pf.index,
+                          "leaf_hash": pf.leaf_hash.hex(),
+                          "aunts": [a.hex() for a in pf.aunts]}}
+
+    return prove
+
+
 async def tx(env: Environment, hash=None, prove=False) -> dict:
     indexer = getattr(env.node, "tx_indexer", None)
     if indexer is None:
@@ -472,31 +510,42 @@ async def tx(env: Environment, hash=None, prove=False) -> dict:
     res = indexer.get(want)
     if res is None:
         raise RPCError(-32603, f"tx {want.hex()} not found")
+    if prove:
+        pf = _tx_proof_provider(env)(res)
+        if pf is not None:
+            res = dict(res, proof=pf)
     return res
 
 
 async def tx_search(env: Environment, query="", page=1,
-                    per_page=30) -> dict:
+                    per_page=30, prove=False, order_by="") -> dict:
     from ..libs.query import QuerySyntaxError
 
     indexer = getattr(env.node, "tx_indexer", None)
     if indexer is None:
         raise RPCError(-32603, "transaction indexing is disabled")
     try:
-        return indexer.search(query, int(page), int(per_page))
+        out = indexer.search(query, int(page), int(per_page),
+                             order_by=_check_order_by(order_by))
     except QuerySyntaxError as e:
         raise RPCError(-32602, f"bad query: {e}") from e
+    if prove:
+        prover = _tx_proof_provider(env)
+        out["txs"] = [dict(r, proof=pf) if (pf := prover(r)) is not None
+                      else r for r in out["txs"]]
+    return out
 
 
 async def block_search(env: Environment, query="", page=1,
-                       per_page=30) -> dict:
+                       per_page=30, order_by="") -> dict:
     from ..libs.query import QuerySyntaxError
 
     indexer = getattr(env.node, "block_indexer", None)
     if indexer is None:
         raise RPCError(-32603, "block indexing is disabled")
     try:
-        return indexer.search(query, int(page), int(per_page))
+        return indexer.search(query, int(page), int(per_page),
+                              order_by=_check_order_by(order_by))
     except QuerySyntaxError as e:
         raise RPCError(-32602, f"bad query: {e}") from e
 

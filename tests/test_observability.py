@@ -167,6 +167,32 @@ def test_node_indexes_and_serves_tx_routes():
             br = await cli.call("block_search", query=f"block.height='{h}'")
             assert h in br["heights"]
 
+            # prove=True returns a merkle inclusion proof that verifies
+            # against the block header's data_hash (rpc/core/tx.go:40)
+            from cometbft_tpu.crypto.merkle import Proof
+            from cometbft_tpu.types.header import tx_hash as _txh
+
+            proved = await cli.call("tx", hash=txh, prove=True)
+            pf = proved["proof"]["proof"]
+            proof = Proof(total=pf["total"], index=pf["index"],
+                          leaf_hash=bytes.fromhex(pf["leaf_hash"]),
+                          aunts=[bytes.fromhex(a) for a in pf["aunts"]])
+            blk = await cli.call("block", height=h)
+            data_hash = bytes.fromhex(blk["block"]["hdr"]["dh"]["~b"])
+            assert bytes.fromhex(proved["proof"]["root_hash"]) == data_hash
+            assert proof.verify(data_hash, _txh(b"ik=iv"))
+
+            # order_by governs result ordering; bad values are rejected
+            sr2 = await cli.call("tx_search", query="tx.height > 0",
+                                 order_by="desc")
+            hs = [r["height"] for r in sr2["txs"]]
+            assert hs == sorted(hs, reverse=True)
+            from cometbft_tpu.rpc import RPCError
+            import pytest as _pytest
+            with _pytest.raises(RPCError):
+                await cli.call("tx_search", query="tx.height > 0",
+                               order_by="sideways")
+
             # commit-verification metrics need a block with a last commit
             while nodes[0].height() < 3:
                 await asyncio.sleep(0.05)

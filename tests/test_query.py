@@ -104,6 +104,24 @@ def test_tx_indexer_range_search():
     assert [r["height"] for r in out["txs"]] == [3]
 
 
+def test_indexer_order_by_desc():
+    from cometbft_tpu.abci.types import ExecTxResult
+    from cometbft_tpu.indexer.block import BlockIndexer
+    from cometbft_tpu.indexer.tx import TxIndexer
+
+    ix = TxIndexer()
+    for h in range(1, 6):
+        ix.index(h, 0, b"otx%d" % h, ExecTxResult(), {})
+    out = ix.search("tx.height > 0", order_by="desc")
+    assert [r["height"] for r in out["txs"]] == [5, 4, 3, 2, 1]
+
+    bx = BlockIndexer()
+    for h in range(1, 6):
+        bx.index(h, [])
+    out = bx.search("block.height > 2", order_by="desc")
+    assert out["heights"] == [5, 4, 3]
+
+
 def test_tx_indexer_hash_search():
     from cometbft_tpu.abci.types import ExecTxResult
     from cometbft_tpu.indexer.tx import TxIndexer
