@@ -110,16 +110,26 @@ class ConsensusState:
         if self.wal is not None:
             await self._catchup_replay()
         self._task = asyncio.create_task(self._receive_routine())
+        mp = getattr(self.block_exec, "mempool", None)
+        if hasattr(mp, "on_txs_available"):
+            # push edge from the mempool straight into the queue, fired
+            # once per height on the first admitted tx (the reference
+            # subscribes to mempool.TxsAvailable())
+            mp.on_txs_available = self.notify_txs_available
         self._schedule_round0_now()
 
     async def stop(self) -> None:
         self.ticker.stop()
+        mp = getattr(self.block_exec, "mempool", None)
+        if getattr(mp, "on_txs_available", None) is self.notify_txs_available:
+            mp.on_txs_available = None
         if self._task is not None:
             self._task.cancel()
             try:
                 await self._task
             except asyncio.CancelledError:
                 pass
+            self._task = None
         if self.wal is not None:
             self.wal.flush_and_sync()
 
@@ -293,10 +303,16 @@ class ConsensusState:
             await self._enter_new_round(ti.height, ti.round + 1)
 
     async def _handle_txs_available(self) -> None:
+        """state.go:1022 handleTxsAvailable."""
         rs = self.rs
         if rs.step == STEP_NEW_HEIGHT:
-            # fast-path round 0 on pending txs (createEmptyBlocks interval)
-            self._schedule_round0_now()
+            # timeoutCommit phase: round 0 will propose anyway if a proof
+            # block is needed; otherwise fast-path the schedule
+            if not self._need_proof_block(rs.height):
+                self._schedule_round0_now()
+        elif rs.step == STEP_NEW_ROUND and rs.round == 0:
+            # we were parked waiting for txs (create_empty_blocks off)
+            await self._enter_propose(rs.height, 0)
 
     # ----------------------------------------------------------- new round
 
@@ -319,7 +335,43 @@ class ConsensusState:
                                {"height": height, "round": round_,
                                 "proposer": self._round_proposer(
                                     round_).address.hex()})
+        # wait for txs before proposing in round 0 (state.go:1110
+        # waitForTxs): active when create_empty_blocks is off or an
+        # interval is set, unless a proof block is needed
+        wait_for_txs = ((not self.cfg.create_empty_blocks
+                         or self.cfg.create_empty_blocks_interval > 0)
+                        and round_ == 0
+                        and not self._need_proof_block(height))
+        if wait_for_txs and not self._mempool_has_txs():
+            if self.cfg.create_empty_blocks_interval > 0:
+                self.ticker.schedule(TimeoutInfo(
+                    self.cfg.create_empty_blocks_interval, height, round_,
+                    STEP_NEW_ROUND))
+            return          # _handle_txs_available resumes us
         await self._enter_propose(height, round_)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """state.go:1124 needProofBlock: sign the genesis app hash right
+        away, and propose an empty block whenever the previous block
+        changed the app hash (so the new hash commits promptly).  Cached
+        per height — the block decode is not free and both the round-0
+        entry and txs_available consult it."""
+        cached = getattr(self, "_proof_block_cache", None)
+        if cached is not None and cached[0] == height:
+            return cached[1]
+        if height == self.state.initial_height:
+            verdict = True
+        else:
+            prev = self.block_store.load_block(height - 1)
+            verdict = (prev is None
+                       or prev.header.app_hash != self.state.app_hash)
+        self._proof_block_cache = (height, verdict)
+        return verdict
+
+    def _mempool_has_txs(self) -> bool:
+        mp = getattr(self.block_exec, "mempool", None)
+        size = getattr(mp, "size", None)
+        return bool(size and size())
 
     def _round_proposer(self, round_: int):
         vals = self.state.validators

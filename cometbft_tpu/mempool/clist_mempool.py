@@ -46,6 +46,9 @@ class CListMempool(Mempool):
         self._lock = asyncio.Lock()
         self._txs_available = asyncio.Event()
         self._notified_available = False
+        # edge callback fired once per height on the first admitted tx
+        # (the reference's TxsAvailable channel consumer is consensus)
+        self.on_txs_available = None
         self.height = 0
 
     # ------------------------------------------------------------- check_tx
@@ -74,6 +77,8 @@ class CListMempool(Mempool):
         if self._txs and not self._notified_available:
             self._notified_available = True
             self._txs_available.set()
+            if self.on_txs_available is not None:
+                self.on_txs_available()
 
     def txs_available(self) -> asyncio.Event:
         return self._txs_available

@@ -274,3 +274,48 @@ def test_mixed_key_validator_set_commits():
         return True
 
     asyncio.run(main())
+
+
+def test_create_empty_blocks_disabled_waits_for_txs():
+    """config create_empty_blocks=false (state.go:1110 waitForTxs): after
+    the proof block, the chain parks until a tx arrives, commits a block
+    containing it (plus the follow-up proof block for the new app hash),
+    then parks again."""
+    from cometbft_tpu.config import test_consensus_config
+
+    async def main():
+        cfg = test_consensus_config()
+        cfg.create_empty_blocks = False
+        net = await make_inproc_network(4, config=cfg)
+        try:
+            await net.start()
+            await net.wait_for_height(1, timeout=10)
+            h0 = max(n.block_store.height() for n in net.nodes)
+            await asyncio.sleep(1.0)           # many rounds worth of time
+            h1 = max(n.block_store.height() for n in net.nodes)
+            # parked: at most one extra proof block, no stream of empties
+            assert h1 - h0 <= 1, f"empty blocks kept flowing: {h0}->{h1}"
+
+            # no mempool gossip in the tier-1 harness: feed every node,
+            # as the mempool reactor would
+            for n in net.nodes:
+                await n.mempool.check_tx(b"wake=up")
+            await net.wait_for_height(h1 + 1, timeout=10)
+            # the tx is in a committed block
+            found = None
+            for h in range(h0, net.nodes[0].block_store.height() + 1):
+                blk = net.nodes[0].block_store.load_block(h)
+                if blk is not None and b"wake=up" in blk.data.txs:
+                    found = h
+            assert found, "tx never committed"
+
+            await asyncio.sleep(0.5)
+            h2 = max(n.block_store.height() for n in net.nodes)
+            await asyncio.sleep(1.0)
+            h3 = max(n.block_store.height() for n in net.nodes)
+            assert h3 - h2 <= 1, f"chain did not re-park: {h2}->{h3}"
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
