@@ -350,6 +350,9 @@ class ConsensusState:
             return          # _handle_txs_available resumes us
         await self._enter_propose(height, round_)
 
+    def _skip_timeout_commit(self) -> bool:
+        return self.cfg.skip_timeout_commit or self.cfg.timeout_commit == 0
+
     def _need_proof_block(self, height: int) -> bool:
         """state.go:1124 needProofBlock: sign the genesis app hash right
         away, and propose an empty block whenever the previous block
@@ -782,6 +785,12 @@ class ConsensusState:
                     rs.last_commit.add_vote(vote)
                 except (VoteSetError, ConflictingVoteError):
                     pass
+                else:
+                    # all of last height's precommits in hand: skip the
+                    # rest of timeout_commit (state.go:2325)
+                    if self._skip_timeout_commit() and \
+                            rs.last_commit.has_all():
+                        await self._enter_new_round(rs.height, 0)
             return
         if vote.height != rs.height:
             return
@@ -867,6 +876,10 @@ class ConsensusState:
             await self._enter_precommit(rs.height, vote.round)
             if not maj.is_nil():
                 await self._enter_commit(rs.height, vote.round)
+                # every precommit already in: start the next height now
+                # (state.go:2489 skipTimeoutCommit)
+                if self._skip_timeout_commit() and precommits.has_all():
+                    await self._enter_new_round(self.rs.height, 0)
             else:
                 await self._enter_precommit_wait(rs.height, vote.round)
         elif precommits.has_two_thirds_any():

@@ -319,3 +319,30 @@ def test_create_empty_blocks_disabled_waits_for_txs():
         return True
 
     assert run(main())
+
+
+def test_skip_timeout_commit_fast_heights():
+    """skip_timeout_commit (state.go:2325,2489): with every precommit in
+    hand the next height starts immediately, so block production is not
+    bound by timeout_commit."""
+    from cometbft_tpu.config import test_consensus_config
+
+    async def main():
+        cfg = test_consensus_config()
+        cfg.timeout_commit = 2_000_000_000        # 2s: would dominate
+        cfg.skip_timeout_commit = True
+        net = await make_inproc_network(4, config=cfg)
+        try:
+            await net.start()
+            t0 = asyncio.get_event_loop().time()
+            await net.wait_for_height(5, timeout=30)
+            elapsed = asyncio.get_event_loop().time() - t0
+            # the genesis start_time wait (~2s) is un-skippable by design
+            # (updateToState); heights 2-5 commit within ~0.1s each when
+            # skipping, so one lost skip (+2s) must trip the bound
+            assert elapsed < 4.0, f"timeout_commit not skipped: {elapsed}"
+        finally:
+            await net.stop()
+        return True
+
+    assert run(main())
