@@ -31,6 +31,51 @@ def _load_home(home: str):
     return cfg
 
 
+def _apply_overrides(cfg, options: list[str]) -> None:
+    """--option section.key=value config overrides (the reference binds a
+    cobra flag per config field; one generic repeatable flag covers the
+    same surface).  Values coerce to the field's current type; raises
+    ConfigError on unknown keys or bad values."""
+    import dataclasses
+
+    from ..config import ConfigError
+
+    sections = {f.name for f in dataclasses.fields(cfg)}
+    for opt in options:
+        path, sep, raw = opt.partition("=")
+        section_name, dot, key = path.strip().partition(".")
+        if not sep or not dot or not key:
+            raise ConfigError(f"bad --option {opt!r}: expected "
+                              f"section.key=value")
+        if section_name not in sections:
+            raise ConfigError(f"unknown config key {path!r}")
+        section = getattr(cfg, section_name)
+        field_types = {f.name: f.type for f in dataclasses.fields(section)}
+        if key not in field_types:
+            raise ConfigError(f"unknown config key {path!r}")
+        # coerce by the declared field type, not the runtime value (a
+        # hand-edited TOML int in a float field must not flip the rule)
+        ftype = str(field_types[key])
+        try:
+            if ftype == "bool":
+                if raw.lower() not in ("true", "false", "1", "0"):
+                    raise ValueError("expected true|false")
+                value = raw.lower() in ("true", "1")
+            elif ftype == "int":
+                value = int(raw)
+            elif ftype == "float":
+                value = float(raw)
+            elif ftype.startswith("list"):
+                value = [s.strip() for s in raw.split(",") if s.strip()]
+            else:
+                value = raw
+        except ValueError as e:
+            raise ConfigError(f"bad value for {path!r}: {e}") from e
+        setattr(section, key, value)
+    if options:
+        cfg.validate()
+
+
 def _join(home: str, rel: str) -> str:
     return rel if os.path.isabs(rel) else os.path.join(home, rel)
 
@@ -102,6 +147,11 @@ async def _start_async(args) -> int:
 
     home = args.home
     cfg = _load_home(home)
+    try:
+        _apply_overrides(cfg, getattr(args, "option", []))
+    except Exception as e:
+        print(f"{e}", file=sys.stderr)
+        return 1
     doc = GenesisDoc.load(_join(home, cfg.base.genesis_file))
     nk = NodeKey.load_or_gen(_join(home, cfg.base.node_key_file))
     signer_listener = None
@@ -697,6 +747,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_init)
 
     sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--option", "-o", action="append", default=[],
+                    metavar="SECTION.KEY=VALUE",
+                    help="override a config.toml entry for this run "
+                         "(repeatable), e.g. -o rpc.laddr=tcp://0.0.0.0:26657"
+                         " -o consensus.timeout_commit=500000000")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("testnet", help="generate wired node homes")

@@ -237,3 +237,48 @@ async def _drive_perturbation(procs, spawn, base_port):
         blk = await cli(i).call("block", height=check_h)
         hashes.add(blk["block_id"]["hash"]["~b"])
     assert len(hashes) == 1, f"fork after restart: {hashes}"
+
+
+def test_start_option_overrides(tmp_path):
+    """--option section.key=value overrides config.toml for one run
+    (the reference binds a cobra flag per config field)."""
+    home = str(tmp_path / "node")
+    res = _run_cli("init", "--chain-id", "opt-chain", home=home)
+    assert res.returncode == 0, res.stderr
+
+    # bad forms fail fast with a clean error, not a traceback
+    for bad in ("nonsense", "rpc.laddr", "bogus.key=1",
+                "consensus.timeout_commit=abc", "p2p.pex=maybe",
+                "__class__.__name__=X"):
+        r = _run_cli("start", "-o", bad, home=home)
+        assert r.returncode == 1, (bad, r.stdout)
+        assert "Traceback" not in r.stderr, (bad, r.stderr)
+
+    # a good override takes effect: node binds the overridden RPC port
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu", "--home", home, "start",
+         "-o", "rpc.laddr=tcp://127.0.0.1:28799",
+         "-o", "consensus.timeout_commit=100000000",
+         "-o", "base.signature_backend=cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO)
+    try:
+        import urllib.request
+
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                body = urllib.request.urlopen(
+                    "http://127.0.0.1:28799/status", timeout=2).read()
+                break
+            except Exception:
+                assert time.monotonic() < deadline and proc.poll() is None
+                time.sleep(0.3)
+        assert b"opt-chain" in body
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
