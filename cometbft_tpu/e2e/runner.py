@@ -107,9 +107,12 @@ class Runner:
             cmd = [sys.executable, "-m", "cometbft_tpu",
                    "--home", self.home(name), "start"]
         self.log(f"[e2e] starting {name} ({node.mode})")
+        log_path = os.path.join(self.base_dir, f"{name}.log")
+        log_f = open(log_path, "ab")
         self.procs[name] = subprocess.Popen(
-            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+            cmd, stdout=log_f, stderr=subprocess.STDOUT,
             env=env, cwd=_REPO)
+        log_f.close()          # the child keeps its own fd
 
     def _light_cmd(self, name: str) -> list[str]:
         primary = self._primary_name()
@@ -279,9 +282,14 @@ class Runner:
                     self.procs[name].poll() is not None:
                 continue               # killed and never restarted
             port = self.rpc_port(name)
-            end = time.monotonic() + 90
+            end = time.monotonic() + 150
             while True:
-                st = await call(port, "status", timeout=60.0)
+                try:
+                    st = await call(port, "status", timeout=120.0)
+                except (OSError, asyncio.TimeoutError) as e:
+                    raise RunnerError(
+                        f"{name} rpc unreachable: {e}; last log lines:\n"
+                        f"{self._log_tail(name)}") from e
                 heights[name] = st["sync_info"]["latest_block_height"]
                 if heights[name] >= target:
                     break
@@ -309,6 +317,14 @@ class Runner:
         return {"final_height": target, "heights": heights,
                 "agreement_hash": next(iter(hashes.values()), None),
                 "light_verified": light_ok}
+
+    def _log_tail(self, name: str, n: int = 15) -> str:
+        try:
+            with open(os.path.join(self.base_dir, f"{name}.log"),
+                      errors="replace") as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return "(no log)"
 
     # --------------------------------------------------------- teardown
 

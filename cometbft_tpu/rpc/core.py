@@ -206,7 +206,17 @@ async def blockchain(env: Environment, min_height=None,
     return {"last_height": bs.height(), "block_metas": metas}
 
 
+def _events_jsonable(events) -> list:
+    return [{"type": e.type,
+             "attributes": [{"key": a.key, "value": a.value,
+                             "index": a.index}
+                            for a in e.attributes]}
+            for e in events or []]
+
+
 async def block_results(env: Environment, height=None) -> dict:
+    """rpc/core/blocks.go BlockResults / ResultBlockResults
+    (responses.go:54): full FinalizeBlock output at a height."""
     h = _height_or_latest(env, height)
     raw = env.state_store.load_finalize_block_response(h)
     if raw is None:
@@ -217,12 +227,17 @@ async def block_results(env: Environment, height=None) -> dict:
     return {
         "height": h,
         "tx_results": [{"code": r.code, "data": r.data.hex(),
-                        "log": r.log, "gas_used": r.gas_used}
+                        "log": r.log, "gas_used": r.gas_used,
+                        "events": _events_jsonable(r.events)}
                        for r in resp.tx_results],
+        "finalize_block_events": _events_jsonable(resp.events),
         "validator_updates": [{"pub_key_type": u.pub_key_type,
                                "pub_key": u.pub_key_bytes.hex(),
                                "power": u.power}
                               for u in resp.validator_updates],
+        "consensus_param_updates": (
+            None if resp.consensus_param_updates is None
+            else _params_jsonable(resp.consensus_param_updates)),
         "app_hash": resp.app_hash.hex(),
     }
 
@@ -252,12 +267,8 @@ async def validators(env: Environment, height=None, page=1,
     return paginate_validators(vals, h, page, per_page)
 
 
-async def consensus_params(env: Environment, height=None) -> dict:
-    h = _height_or_latest(env, height)
-    params = env.state_store.load_params(h)
-    if params is None:
-        raise RPCError(-32603, f"no consensus params at height {h}")
-    return {"block_height": h, "consensus_params": {
+def _params_jsonable(params) -> dict:
+    return {
         "block": {"max_bytes": params.block.max_bytes,
                   "max_gas": params.block.max_gas},
         "evidence": {"max_age_num_blocks":
@@ -266,11 +277,23 @@ async def consensus_params(env: Environment, height=None) -> dict:
                      params.evidence.max_age_duration_ns,
                      "max_bytes": params.evidence.max_bytes},
         "validator": {"pub_key_types": params.validator.pub_key_types},
+        "version": {"app": params.version.app},
         "feature": {"vote_extensions_enable_height":
                     params.feature.vote_extensions_enable_height,
                     "pbts_enable_height":
                     params.feature.pbts_enable_height},
-    }}
+        "synchrony": {"precision_ns": params.synchrony.precision_ns,
+                      "message_delay_ns":
+                      params.synchrony.message_delay_ns},
+    }
+
+
+async def consensus_params(env: Environment, height=None) -> dict:
+    h = _height_or_latest(env, height)
+    params = env.state_store.load_params(h)
+    if params is None:
+        raise RPCError(-32603, f"no consensus params at height {h}")
+    return {"block_height": h, "consensus_params": _params_jsonable(params)}
 
 
 # ------------------------------------------------------------- consensus

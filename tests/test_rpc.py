@@ -108,6 +108,10 @@ def test_rpc_full_surface_over_http():
 
             br = await cli.call("block_results", height=committed_h)
             assert any(r["code"] == 0 for r in br["tx_results"])
+            # full ResultBlockResults shape (responses.go:54)
+            assert "finalize_block_events" in br
+            assert "consensus_param_updates" in br
+            assert all("events" in r for r in br["tx_results"])
 
             vals = await cli.call("validators")
             assert vals["total"] == 4 and len(vals["validators"]) == 4
