@@ -286,6 +286,28 @@ async def _start_async(args) -> int:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
+
+    # goroutine-dump analogue (cmd/cometbft/commands/debug captures
+    # goroutine stacks): SIGUSR1 -> native thread stacks, SIGUSR2 ->
+    # asyncio task summaries, both to stderr without stopping the node
+    import faulthandler
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
+    def _dump_tasks() -> None:
+        tasks = asyncio.all_tasks(loop)
+        print(f"=== {len(tasks)} asyncio tasks ===", file=sys.stderr)
+        for t in sorted(tasks, key=lambda t: t.get_name()):
+            frames = t.get_stack()
+            where = ""
+            if frames:
+                f = frames[-1]
+                where = f" at {f.f_code.co_filename}:{f.f_lineno} " \
+                        f"({f.f_code.co_name})"
+            print(f"--- {t.get_name()}{where}", file=sys.stderr)
+        sys.stderr.flush()
+
+    loop.add_signal_handler(signal.SIGUSR2, _dump_tasks)
     await stop.wait()
     print("shutting down...", flush=True)
     for t in dial_tasks:
@@ -703,11 +725,27 @@ async def _light_async(args) -> int:
     primary = RPCProvider(phost, pport, "primary")
     witnesses = [RPCProvider(*parse_hp(w), f"witness{i}")
                  for i, w in enumerate(args.witness or [])]
+    from fractions import Fraction
+
+    from ..light.client import SEQUENTIAL, SKIPPING
+
+    try:
+        num, _, den = args.trust_level.partition("/")
+        trust_level = Fraction(int(num), int(den or 1))
+        if not Fraction(1, 3) <= trust_level <= 1:
+            raise ValueError("must be within [1/3, 1]")
+    except (ValueError, ZeroDivisionError) as e:
+        print(f"bad --trust-level {args.trust_level!r}: {e}",
+              file=sys.stderr)
+        return 1
+
     client = Client(
         args.chain_id,
         TrustOptions(args.trust_period * 1_000_000_000,
                      args.trust_height, bytes.fromhex(args.trust_hash)),
-        primary, witnesses=witnesses)
+        primary, witnesses=witnesses,
+        mode=SEQUENTIAL if args.sequential else SKIPPING,
+        trust_level=trust_level)
     server, addr = await run_light_proxy(
         client, HTTPClient(phost, pport), "127.0.0.1", args.port)
     print(f"Light proxy on {addr[0]}:{addr[1]} "
@@ -784,6 +822,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="hex header hash at the trust height")
     sp.add_argument("--trust-period", type=int, default=168 * 3600,
                     help="trusting period in seconds")
+    sp.add_argument("--trust-level", default="1/3",
+                    help="trust level for skipping verification, "
+                         "e.g. 1/3 (commands/light.go:94)")
+    sp.add_argument("--sequential", action="store_true",
+                    help="verify every header instead of skipping")
     sp.add_argument("--port", type=int, default=0)
     sp.set_defaults(fn=cmd_light)
 

@@ -282,3 +282,49 @@ def test_start_option_overrides(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_sigusr_stack_dumps(tmp_path):
+    """SIGUSR1 dumps thread stacks, SIGUSR2 dumps asyncio tasks — the
+    reference debug command's goroutine-dump analogue — without stopping
+    the node."""
+    home = str(tmp_path / "node")
+    res = _run_cli("init", "--chain-id", "dump-chain", home=home)
+    assert res.returncode == 0, res.stderr
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out_path = str(tmp_path / "node.log")
+    with open(out_path, "wb") as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "--home", home, "start",
+             "-o", "base.signature_backend=cpu",
+             "-o", "rpc.laddr=tcp://127.0.0.1:28811"],
+            stdout=out, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    try:
+        import urllib.request
+
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:28811/health", timeout=2)
+                break
+            except Exception:
+                assert time.monotonic() < deadline and proc.poll() is None
+                time.sleep(0.3)
+        proc.send_signal(signal.SIGUSR1)
+        proc.send_signal(signal.SIGUSR2)
+        deadline = time.monotonic() + 30
+        while True:
+            data = open(out_path).read()
+            if "asyncio tasks ===" in data and "Current thread" in data:
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.3)
+        # node survived the dumps
+        urllib.request.urlopen("http://127.0.0.1:28811/health", timeout=5)
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -10,6 +10,10 @@ import subprocess
 import sys
 import time
 
+import pytest
+
+pytestmark = pytest.mark.timeout(240)
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE_PORT = 29060
 
@@ -72,10 +76,12 @@ def test_statesync_via_cli_config(tmp_path):
             for i in range(3):
                 await call(cli0, "broadcast_tx_sync",
                            tx=(b"ssk%d=ssv%d" % (i, i)).hex())
+            deadline0 = time.monotonic() + 120
             while True:
                 st = await call(cli0, "status")
                 if st["sync_info"]["latest_block_height"] >= 8:
                     break
+                assert time.monotonic() < deadline0, "chain stalled"
                 await asyncio.sleep(0.3)
 
             # trust anchor out-of-band (operators do this via a block
