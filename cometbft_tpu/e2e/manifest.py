@@ -73,6 +73,10 @@ class Manifest:
     chain_id: str = "e2e-net"
     validators: dict = field(default_factory=dict)   # name -> power
     nodes: dict = field(default_factory=dict)        # name -> NodeManifest
+    # height -> {node name -> power}: valset txs the runner submits when
+    # the chain passes that height (manifest.go:34 ValidatorUpdatesMap;
+    # power 0 removes the validator)
+    validator_updates: dict = field(default_factory=dict)
     load: LoadManifest = field(default_factory=LoadManifest)
     # network-wide knobs
     emulated_latency_ms: float = 0.0
@@ -93,6 +97,24 @@ class Manifest:
             if n.mode not in MODES:
                 raise ManifestError(f"bad mode {n.mode!r} for {n.name}")
             n.schedule()
+        for h, updates in self.validator_updates.items():
+            if h <= 0:
+                raise ManifestError(f"validator_update height {h} "
+                                    f"must be positive")
+            for name, power in updates.items():
+                node = self.nodes.get(name)
+                if node is None or node.mode == "light":
+                    raise ManifestError(f"validator_update target "
+                                        f"{name!r} is not a backing node")
+                if power < 0:
+                    raise ManifestError(f"validator_update power for "
+                                        f"{name!r} must be >= 0")
+                if node.key_type != "ed25519":
+                    # the kvstore valset tx carries ed25519 keys only
+                    # (abci/kvstore.py:122)
+                    raise ManifestError(
+                        f"validator_update target {name!r} has key type "
+                        f"{node.key_type!r}; only ed25519 is supported")
 
     def validator_powers(self) -> dict:
         """Explicit [validators] map, else all validator-mode nodes at
@@ -126,6 +148,9 @@ def manifest_from_dict(doc: dict) -> Manifest:
         nm.key_type = nd.get("key_type", "ed25519")
         nm.perturb = list(nd.get("perturb", []))
         m.nodes[name] = nm
+    for h, updates in doc.get("validator_update", {}).items():
+        m.validator_updates[int(h)] = {k: int(v)
+                                       for k, v in updates.items()}
     if "load" in doc:
         ld = doc["load"]
         m.load = LoadManifest(rate=float(ld.get("rate", 10.0)),

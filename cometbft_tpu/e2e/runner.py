@@ -162,6 +162,7 @@ class Runner:
             for h, action in self.m.nodes[name].schedule():
                 schedule.append((h, action, name))
         schedule.sort()
+        valset_updates = sorted(self.m.validator_updates.items())
 
         watch_port = self.rpc_port(self._primary_name())
         await call(watch_port, "status", timeout=60.0)
@@ -212,8 +213,14 @@ class Runner:
                             fired = True
                             break
 
+                while valset_updates and valset_updates[0][0] <= h:
+                    _, updates = valset_updates.pop(0)
+                    for vname, power in updates.items():
+                        await self._submit_valset_tx(call, watch_port,
+                                                     vname, power)
+
                 if (h >= self.m.final_height and not pending_start
-                        and not schedule):
+                        and not schedule and not valset_updates):
                     break
                 if time.monotonic() > deadline:
                     raise RunnerError(
@@ -314,9 +321,63 @@ class Runner:
                 raise RunnerError(f"light {name} diverges at {target}")
             light_ok[name] = True
 
+        # manifest validator_updates took effect: fold them over genesis
+        # and compare with the live validator set
+        validators = {}
+        if self.m.validator_updates:
+            expect = dict(self.m.validator_powers())
+            for _, updates in sorted(self.m.validator_updates.items()):
+                for name, power in updates.items():
+                    if power == 0:
+                        expect.pop(name, None)
+                    else:
+                        expect[name] = power
+            port = self.rpc_port(self._primary_name())
+            want = {self.node_pub_key_hex(n): p
+                    for n, p in expect.items()}
+            end = time.monotonic() + 30    # updates apply at height+2
+            while True:
+                vres = await call(port, "validators", timeout=60.0)
+                got = {v["pub_key"]: v["voting_power"]
+                       for v in vres["validators"]}
+                if got == want:
+                    break
+                if time.monotonic() > end:
+                    raise RunnerError(f"validator set mismatch: "
+                                      f"want {want}, got {got}")
+                await asyncio.sleep(0.5)
+            validators = expect
+
         return {"final_height": target, "heights": heights,
                 "agreement_hash": next(iter(hashes.values()), None),
-                "light_verified": light_ok}
+                "light_verified": light_ok,
+                "validators": validators}
+
+    def node_pub_key_hex(self, name: str) -> str:
+        """The node's validator pubkey (from its generated FilePV file)."""
+        import json as _json
+
+        with open(os.path.join(self.home(name), "config",
+                               "priv_validator_key.json")) as f:
+            return _json.load(f)["pub_key"]
+
+    async def _submit_valset_tx(self, call, port: int, name: str,
+                                power: int) -> None:
+        """Manifest validator_update -> kvstore valset tx
+        (val:<b64 pubkey>!<power>, abci/kvstore.py).  The power is
+        zero-padded by a per-run sequence number so re-applying an
+        earlier (name, power) pair still produces a unique tx — the
+        mempool cache silently drops byte-identical resubmissions."""
+        import base64
+
+        self._valset_seq = getattr(self, "_valset_seq", 0) + 1
+        pk = bytes.fromhex(self.node_pub_key_hex(name))
+        padded = b"%0*d" % (len(str(power)) + self._valset_seq, power)
+        tx = b"val:" + base64.b64encode(pk) + b"!" + padded
+        self.log(f"[e2e] validator_update {name} -> power {power}")
+        res = await call(port, "broadcast_tx_sync", tx=tx.hex())
+        if res.get("code", 0) != 0:
+            raise RunnerError(f"valset tx for {name} rejected: {res}")
 
     def _log_tail(self, name: str, n: int = 15) -> str:
         try:

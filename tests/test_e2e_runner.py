@@ -22,6 +22,36 @@ def test_manifest_validation():
     assert m.validator_powers() == {"a": 100}      # manifest.go:28 default
 
 
+def test_e2e_validator_updates(tmp_path):
+    """Manifest validator_update (manifest.go:34): a full node is voted
+    in as a validator mid-run and another validator's power changes; the
+    live validator set must match the folded updates."""
+    m = manifest_from_dict({
+        "chain_id": "e2e-valup",
+        "final_height": 10,
+        "validators": {"v1": 10, "v2": 10, "v3": 10},
+        "node": {
+            "v1": {}, "v2": {}, "v3": {},
+            "joiner": {"mode": "full"},
+        },
+        "validator_update": {
+            "3": {"joiner": 15},        # full node becomes a validator
+            "5": {"v3": 25},            # power change
+        },
+        "load": {"rate": 0.0, "duration": 0.0},
+    })
+    runner = Runner(m, str(tmp_path / "net"), base_port=30160,
+                    log=lambda *a: None)
+    runner.setup()
+    try:
+        report = asyncio.run(runner.run(deadline_s=180.0))
+    finally:
+        runner.stop()
+    assert report["validators"] == {"v1": 10, "v2": 10, "v3": 25,
+                                    "joiner": 15}
+    assert all(h >= 10 for h in report["heights"].values())
+
+
 def test_e2e_seed_discovery(tmp_path):
     """Seed topology: validators have NO persistent peers — they learn
     the network through the seed via PEX (manifest.go seed semantics),
