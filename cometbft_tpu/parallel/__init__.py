@@ -8,6 +8,6 @@ scales it across chips with zero collectives (host->device once, one bool
 per lane back).
 """
 
-from .mesh import batch_mesh, sharded_verify_fn
+from .mesh import batch_mesh, init_multihost, sharded_verify_fn
 
-__all__ = ["batch_mesh", "sharded_verify_fn"]
+__all__ = ["batch_mesh", "init_multihost", "sharded_verify_fn"]

@@ -20,6 +20,44 @@ def batch_mesh(devices=None) -> Mesh:
     return Mesh(devs, axis_names=("batch",))
 
 
+def init_multihost(coordinator: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> Mesh:
+    """Multi-host mesh: initialize the jax distributed runtime (every
+    host runs this with the same coordinator) and return the global
+    batch mesh spanning all hosts' devices.
+
+    The reference scales hosts with its own TCP fabric (p2p) and has no
+    device fabric; here host networking is likewise p2p/RPC, while the
+    *verification batch* shards over every chip on every host — XLA
+    routes any cross-host traffic over ICI/DCN, and since lanes are
+    independent the step stays collective-free.  Args default to the
+    standard env vars (JAX_COORDINATOR_ADDRESS, JAX_NUM_PROCESSES,
+    JAX_PROCESS_ID) so launchers can configure it without code."""
+    import os
+
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator is None and (num_processes is not None
+                                or process_id is not None):
+        raise ValueError("num_processes/process_id given without a "
+                         "coordinator address")
+    if coordinator:
+        if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+            num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+        if process_id is None and "JAX_PROCESS_ID" in os.environ:
+            process_id = int(os.environ["JAX_PROCESS_ID"])
+        already = getattr(jax.distributed, "is_initialized", None)
+        if not (already() if already is not None else
+                jax._src.distributed.global_state.client is not None):
+            # None process args let jax auto-detect cluster membership
+            # (TPU pods); re-init would raise, so guard for re-entry
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id)
+    return batch_mesh()
+
+
 def sharded_verify_fn(mesh: Mesh):
     """jit of the ed25519 verify kernel with every arg sharded on the batch
     axis of ``mesh``.  The mesh size must divide the batch size (each device

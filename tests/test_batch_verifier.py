@@ -137,3 +137,19 @@ def test_graft_entry_and_multichip():
     assert len(jax.devices()) == 8, "conftest should provide 8 virtual devices"
     ge.dryrun_multichip(8)
     ge.dryrun_multichip(4)
+
+
+def test_init_multihost_single_host_default(monkeypatch):
+    """init_multihost without a coordinator is the single-host path: no
+    distributed init, a global batch mesh over the local devices (the
+    multi-process path needs real hosts; launchers set the JAX_* env)."""
+    import pytest
+
+    from cometbft_tpu.parallel import batch_mesh, init_multihost
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    with pytest.raises(ValueError):
+        init_multihost(num_processes=4)     # args without a coordinator
+    mesh = init_multihost()
+    assert mesh.axis_names == ("batch",)
+    assert mesh.devices.size == batch_mesh().devices.size
