@@ -425,6 +425,35 @@ def main() -> None:
     platforms = os.environ.get("JAX_PLATFORMS", "")
     want_tpu = ("cpu" != platforms.strip().lower())
 
+    if want_tpu:
+        # cheap pre-probe: when the accelerator relay is wedged, backend
+        # init hangs forever, and the full attempt would burn its whole
+        # timeout before the CPU fallback runs.  A throwaway process
+        # answers the question.
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+        print(f"[bench] probing accelerator backend "
+              f"({probe_timeout:.0f}s limit)", file=sys.stderr, flush=True)
+        reason = ""
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(any(d.platform != 'cpu' "
+                 "for d in jax.devices()))"],
+                capture_output=True, timeout=probe_timeout, text=True)
+            lines = probe.stdout.strip().splitlines()
+            want_tpu = (probe.returncode == 0 and lines
+                        and lines[-1] == "True")
+            if not want_tpu:
+                reason = (f"rc={probe.returncode}, "
+                          f"stdout={lines[-1] if lines else ''!r}, "
+                          f"stderr tail: {probe.stderr.strip()[-200:]!r}")
+        except Exception as e:
+            want_tpu = False
+            reason = f"{type(e).__name__}: {e}"
+        if not want_tpu:
+            print(f"[bench] no live accelerator ({reason}); skipping "
+                  f"the TPU attempt", file=sys.stderr, flush=True)
+
     attempts: list[tuple[str, int, float]] = []
     if want_tpu:
         attempts.append(("tpu", nsig_tpu, t_tpu))
