@@ -1,0 +1,140 @@
+"""Tier-2 byzantine test over real TCP: a double-signed precommit rides
+the live vote gossip, the conflict becomes DuplicateVoteEvidence, the
+evidence channel gossips it between pools, a proposal carries it, and
+every replica's app sees the ABCI misbehavior (reference:
+``internal/consensus/byzantine_test.go`` + ``internal/evidence/reactor.go``
+as one scenario)."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.config import test_consensus_config as _tcc
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p import NodeKey
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_double_sign_detected_and_gossiped_over_tcp():
+    async def main():
+        pvs = [MockPV.from_secret(b"evnet%d" % i) for i in range(4)]
+        doc = GenesisDoc(chain_id="ev-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes, apps = [], []
+        for i, pv in enumerate(pvs):
+            cfg = Config(consensus=_tcc())
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            app = KVStoreApplication()
+            node = await Node.create(
+                doc, app, priv_validator=pv, config=cfg,
+                node_key=NodeKey.from_secret(b"evk%d" % i), name=f"ev{i}")
+            nodes.append(node)
+            apps.append(app)
+            await node.start()
+        try:
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    await a.dial_peer(b.listen_addr, persistent=True)
+
+            # let the chain roll
+            while min(n.height() for n in nodes) < 2:
+                await asyncio.sleep(0.05)
+
+            byz = nodes[3]
+            byz_addr = pvs[3].get_pub_key().address()
+            byz_idx, _ = byz.consensus.state.validators.get_by_address(
+                byz_addr)
+
+            for _ in range(20):
+                h = byz.consensus.rs.height
+                fake = Vote(
+                    type=PRECOMMIT_TYPE, height=h, round=0,
+                    block_id=BlockID(b"\x55" * 32,
+                                     PartSetHeader(1, b"\x44" * 32)),
+                    timestamp_ns=424242,
+                    validator_address=byz_addr, validator_index=byz_idx)
+                await pvs[3].sign_vote("ev-net", fake,
+                                       sign_extension=False)
+                # the byzantine replica broadcasts its equivocation over
+                # the REAL consensus vote channel
+                byz.consensus_reactor._broadcast_vote(fake)
+                try:
+                    await asyncio.wait_for(
+                        _all_apps_saw_misbehavior(apps, byz_addr), 5)
+                    break
+                except asyncio.TimeoutError:
+                    continue
+            else:
+                raise AssertionError("misbehavior never reached the apps")
+
+            # evidence-channel gossip, isolated from the vote channel:
+            # hand-craft fresh DuplicateVoteEvidence for a NEW height,
+            # add it only to node0's pool, and require the evidence
+            # reactor to deliver it into node1's pool directly
+            from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+            h2 = byz.consensus.rs.height - 1   # committed height
+            vals = nodes[0].consensus.state.validators
+            va = Vote(type=PRECOMMIT_TYPE, height=h2, round=0,
+                      block_id=BlockID(b"\x11" * 32,
+                                       PartSetHeader(1, b"\x22" * 32)),
+                      timestamp_ns=7, validator_address=byz_addr,
+                      validator_index=byz_idx)
+            vb = Vote(type=PRECOMMIT_TYPE, height=h2, round=0,
+                      block_id=BlockID(b"\x33" * 32,
+                                       PartSetHeader(1, b"\x99" * 32)),
+                      timestamp_ns=7, validator_address=byz_addr,
+                      validator_index=byz_idx)
+            await pvs[3].sign_vote("ev-net", va, sign_extension=False)
+            await pvs[3].sign_vote("ev-net", vb, sign_extension=False)
+            ev2 = DuplicateVoteEvidence.from_votes(
+                va, vb, nodes[0].consensus.state.last_block_time_ns
+                if hasattr(nodes[0].consensus.state, "last_block_time_ns")
+                else 0, vals)
+            assert nodes[0].evidence_pool.add_evidence(ev2)
+            deadline = asyncio.get_event_loop().time() + 20
+            while not nodes[1].evidence_pool.is_pending(ev2) and \
+                    not nodes[1].evidence_pool.is_committed(ev2):
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "evidence never gossiped pool-to-pool"
+                await asyncio.sleep(0.05)
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    async def _all_apps_saw_misbehavior(apps, byz_addr):
+        while True:
+            hits = 0
+            for app in apps:
+                for mb in app.misbehavior_seen:
+                    if mb.validator_address == byz_addr and \
+                            mb.type == "DUPLICATE_VOTE":
+                        hits += 1
+                        break
+            if hits == len(apps):
+                return None
+            await asyncio.sleep(0.05)
+
+    assert run(main())
