@@ -77,5 +77,7 @@ class Application:
         return b""
 
     async def apply_snapshot_chunk(self, index: int, chunk: bytes,
-                                   sender: str) -> int:
+                                   sender: str):
+        """Return a status int or a full t.ApplySnapshotChunkResponse
+        (refetch_chunks / reject_senders honored by the syncer)."""
         return t.APPLY_CHUNK_ABORT

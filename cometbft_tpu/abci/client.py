@@ -185,6 +185,7 @@ _DC_TYPES = {cls.__name__: cls for cls in (
     t.ProcessProposalRequest, t.FinalizeBlockRequest,
     t.FinalizeBlockResponse, t.ExtendVoteResponse,
     t.VerifyVoteExtensionResponse, t.CommitResponse,
+    t.ApplySnapshotChunkResponse,
     _params.ConsensusParams, _params.BlockParams, _params.EvidenceParams,
     _params.ValidatorParams, _params.VersionParams, _params.FeatureParams,
     _params.SynchronyParams) + _domain_types()}

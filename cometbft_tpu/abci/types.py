@@ -21,6 +21,17 @@ APPLY_CHUNK_RETRY = 3
 
 
 @dataclass
+class ApplySnapshotChunkResponse:
+    """Full reference shape (abci ApplySnapshotChunkResponse:
+    result + refetch_chunks + reject_senders).  Apps may return a bare
+    status int instead; the statesync syncer normalizes."""
+
+    result: int = APPLY_CHUNK_ACCEPT
+    refetch_chunks: list = field(default_factory=list)   # indexes
+    reject_senders: list = field(default_factory=list)   # peer ids
+
+
+@dataclass
 class EventAttribute:
     key: str
     value: str

@@ -41,7 +41,8 @@ class Syncer:
         self.reactor = reactor
         self.log = tmlog.logger("statesync", node=name)
         self._snapshots: dict[tuple, _PendingSnapshot] = {}
-        self._chunks: dict[int, bytes] = {}
+        self._chunks: dict[int, tuple[bytes, str]] = {}  # idx -> (data, sender)
+        self._banned: set[str] = set()   # app-rejected senders
         self._chunk_event = asyncio.Event()
         self._current = None
 
@@ -62,7 +63,9 @@ class Syncer:
                 cur.snapshot.format != format_ or \
                 snapshot_hash != cur.snapshot.hash:
             return      # stale response from another snapshot: drop
-        self._chunks[index] = chunk
+        if peer_id in self._banned:
+            return      # late delivery from a sender the app rejected
+        self._chunks[index] = (chunk, peer_id)
         self._chunk_event.set()
 
     def remove_peer(self, peer_id: str) -> None:
@@ -127,6 +130,7 @@ class Syncer:
 
         self._current = pending
         self._chunks = {}
+        self._banned = set()
         try:
             await self._fetch_and_apply(pending)
         finally:
@@ -194,21 +198,54 @@ class Syncer:
             # index); later chunks wait in self._chunks until their turn
             while len(applied) in self._chunks:
                 i = len(applied)
+                data, sender = self._chunks[i]
                 resp = await self.app_conns.snapshot.apply_snapshot_chunk(
-                    i, self._chunks[i], "")
-                if resp == abci.APPLY_CHUNK_ACCEPT:
-                    applied.add(i)
-                elif resp == abci.APPLY_CHUNK_RETRY:
+                    i, data, sender)
+                if isinstance(resp, int):   # bare-status app shorthand
+                    resp = abci.ApplySnapshotChunkResponse(result=resp)
+
+                # syncer.go:438 — the app can name bad senders and ask
+                # for specific chunks again regardless of the result
+                for bad in resp.reject_senders:
+                    self._banned.add(bad)
+                    if bad in pending.peers:
+                        pending.peers.remove(bad)
+                    # chunks.DiscardSender: everything unapplied from the
+                    # rejected sender is poisoned
+                    for j in [j for j, (_, s) in self._chunks.items()
+                              if s == bad]:
+                        self._chunks.pop(j)
+                        requested.pop(j, None)
+                    self.log.warn("banned snapshot sender", peer=bad)
+
+                full_reset = resp.result == abci.APPLY_CHUNK_RETRY
+                for j in resp.refetch_chunks:
+                    if j < len(applied):
+                        # an already-applied chunk cannot be re-applied
+                        # mid-stream (the restore is strictly sequential):
+                        # discard all progress, like RETRY
+                        full_reset = True
+                    self._chunks.pop(j, None)
+                    requested.pop(j, None)
+
+                bump_retry = full_reset or i in resp.refetch_chunks
+                if bump_retry:
+                    retries[i] = retries.get(i, 0) + 1
+                    if retries[i] > self.MAX_CHUNK_RETRIES:
+                        raise StatesyncError(
+                            f"chunk {i} refused {retries[i]} times")
+                if full_reset:
                     # the app discarded its accumulated restore progress
                     # (e.g. whole-snapshot hash mismatch): refetch all
                     applied.clear()
                     self._chunks.clear()
                     requested.clear()
-                    retries[i] = retries.get(i, 0) + 1
-                    if retries[i] > self.MAX_CHUNK_RETRIES:
-                        raise StatesyncError(
-                            f"chunk {i} refused {retries[i]} times")
                     break
+                if resp.result == abci.APPLY_CHUNK_ACCEPT:
+                    if i in resp.refetch_chunks:
+                        break   # app wants this very chunk again: not
+                                # applied; the outer loop re-requests it
+                    applied.add(i)
                 else:
                     raise StatesyncError(
-                        f"app aborted on chunk {i} ({resp})")
+                        f"app aborted on chunk {i} ({resp.result})")

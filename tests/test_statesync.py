@@ -105,3 +105,110 @@ def test_statesync_bootstraps_fresh_node():
         return True
 
     assert run(main())
+
+
+def test_syncer_honors_reject_senders_and_refetch():
+    """The full ApplySnapshotChunkResponse shape (abci
+    ApplySnapshotChunkResponse): an app naming a bad sender gets that
+    peer banned and the chunk refetched from the remaining peer; restore
+    completes from the honest data."""
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.types import Snapshot
+    from cometbft_tpu.statesync.syncer import Syncer
+
+    class StubSnapshotConn:
+        def __init__(self):
+            self.applied = {}
+            self.banned = False
+
+        async def offer_snapshot(self, snapshot, app_hash):
+            return abci_t.OFFER_SNAPSHOT_ACCEPT
+
+        async def apply_snapshot_chunk(self, index, chunk, sender):
+            if chunk.startswith(b"EVIL"):
+                self.banned = True
+                return abci_t.ApplySnapshotChunkResponse(
+                    result=abci_t.APPLY_CHUNK_ACCEPT,   # result ignored:
+                    refetch_chunks=[index],             # chunk re-pulled
+                    reject_senders=["evil"])
+            self.applied[index] = chunk
+            return abci_t.APPLY_CHUNK_ACCEPT            # bare-int form
+
+    class StubQueryConn:
+        def __init__(self, h, app_hash):
+            self._h, self._hash = h, app_hash
+
+        async def info(self):
+            from cometbft_tpu.abci.types import InfoResponse
+
+            return InfoResponse(last_block_height=self._h,
+                                last_block_app_hash=self._hash)
+
+    class StubProvider:
+        async def app_hash(self, h):
+            return b"\xab" * 32
+
+        async def state(self, h):
+            return "STATE"
+
+        async def commit(self, h):
+            return "COMMIT"
+
+    class StubReactor:
+        def __init__(self, syncer_ref):
+            self.syncer_ref = syncer_ref
+            self.requests = []
+
+        def request_chunk(self, peer, height, format_, index, h):
+            self.requests.append((peer, index))
+            # deliver async like the network would
+            data = (b"EVIL-%d" % index) if peer == "evil" \
+                else (b"GOOD-%d" % index)
+
+            async def deliver():
+                self.syncer_ref[0].add_chunk(peer, height, format_,
+                                             index, data, h)
+
+            asyncio.get_event_loop().create_task(deliver())
+
+    async def main():
+        class Conns:
+            pass
+
+        conns = Conns()
+        snap_conn = StubSnapshotConn()
+        conns.snapshot = snap_conn
+        conns.query = StubQueryConn(5, b"\xab" * 32)
+        ref = [None]
+        reactor = StubReactor(ref)
+        syncer = Syncer(conns, StubProvider(), reactor=reactor)
+        ref[0] = syncer
+        snapshot = Snapshot(height=5, format=1, chunks=3,
+                            hash=b"\xcd" * 32, metadata=b"")
+        # the EVIL peer is first in the rotation, so chunk 0 comes bad
+        syncer.add_snapshot("evil", snapshot)
+        syncer.add_snapshot("good", snapshot)
+
+        state, commit = await syncer._restore(
+            syncer._snapshots[(5, 1, b"\xcd" * 32)])
+        assert state == "STATE" and commit == "COMMIT"
+        assert snap_conn.banned
+        # all three chunks ultimately applied from the honest peer
+        assert set(snap_conn.applied) == {0, 1, 2}
+        assert all(v.startswith(b"GOOD") for v in snap_conn.applied.values())
+        # the banned peer got no further requests after the rejection:
+        # its only request is the initial round-robin one for chunk 0
+        evil_req_positions = [k for k, (p, _) in
+                              enumerate(reactor.requests) if p == "evil"]
+        good_req_positions = [k for k, (p, _) in
+                              enumerate(reactor.requests) if p == "good"]
+        assert len(good_req_positions) >= 3
+        assert evil_req_positions, "evil never even asked once"
+        # evil can appear only in the initial round-robin pass over the
+        # 3 chunks (at most 2 of 3 with 2 peers); everything after the
+        # ban goes to good
+        assert len(evil_req_positions) <= 2, \
+            "banned peer kept receiving requests"
+        return True
+
+    assert run(main())
