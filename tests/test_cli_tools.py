@@ -19,19 +19,42 @@ def _run_cli(*args, home):
         capture_output=True, text=True, env=env, cwd=REPO)
 
 
-def _run_node_for(home, seconds):
-    """Run a single-validator node on this home until it commits blocks."""
+def _run_node_for(home, seconds, min_height=2):
+    """Run a single-validator node on this home until it has committed
+    at least ``min_height`` blocks (a fixed sleep flakes under load —
+    startup alone can eat several seconds on a busy box)."""
+    import json as _json
+    import urllib.request
+
+    from cometbft_tpu.config import Config
+
+    cfg = Config.load(f"{home}/config/config.toml")
+    port = int(cfg.rpc.laddr.rsplit(":", 1)[1])
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     proc = subprocess.Popen(
         [sys.executable, "-m", "cometbft_tpu", "--home", home, "start"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=REPO)
-    time.sleep(seconds)
-    proc.terminate()
+    deadline = time.monotonic() + max(seconds, 90)
     try:
-        proc.wait(timeout=15)
-    except subprocess.TimeoutExpired:
-        proc.kill()
+        while True:
+            assert proc.poll() is None, "node died during warm-up"
+            try:
+                st = _json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2).read())
+                if st["result"]["sync_info"][
+                        "latest_block_height"] >= min_height:
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "node never reached height"
+            time.sleep(0.3)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
 
 
 def _prep_home(tmp_path, port):
