@@ -27,6 +27,14 @@ class StatesyncError(Exception):
     pass
 
 
+class _RejectFormat(StatesyncError):
+    """App returned OFFER_SNAPSHOT_REJECT_FORMAT (syncer.go:38)."""
+
+
+class _RejectSender(StatesyncError):
+    """App returned OFFER_SNAPSHOT_REJECT_SENDER (syncer.go:40)."""
+
+
 class _PendingSnapshot:
     def __init__(self, snapshot):
         self.snapshot = snapshot
@@ -50,6 +58,8 @@ class Syncer:
 
     def add_snapshot(self, peer_id: str, snapshot) -> None:
         key = (snapshot.height, snapshot.format, snapshot.hash)
+        if peer_id in self._banned:
+            return      # snapshots.go RejectPeer: bans outlive rounds
         pending = self._snapshots.setdefault(key,
                                              _PendingSnapshot(snapshot))
         if peer_id not in pending.peers:
@@ -85,6 +95,7 @@ class Syncer:
         relative to the fetch or the chunks will be gone by the time they
         are requested (the reference's retryHook re-requests snapshots
         for the same reason)."""
+        rejected_formats: set[int] = set()   # REJECT_FORMAT is final
         for round_ in range(rounds):
             self._snapshots.clear()
             if self.reactor is not None:
@@ -92,21 +103,37 @@ class Syncer:
             await asyncio.sleep(discovery_time)
             tried: set = set()
             while True:
-                best = self._best_snapshot(tried)
+                best = self._best_snapshot(tried, rejected_formats)
                 if best is None:
                     break                    # pool exhausted: re-discover
                 tried.add((best.snapshot.height, best.snapshot.format,
                            best.snapshot.hash))
                 try:
                     return await self._restore(best)
+                except _RejectFormat:
+                    # syncer.go:208 — skip every snapshot of this format
+                    rejected_formats.add(best.snapshot.format)
+                    self.log.warn("snapshot format rejected",
+                                  format=best.snapshot.format)
+                except _RejectSender:
+                    # syncer.go:212 — distrust every peer advertising it
+                    banned = list(best.peers)
+                    for p in banned:
+                        self._banned.add(p)
+                        self.remove_peer(p)
+                    self.log.warn("snapshot senders rejected",
+                                  peers=len(banned))
                 except StatesyncError as e:
                     self.log.warn("snapshot restore failed; trying next",
                                   height=best.snapshot.height, err=str(e))
         raise StatesyncError(f"no viable snapshots after {rounds} rounds")
 
-    def _best_snapshot(self, tried: set) -> _PendingSnapshot | None:
+    def _best_snapshot(self, tried: set,
+                       rejected_formats: set | None = None
+                       ) -> _PendingSnapshot | None:
         candidates = [p for k, p in self._snapshots.items()
-                      if k not in tried and p.peers]
+                      if k not in tried and p.peers
+                      and p.snapshot.format not in (rejected_formats or ())]
         if not candidates:
             return None
         return max(candidates, key=lambda p: p.snapshot.height)
@@ -125,12 +152,17 @@ class Syncer:
 
         resp = await self.app_conns.snapshot.offer_snapshot(
             snapshot, trusted_app_hash)
+        if resp == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            raise _RejectFormat(f"format {snapshot.format}")
+        if resp == abci.OFFER_SNAPSHOT_REJECT_SENDER:
+            raise _RejectSender("providers rejected")
         if resp != abci.OFFER_SNAPSHOT_ACCEPT:
             raise StatesyncError(f"app rejected snapshot ({resp})")
 
         self._current = pending
         self._chunks = {}
-        self._banned = set()
+        # NOTE: self._banned persists across snapshots — a sender the
+        # app rejected once stays distrusted for the whole sync
         try:
             await self._fetch_and_apply(pending)
         finally:

@@ -212,3 +212,60 @@ def test_syncer_honors_reject_senders_and_refetch():
         return True
 
     assert run(main())
+
+
+def test_syncer_offer_reject_format_and_sender():
+    """OFFER_SNAPSHOT_REJECT_FORMAT skips every snapshot of that format;
+    REJECT_SENDER distrusts the advertising peers (syncer.go:208-212)."""
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.types import Snapshot
+    from cometbft_tpu.statesync.syncer import StatesyncError, Syncer
+
+    offers = []
+
+    class SnapConn:
+        async def offer_snapshot(self, snapshot, app_hash):
+            offers.append((snapshot.height, snapshot.format))
+            if snapshot.format == 9:
+                return abci_t.OFFER_SNAPSHOT_REJECT_FORMAT
+            return abci_t.OFFER_SNAPSHOT_REJECT_SENDER
+
+    class Provider:
+        async def app_hash(self, h):
+            return b"\x01" * 32
+
+    async def main():
+        class Conns:
+            pass
+
+        conns = Conns()
+        conns.snapshot = SnapConn()
+        syncer = Syncer(conns, Provider())
+
+        async def advertise():
+            # sync() clears the pool at round start; deliver the offers
+            # during the discovery window like the reactor would
+            await asyncio.sleep(0.05)
+            for h in (10, 20):
+                syncer.add_snapshot("pA", Snapshot(height=h, format=9,
+                                                   chunks=1, hash=b"\x09",
+                                                   metadata=b""))
+                syncer.add_snapshot("pB", Snapshot(height=h, format=1,
+                                                   chunks=1, hash=b"\x01",
+                                                   metadata=b""))
+
+        adv = asyncio.get_event_loop().create_task(advertise())
+        with pytest.raises(StatesyncError):
+            await syncer.sync(discovery_time=0.2, rounds=1)
+        await adv
+
+        # format 9 was offered exactly once (highest height), then the
+        # whole format was skipped; format-1 offers hit REJECT_SENDER so
+        # both peers end up distrusted
+        f9 = [o for o in offers if o[1] == 9]
+        assert f9 == [(20, 9)], offers
+        assert any(o[1] == 1 for o in offers)
+        assert "pB" in syncer._banned
+        return True
+
+    assert run(main())
