@@ -43,6 +43,7 @@ class Client:
                  trust_level: Fraction = DEFAULT_TRUST_LEVEL,
                  max_clock_drift_ns: int = MAX_CLOCK_DRIFT_NS,
                  backend: str | None = None,
+                 pruning_size: int = 1000,
                  now_ns=time.time_ns):
         self.chain_id = chain_id
         self.trust = trust_options
@@ -53,7 +54,17 @@ class Client:
         self.trust_level = trust_level
         self.max_clock_drift_ns = max_clock_drift_ns
         self.backend = backend
+        # light/client.go:26 defaultPruningSize: the store keeps at most
+        # this many light blocks (0 = unbounded)
+        if pruning_size < 0:
+            raise ValueError("pruning_size must be >= 0")
+        self.pruning_size = pruning_size
         self.now_ns = now_ns
+
+    def _save(self, lb) -> None:
+        self.store.save(lb)
+        if self.pruning_size:
+            self.store.prune(self.pruning_size)
 
     # ------------------------------------------------------------ anchor
 
@@ -67,7 +78,7 @@ class Client:
         err = lb.validate_basic(self.chain_id)
         if err:
             raise LightClientError(f"invalid trust anchor: {err}")
-        self.store.save(lb)
+        self._save(lb)
         return lb
 
     def latest_trusted(self) -> LightBlock | None:
@@ -97,6 +108,8 @@ class Client:
         await self._cross_check(target, now_ns)
         for lb in verified:
             self.store.save(lb)
+        if self.pruning_size:        # one pass after the batch, not per save
+            self.store.prune(self.pruning_size)
         return target
 
     async def update(self, now_ns: int | None = None) -> LightBlock | None:
@@ -180,6 +193,9 @@ class Client:
         if cur.header.last_block_id.hash != lb.header.hash():
             raise LightClientError(
                 f"historic header {height} not linked to trusted chain")
+        # no prune here: a backwards-verified HISTORIC block is the oldest
+        # key by construction — pruning would delete it immediately and
+        # the cache would never help repeat historic queries
         self.store.save(lb)
         return lb
 

@@ -186,3 +186,25 @@ def test_client_backwards_verification():
         return True
 
     assert run(main())
+
+
+def test_client_prunes_store_to_pruning_size():
+    """light/client.go:26 defaultPruningSize: the trusted store keeps a
+    bounded number of light blocks as sync advances."""
+    chain = make_light_chain(20)
+    primary = ChainProvider(chain, "primary")
+
+    async def main():
+        client = Client(CHAIN,
+                        TrustOptions(PERIOD, 1, chain[0].header.hash()),
+                        primary, mode=SEQUENTIAL, backend="cpu",
+                        pruning_size=5, now_ns=lambda: _now(chain))
+        await client.initialize()
+        await client.verify_light_block_at_height(20)
+        stored = [h for h in range(1, 21)
+                  if client.store.get(h) is not None]
+        assert len(stored) <= 5, stored
+        assert client.latest_trusted().height == 20
+        return True
+
+    assert run(main())
