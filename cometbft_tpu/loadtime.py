@@ -15,7 +15,6 @@ and the block header time), then prints distribution statistics.
 from __future__ import annotations
 
 import asyncio
-import os
 import time
 
 PREFIX = b"load:"

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import msgpack
 
-from ..crypto.keys import Ed25519PubKey
 from .block_id import BlockID, PartSetHeader
 from .commit import Commit, CommitSig, ExtendedCommit, ExtendedCommitSig
 from .header import Block, Data, Header
