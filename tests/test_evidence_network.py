@@ -138,3 +138,84 @@ def test_double_sign_detected_and_gossiped_over_tcp():
             await asyncio.sleep(0.05)
 
     assert run(main())
+
+
+def test_broadcast_evidence_rpc():
+    """rpc broadcast_evidence: externally submitted DuplicateVoteEvidence
+    enters the pool after verification; invalid evidence is rejected with
+    an RPC error (rpc/core/evidence.go)."""
+    from cometbft_tpu.rpc import HTTPClient, RPCError
+    from cometbft_tpu.rpc.json import jsonable
+    from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+    async def main():
+        pvs = [MockPV.from_secret(b"bevn%d" % i) for i in range(4)]
+        doc = GenesisDoc(chain_id="bev-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = Config(consensus=_tcc())
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.laddr = "tcp://127.0.0.1:0"
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+                node_key=NodeKey.from_secret(b"bek%d" % i), name=f"bev{i}")
+            nodes.append(node)
+            await node.start()
+        try:
+            for i, a in enumerate(nodes):
+                for b in nodes[i + 1:]:
+                    await a.dial_peer(b.listen_addr, persistent=True)
+            while min(n.height() for n in nodes) < 3:
+                await asyncio.sleep(0.05)
+
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            byz_addr = pvs[3].get_pub_key().address()
+            byz_idx, _ = nodes[0].consensus.state.validators \
+                .get_by_address(byz_addr)
+            h = nodes[0].height() - 1
+            votes = []
+            for tag in (b"\x10", b"\x20"):
+                v = Vote(type=PRECOMMIT_TYPE, height=h, round=0,
+                         block_id=BlockID(tag * 32,
+                                          PartSetHeader(1, tag * 32)),
+                         timestamp_ns=9, validator_address=byz_addr,
+                         validator_index=byz_idx)
+                await pvs[3].sign_vote("bev-net", v, sign_extension=False)
+                votes.append(v)
+            blk_time = nodes[0].block_store.load_block(h).header.time_ns
+            ev = DuplicateVoteEvidence.from_votes(
+                votes[0], votes[1], blk_time,
+                nodes[0].consensus.state.validators)
+
+            res = await cli.call("broadcast_evidence",
+                                 evidence=jsonable(ev))
+            assert res["hash"] == ev.hash().hex()
+            assert nodes[0].evidence_pool.is_pending(ev)
+
+            # invalid evidence (unsigned votes) is rejected
+            bad = DuplicateVoteEvidence(
+                vote_a=Vote(type=PRECOMMIT_TYPE, height=h, round=0,
+                            block_id=BlockID(b"\x01" * 32,
+                                             PartSetHeader(1, b"\x01" * 32)),
+                            timestamp_ns=1, validator_address=byz_addr,
+                            validator_index=byz_idx),
+                vote_b=Vote(type=PRECOMMIT_TYPE, height=h, round=0,
+                            block_id=BlockID(b"\x02" * 32,
+                                             PartSetHeader(1, b"\x02" * 32)),
+                            timestamp_ns=1, validator_address=byz_addr,
+                            validator_index=byz_idx))
+            import pytest as _pytest
+            with _pytest.raises(RPCError):
+                await cli.call("broadcast_evidence",
+                               evidence=jsonable(bad))
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
