@@ -295,8 +295,19 @@ def test_prometheus_standalone_listener():
         reader, writer = await asyncio.open_connection("127.0.0.1", port)
         writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
         await writer.drain()
-        raw = await asyncio.wait_for(reader.read(65536), 5)
-        assert b"200 OK" in raw.split(b"\r\n")[0]
+        # read the whole body (the shared registry grows with the suite;
+        # a single read() caps at 64KB and truncates late metrics)
+        status = await asyncio.wait_for(reader.readline(), 5)
+        assert b"200 OK" in status
+        headers = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(), 5)
+            if line in (b"\r\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = await asyncio.wait_for(
+            reader.readexactly(int(headers["content-length"])), 10)
         assert b"obs_test_total" in raw
         writer.close()
         server.close()

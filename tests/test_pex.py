@@ -73,14 +73,14 @@ def test_pex_discovers_transitive_peer():
                 while c.node_key.id not in a.switch.peers:
                     await asyncio.sleep(0.05)
 
-            await asyncio.wait_for(connected(), 30)
+            await asyncio.wait_for(connected(), 60)   # loaded-box margin
             # and the address book learned it
             assert any(nid == c.node_key.id
                        for nid, _ in a.addr_book.sample(100))
         finally:
             for n in nodes:
                 try:
-                    await n.stop()
+                    await asyncio.wait_for(n.stop(), 15)
                 except Exception:
                     pass
         return True
