@@ -6,9 +6,14 @@ Commit.VerifyCommit latency @10k vals") and the reference's bench harness
 sig counts): ed25519 signatures over ~120-byte vote-sign-bytes messages,
 verified on the accelerator via the ZIP-215 kernel.
 
-``vs_baseline`` is the measured speedup over the host CPU single-verify
-path (the stand-in for the reference's Go curve25519-voi verifier; voi's
-batch mode is ~2x the single path, so divide by ~2 for a conservative read).
+In ``commit`` mode two explicit comparison fields are emitted:
+``vs_single_loop`` (speedup over a host single-verify loop) and
+``vs_reference_batch_est`` (that number / 2 — curve25519-voi's CPU batch
+mode runs ~2x its single path, so this estimates the speedup over the
+reference's REAL baseline).  ``vs_baseline`` equals the reference-relative
+estimate on every backend, so the driver's one JSON line can never be
+misread as parity with the reference when it is only parity with our own
+single-verify loop.
 
 Robustness contract (the whole point of this file's structure): the parent
 process NEVER imports jax.  The TPU attempt runs in a subprocess with a hard
@@ -309,12 +314,17 @@ def _child_main(backend: str, nsig: int) -> None:
             assert verify_ed25519_zip215(pk, msg, sig)
         cpu_per_sig = (time.perf_counter() - t0) / len(sample)
 
+        vs_single = (cpu_per_sig * nsig) / p50
         print(json.dumps({
             "metric": "ed25519 sig-verifies/sec/chip "
                       "(extended-commit-shaped batch)",
             "value": round(nsig / p50, 1),
             "unit": "sigs/s",
-            "vs_baseline": round((cpu_per_sig * nsig) / p50, 2),
+            # reference-relative: voi's CPU batch path is ~2x its single
+            # verify, so the honest comparison halves the single-loop win
+            "vs_baseline": round(vs_single / 2.0, 2),
+            "vs_single_loop": round(vs_single, 2),
+            "vs_reference_batch_est": round(vs_single / 2.0, 2),
             "p50_batch_latency_ms": round(p50 * 1e3, 3),
             "batch_size": nsig,
             "backend": "cpu",
@@ -367,14 +377,16 @@ def _child_main(backend: str, nsig: int) -> None:
     for pk, msg, sig in sample:
         assert verify_ed25519_zip215(pk, msg, sig)
     cpu_per_sig = (time.perf_counter() - t0) / len(sample)
-    vs_baseline = (cpu_per_sig * nsig) / p50
+    vs_single = (cpu_per_sig * nsig) / p50
 
     print(json.dumps({
         "metric": "ed25519 sig-verifies/sec/chip "
                   "(extended-commit-shaped batch)",
         "value": round(sigs_per_sec, 1),
         "unit": "sigs/s",
-        "vs_baseline": round(vs_baseline, 2),
+        "vs_baseline": round(vs_single / 2.0, 2),
+        "vs_single_loop": round(vs_single, 2),
+        "vs_reference_batch_est": round(vs_single / 2.0, 2),
         "p50_batch_latency_ms": round(p50 * 1e3, 3),
         "batch_size": nsig,
         "backend": backend,

@@ -132,6 +132,73 @@ def _backend():
 _BACKEND = _backend()                # resolved once at import
 ENABLED = _BACKEND is not None
 
+# The IETF ciphersuite each backend implements.  py_ecc / blspy speak the
+# standard G2Basic suite; the bundled fallback's SVDW hash-to-curve is a
+# distinct (self-interop-only) suite — mixing the two across a validator
+# set is a consensus-split hazard, so nodes must agree on the suite.
+STANDARD_CIPHERSUITE = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"
+_PUREPY_CIPHERSUITE = "PUREPY_BLS12381G2_XMD:SHA-256_SVDW_RO_NUL_"
+
+
+def backend_ciphersuite() -> str:
+    """The hash-to-curve ciphersuite of the active backend — recorded so
+    mismatched networks fail fast instead of forking (a hazard the
+    reference avoids only by having a single blst backend)."""
+    if isinstance(_BACKEND, _PurePyBackend):
+        return _PUREPY_CIPHERSUITE
+    return STANDARD_CIPHERSUITE
+
+
+def is_standard_backend() -> bool:
+    return backend_ciphersuite() == STANDARD_CIPHERSUITE
+
+
+def nonstandard_backend_allowed() -> bool:
+    """Opt-in gate for running BLS *validator* keys on the non-standard
+    bundled backend (``COMETBFT_TPU_ALLOW_NONSTANDARD_BLS=1``): without
+    it, a network mixing backend suites would silently disagree on BLS
+    signature validity."""
+    import os
+
+    return os.environ.get("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS",
+                          "").strip().lower() in ("1", "true", "yes")
+
+
+def check_validator_backend() -> str | None:
+    """Return an error string when BLS validator keys would run on the
+    non-standard pure-Python suite without the explicit opt-in; None when
+    safe.  Called from genesis validation and privval key loading."""
+    if is_standard_backend() or nonstandard_backend_allowed():
+        return None
+    return (
+        "bls12_381 validator keys are in use but this node's BLS "
+        f"backend speaks the non-standard bundled suite "
+        f"({_PUREPY_CIPHERSUITE}); a network with standard-suite nodes "
+        "(py_ecc/blspy) would disagree on signature validity and fork. "
+        "Install py_ecc or blspy, or — for a closed testnet where EVERY "
+        "node runs the bundled backend — set "
+        "COMETBFT_TPU_ALLOW_NONSTANDARD_BLS=1")
+
+
+_SIGN_WARNED = False
+
+
+def _warn_purepy_signing() -> None:
+    """One-time runtime warning: pure-Python big-int scalar multiplication
+    is variable-time — a secret-key timing side channel.  Production BLS
+    validators must install blspy or py_ecc."""
+    global _SIGN_WARNED
+    if _SIGN_WARNED:
+        return
+    _SIGN_WARNED = True
+    import sys
+
+    print("WARNING: signing with a bls12_381 key on the bundled "
+          "pure-Python backend — variable-time scalar multiplication "
+          "leaks key bits through timing, and the hash-to-curve suite is "
+          "non-standard (self-interop only). Install py_ecc or blspy for "
+          "production validators.", file=sys.stderr)
+
 
 class Bls12381PubKey(PubKey):
     def __init__(self, raw: bytes):
@@ -188,6 +255,8 @@ class Bls12381PrivKey(PrivKey):
         impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
+        if isinstance(impl, _PurePyBackend):
+            _warn_purepy_signing()
         return impl.sign(int.from_bytes(self._raw, "big"), msg)
 
     def pub_key(self) -> Bls12381PubKey:

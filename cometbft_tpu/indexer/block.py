@@ -73,9 +73,18 @@ class BlockIndexer:
                         m.setdefault(f"{etype}.{k}", []).append(v)
                 conds = q.conditions
             else:
-                # legacy row (pre-events storage, value b""): only
-                # block.height conditions are decidable; the rest were
-                # already satisfied by posting narrowing for equality
+                # legacy row (pre-events storage, value b""): equality
+                # conditions were satisfied by posting narrowing and
+                # block.height is decidable, but ranges/CONTAINS/EXISTS
+                # on event attributes are UNDECIDABLE — treat them as
+                # non-matching rather than returning false positives
+                # (reindex via `reindex-event` to make them queryable)
+                undecidable = [c for c in q.conditions
+                               if c.key != "block.height"
+                               and not (c.op == "="
+                                        and eq.get(c.key) == c.arg)]
+                if undecidable:
+                    continue
                 conds = [c for c in q.conditions if c.key == "block.height"]
             if all(c.matches(m.get(c.key)) for c in conds):
                 kept.append(h)

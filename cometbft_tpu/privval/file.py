@@ -49,10 +49,25 @@ class FilePV(PrivValidator):
 
     # ------------------------------------------------------------- file io
 
+    def _check_bls_backend(self) -> None:
+        """Consensus-split guard (same check genesis validation runs): a
+        BLS validator key may only SIGN on the non-standard bundled suite
+        with the explicit closed-network opt-in.  Deliberately not in
+        ``__init__``/``load`` — maintenance paths (show-validator,
+        unsafe-reset-all) must keep working without the env var."""
+        if self.priv_key.type() != "bls12_381":
+            return
+        from ..crypto import bls12381 as _bls
+
+        err = _bls.check_validator_backend()
+        if err:
+            raise ValueError(err)
+
     @classmethod
     def generate(cls, key_path: str, state_path: str,
                  key_type: str = "ed25519") -> "FilePV":
         pv = cls(gen_priv_key(key_type), key_path, state_path)
+        pv._check_bls_backend()        # refuse to CREATE an unusable key
         pv.save_key()
         pv._save_state()
         return pv
@@ -129,6 +144,7 @@ class FilePV(PrivValidator):
 
     async def sign_vote(self, chain_id: str, vote: Vote,
                         sign_extension: bool) -> None:
+        self._check_bls_backend()
         step = _VOTE_STEP[vote.type]
         same_hrs = self._check_hrs(vote.height, vote.round, step)
         sb = vote.sign_bytes(chain_id)
@@ -159,6 +175,7 @@ class FilePV(PrivValidator):
             vote.extension_signature = ext_sig
 
     async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        self._check_bls_backend()
         same_hrs = self._check_hrs(proposal.height, proposal.round,
                                    STEP_PROPOSE)
         sb = proposal.sign_bytes(chain_id)

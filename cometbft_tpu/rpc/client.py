@@ -43,7 +43,13 @@ class HTTPClient:
             reqs.append({"jsonrpc": "2.0", "id": self._id,
                          "method": method, "params": params})
         resps = await self._post(json.dumps(reqs).encode())
-        by_id = {r.get("id"): r for r in resps}
+        if not isinstance(resps, list):
+            # whole-batch failure: the server answered with a single
+            # error object (e.g. parse error) instead of an array
+            if isinstance(resps, dict) and "error" in resps:
+                raise _err(resps["error"])
+            raise RPCError(-32700, f"malformed batch response: {resps!r}")
+        by_id = {r.get("id"): r for r in resps if isinstance(r, dict)}
         out = []
         for req in reqs:
             r = by_id.get(req["id"], {})

@@ -49,6 +49,15 @@ class GenesisDoc:
         for v in self.validators:
             if v.power < 0:
                 raise GenesisError("validator power cannot be negative")
+        if any(v.pub_key.type() == "bls12_381" for v in self.validators):
+            # consensus-split guard: BLS validator keys require either a
+            # standard-ciphersuite backend or an explicit closed-network
+            # opt-in (see crypto/bls12381.check_validator_backend)
+            from ..crypto import bls12381 as _bls
+
+            err = _bls.check_validator_backend()
+            if err:
+                raise GenesisError(err)
 
     def validator_set(self) -> ValidatorSet:
         return ValidatorSet([Validator(v.pub_key, v.power)

@@ -137,3 +137,28 @@ def test_bls12381_stub_surface():
         sig = sk.sign(b"msg")
         assert len(sig) == 96
         assert sk.pub_key().verify_signature(b"msg", sig)
+
+
+def test_bls_validator_backend_guard(monkeypatch):
+    """Consensus-split guard: a genesis with bls12_381 validator keys is
+    refused when the node's backend speaks the non-standard bundled
+    ciphersuite, unless the closed-network opt-in env is set (a hazard
+    the reference sidesteps by having exactly one blst backend)."""
+    import pytest as _pytest
+
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.types.genesis import (GenesisDoc, GenesisError,
+                                            GenesisValidator)
+
+    pub = bls.Bls12381PubKey(b"\x01" * 48)
+    doc = GenesisDoc(chain_id="bls-chain",
+                     validators=[GenesisValidator(pub_key=pub, power=10)])
+
+    monkeypatch.delenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", raising=False)
+    if bls.is_standard_backend():
+        doc.validate_and_complete()          # standard suite: always fine
+        return
+    with _pytest.raises(GenesisError, match="ciphersuite|suite|backend"):
+        doc.validate_and_complete()
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    doc.validate_and_complete()              # explicit opt-in unblocks
