@@ -1,0 +1,72 @@
+"""ctypes binding for the native (C++) ZIP-215 ed25519 verifier.
+
+``native/ed25519.cpp`` implements single and random-linear-combination
+batch verification — the host CPU analogue of the reference's
+curve25519-voi batch path (``crypto/ed25519/ed25519.go:188-221``), which
+SURVEY §2.9-1 requires to be native, never a Python stand-in.  The batch
+entry verifies n signatures as ONE Pippenger multiscalar multiplication,
+~5x a single-verify loop at commit scale.
+
+Degrades gracefully: if the on-demand g++ build fails, every function
+returns None and callers keep their pure-host path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import os
+
+
+@functools.cache
+def _lib():
+    try:
+        from ..native import lib_path
+
+        lib = ctypes.CDLL(lib_path("ed25519"))
+        lib.ed25519_verify.restype = ctypes.c_int
+        lib.ed25519_verify.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.ed25519_batch_verify.restype = ctypes.c_int
+        lib.ed25519_batch_verify.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+            ctypes.c_char_p]
+        return lib
+    except Exception:
+        return None
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def verify(pub: bytes, msg: bytes, sig: bytes) -> bool | None:
+    """Exact single ZIP-215 verification; None if the lib is unavailable."""
+    lib = _lib()
+    if lib is None:
+        return None
+    if len(pub) != 32 or len(sig) != 64:
+        return False
+    return bool(lib.ed25519_verify(pub, sig, msg, len(msg)))
+
+
+def batch_verify(pubs: list[bytes], msgs: list[bytes],
+                 sigs: list[bytes]) -> bool | None:
+    """One RLC batch check over the whole list: True means EVERY signature
+    is valid; False means at least one is not (caller localizes with
+    single verifies); None when the native lib is unavailable.
+
+    Inputs must be pre-validated: 32-byte pubs, 64-byte sigs.
+    """
+    lib = _lib()
+    if lib is None:
+        return None
+    n = len(pubs)
+    if n == 0:
+        return False
+    lens = (ctypes.c_uint64 * n)(*[len(m) for m in msgs])
+    return bool(lib.ed25519_batch_verify(
+        b"".join(pubs), b"".join(sigs), b"".join(msgs), lens, n,
+        os.urandom(32)))

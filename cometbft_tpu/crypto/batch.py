@@ -52,14 +52,20 @@ class BatchVerifier(ABC):
 
 
 class CpuBatchVerifier(BatchVerifier):
-    """Host fallback: per-signature native verification (OpenSSL fast path
-    with exact ZIP-215 recheck), used when no accelerator is present."""
+    """Host fallback, used when no accelerator is present.
+
+    ed25519 lanes verify through the native (C++) RLC batch verifier —
+    one Pippenger multiscalar multiplication over the whole batch, ~5x a
+    single-verify loop, matching the reference's curve25519-voi batch
+    path (``crypto/ed25519/ed25519.go:188-221``).  On batch failure (or
+    when the native lib is unavailable) lanes verify one by one; other
+    key types always verify per-signature."""
 
     def __init__(self):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
 
     def add(self, pub, msg, sig):
-        self._items.append((pub, msg, sig))
+        self._items.append((pub, bytes(msg), bytes(sig)))
 
     @property
     def _count(self):
@@ -70,11 +76,48 @@ class CpuBatchVerifier(BatchVerifier):
 
         hist, lanes, calls = _metrics()
         t0 = _time.perf_counter()
-        oks = [p.verify_signature(m, s) for p, m, s in self._items]
-        hist.observe(_time.perf_counter() - t0, backend="cpu")
-        lanes.inc(len(oks), route="cpu")
-        calls.inc(backend="cpu")
-        return all(oks) and len(oks) > 0, oks
+        try:
+            return self._verify()
+        finally:
+            hist.observe(_time.perf_counter() - t0, backend="cpu")
+            calls.inc(backend="cpu")
+
+    def _verify(self):
+        _, lanes, _ = _metrics()
+        n = len(self._items)
+        oks = [False] * n
+        ed_idx = [i for i, (p, _, s) in enumerate(self._items)
+                  if p.type() == ED25519_KEY_TYPE and len(s) == 64]
+        ed_set = set(ed_idx)
+        for i, (p, m, s) in enumerate(self._items):
+            if i not in ed_set:
+                oks[i] = p.verify_signature(m, s)
+        ed_oks = _host_verify_ed25519(
+            [self._items[i] for i in ed_idx], lanes, route="cpu")
+        for j, i in enumerate(ed_idx):
+            oks[i] = ed_oks[j]
+        lanes.inc(n - len(ed_idx), route="cpu")
+        return all(oks) and n > 0, oks
+
+
+def _host_verify_ed25519(items, lanes_metric, route: str) -> list[bool]:
+    """Host verification of ed25519 lanes (32-byte pubs, 64-byte sigs
+    pre-filtered): one native C++ RLC batch when the whole batch is valid
+    (the common case), falling back to per-signature verification to
+    localize failures — or when the native lib is unavailable.  Shared by
+    the CPU backend and every TpuBatchVerifier host-fallback path."""
+    from . import _native_ed25519 as _nat
+
+    # >= 2 lanes: one RLC multiscalar beats OpenSSL's asm single verify
+    if len(items) >= 2:
+        batched = _nat.batch_verify([p.bytes() for p, _, _ in items],
+                                    [m for _, m, _ in items],
+                                    [s for _, _, s in items])
+        if batched:
+            lanes_metric.inc(len(items), route=route + "_batch")
+            return [True] * len(items)
+    lanes_metric.inc(len(items), route=route)
+    return [p.verify_signature(m, s) for p, m, s in items]
 
 
 def _bucket(n: int, buckets) -> int:
@@ -283,20 +326,24 @@ class TpuBatchVerifier(BatchVerifier):
         if n == 0:
             return False, []
         _, lanes, _ = _metrics()
-        if n < TpuBatchVerifier.MIN_DEVICE_LANES:
-            # tiny batch: host verification beats device dispatch latency
-            oks = [p.verify_signature(m, s) for p, m, s in self._items]
-            lanes.inc(n, route="cpu")
-            return all(oks), oks
         ed_idx = [i for i, (p, _, s) in enumerate(self._items)
                   if p.type() == ED25519_KEY_TYPE and len(s) == 64]
         ed_set = set(ed_idx)
         oks = [False] * n
-        lanes.inc(len(ed_idx), route="device")
-        lanes.inc(n - len(ed_idx), route="cpu")
         for i, (p, m, s) in enumerate(self._items):
             if i not in ed_set:
                 oks[i] = p.verify_signature(m, s)
+        if n < TpuBatchVerifier.MIN_DEVICE_LANES:
+            # tiny batch: host verification beats device dispatch latency
+            # (still through the native RLC batch when >= 2 ed lanes)
+            ed_oks = _host_verify_ed25519(
+                [self._items[i] for i in ed_idx], lanes, route="cpu")
+            for j, i in enumerate(ed_idx):
+                oks[i] = ed_oks[j]
+            lanes.inc(n - len(ed_idx), route="cpu")
+            return all(oks) and n > 0, oks
+        lanes.inc(len(ed_idx), route="device")
+        lanes.inc(n - len(ed_idx), route="cpu")
         if ed_idx:
             # vectorized packing: one frombuffer per FIELD, not per lane
             # (a per-lane loop costs ~100 ms at 10k sigs — on the p50
@@ -320,12 +367,14 @@ class TpuBatchVerifier(BatchVerifier):
             dev = _device_call(lambda: device_verify_ed25519(
                 pubs, rs, ss, msgs, lens, self._device))
             if dev is None:
-                # device busy/stuck/slow: verify these lanes on host so
-                # consensus never waits on the accelerator
-                lanes.inc(len(ed_idx), route="host_fallback")
-                for i in ed_idx:
-                    p, m, s = self._items[i]
-                    oks[i] = p.verify_signature(m, s)
+                # device busy/stuck/slow: verify these lanes on host (via
+                # the native RLC batch) so consensus never waits on the
+                # accelerator
+                ed_oks = _host_verify_ed25519(
+                    [self._items[i] for i in ed_idx], lanes,
+                    route="host_fallback")
+                for j, i in enumerate(ed_idx):
+                    oks[i] = ed_oks[j]
             else:
                 for j, i in enumerate(ed_idx):
                     oks[i] = bool(dev[j])

@@ -16,6 +16,7 @@ always correct to accept.  Batch verification lives in ``crypto.batch``.
 
 from __future__ import annotations
 
+import functools
 import hashlib
 import os
 from abc import ABC, abstractmethod
@@ -105,19 +106,33 @@ class PrivKey(ABC):
     def type(self) -> str: ...
 
 
+@functools.lru_cache(maxsize=4096)
+def _parsed_pubkey(pub: bytes):
+    """Parsed OpenSSL key objects, cached per raw pubkey: validator sets
+    are ~static across heights, so repeat verifies skip the parse (the
+    reference's cacheSize-4096 expanded-pubkey cache,
+    ``crypto/ed25519/ed25519.go:42-67``).  Raises on malformed keys."""
+    return _ossl.Ed25519PublicKey.from_public_bytes(pub)
+
+
 def verify_ed25519_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
     """Single ZIP-215 verification on host.
 
     OpenSSL fast path: its accepts are a subset of ZIP-215's, so a pass is
     final; only its (rare, adversarial-input) rejects re-check with the exact
-    pure-Python ZIP-215 verifier.
+    ZIP-215 verifier (native C++ when built, pure-Python otherwise).
     """
     if len(sig) != 64 or len(pub) != 32:
         return False
     try:
-        _ossl.Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        _parsed_pubkey(pub).verify(sig, msg)
         return True
     except (InvalidSignature, ValueError):
+        from . import _native_ed25519 as _nat
+
+        exact = _nat.verify(pub, msg, sig)
+        if exact is not None:
+            return exact
         return _ref.verify_zip215(pub, msg, sig)
 
 

@@ -374,6 +374,16 @@ class Node:
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
         if self.config.base.device_wait_s > 0:
             cryptobatch.set_device_wait(self.config.base.device_wait_s)
+
+        def _warm_native():
+            # build/load the C++ RLC batch verifier off the event loop so
+            # a fresh checkout's first commit verification doesn't eat a
+            # multi-second g++ compile on the consensus hot path
+            from ..crypto import _native_ed25519 as nat
+
+            nat.available()
+
+        asyncio.get_running_loop().run_in_executor(None, _warm_native)
         if self.config.base.device_warmup and \
                 self.config.base.signature_backend in ("tpu", "jax",
                                                        "auto"):

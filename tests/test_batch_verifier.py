@@ -153,3 +153,83 @@ def test_init_multihost_single_host_default(monkeypatch):
     mesh = init_multihost()
     assert mesh.axis_names == ("batch",)
     assert mesh.devices.size == batch_mesh().devices.size
+
+
+# ------------------------------------------------------- native (C++) RLC
+
+def test_native_ed25519_available():
+    """The on-demand g++ build must work on this image (SURVEY §2.9-1:
+    the CPU fallback is native, never a Python stand-in)."""
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+
+    assert nat.available()
+
+
+def test_native_single_matches_oracle_on_edges():
+    """ZIP-215 edge semantics: non-canonical encodings, small-order
+    points, s >= L — native verdicts must equal the pure-Python oracle."""
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+
+    P, L = ref.P, ref.L
+    msg = b"edge"
+
+    def enc(y, sign):
+        return int.to_bytes((y & ((1 << 255) - 1)) | (sign << 255), 32,
+                            "little")
+
+    pubs = [enc(y, s) for y in (0, 1, P - 1, P, P + 1, 2**255 - 1, 2)
+            for s in (0, 1)]
+    rs = pubs[:6]
+    svals = (0, 1, L - 1, L, 7)
+    checked = 0
+    for pub in pubs:
+        for r in rs:
+            for sv in svals:
+                sig = r + sv.to_bytes(32, "little")
+                assert nat.verify(pub, msg, sig) == ref.verify_zip215(
+                    pub, msg, sig), (pub.hex(), sig.hex())
+                checked += 1
+    assert checked == len(pubs) * len(rs) * len(svals)
+
+
+def test_native_batch_verify_and_localization():
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+
+    items = make_sigs(33)
+    pubs = [p.bytes() for p, _, _ in items]
+    msgs = [m for _, m, _ in items]
+    sigs = [s for _, _, s in items]
+    assert nat.batch_verify(pubs, msgs, sigs) is True
+    bad = list(sigs)
+    bad[17] = bytes(64)
+    assert nat.batch_verify(pubs, msgs, bad) is False
+    assert nat.batch_verify([], [], []) is False
+
+    # the seam: CpuBatchVerifier routes through the native batch and
+    # localizes failures per lane
+    bv = CpuBatchVerifier()
+    for (p, m, _), s in zip(items, bad):
+        bv.add(p, m, s)
+    ok, oks = bv.verify()
+    assert not ok
+    assert oks == [i != 17 for i in range(33)]
+
+
+def test_native_batch_accepts_zip215_only_sigs():
+    """A batch containing a signature OpenSSL would reject but ZIP-215
+    accepts (non-canonical A) must still pass as a whole — parity with
+    the oracle, not with OpenSSL."""
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+
+    P = ref.P
+    r_scalar = 12345
+    r_enc = ref.pt_compress(ref.pt_mul(r_scalar, ref.BASE))
+    ident_nc = (1 + P).to_bytes(32, "little")     # non-canonical identity
+    odd_sig = r_enc + r_scalar.to_bytes(32, "little")
+    assert ref.verify_zip215(ident_nc, b"m", odd_sig)
+
+    items = make_sigs(4)
+    pubs = [p.bytes() for p, _, _ in items] + [ident_nc]
+    msgs = [m for _, m, _ in items] + [b"m"]
+    sigs = [s for _, _, s in items] + [odd_sig]
+    assert nat.batch_verify(pubs, msgs, sigs) is True
