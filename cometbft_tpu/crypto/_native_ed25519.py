@@ -5,7 +5,9 @@ batch verification — the host CPU analogue of the reference's
 curve25519-voi batch path (``crypto/ed25519/ed25519.go:188-221``), which
 SURVEY §2.9-1 requires to be native, never a Python stand-in.  The batch
 entry verifies n signatures as ONE Pippenger multiscalar multiplication,
-~5x a single-verify loop at commit scale.
+~5x a single-verify loop at commit scale.  It also hosts the native
+canonical vote sign-bytes builder (SURVEY §2.9-4) used by the dense
+VerifyCommit fast path.
 
 Degrades gracefully: if the on-demand g++ build fails, every function
 returns None and callers keep their pure-host path.
@@ -16,6 +18,10 @@ from __future__ import annotations
 import ctypes
 import functools
 import os
+
+_U64P = ctypes.POINTER(ctypes.c_uint64)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_U8P = ctypes.POINTER(ctypes.c_uint8)
 
 
 @functools.cache
@@ -31,8 +37,14 @@ def _lib():
         lib.ed25519_batch_verify.restype = ctypes.c_int
         lib.ed25519_batch_verify.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
-            ctypes.c_char_p]
+            _U64P, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]
+        lib.build_vote_sign_bytes.restype = ctypes.c_uint64
+        lib.build_vote_sign_bytes.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,      # pre_commit
+            ctypes.c_char_p, ctypes.c_uint64,      # pre_nil
+            ctypes.c_char_p, ctypes.c_uint64,      # post
+            _I64P, ctypes.c_char_p, ctypes.c_uint64,   # ts, flags, n
+            _U8P, ctypes.c_uint64, _U64P]          # out, stride, lens
         return lib
     except Exception:
         return None
@@ -69,4 +81,56 @@ def batch_verify(pubs: list[bytes], msgs: list[bytes],
     lens = (ctypes.c_uint64 * n)(*[len(m) for m in msgs])
     return bool(lib.ed25519_batch_verify(
         b"".join(pubs), b"".join(sigs), b"".join(msgs), lens, n,
-        os.urandom(32)))
+        os.urandom(32), 0))
+
+
+def batch_verify_dense(pubs, sigs, msgs, lens) -> bool | None:
+    """Dense-array RLC batch: ``pubs`` (n,32) u8, ``sigs`` (n,64) u8,
+    ``msgs`` (n,stride) u8 zero-padded rows, ``lens`` (n,) — the exact
+    matrices the TPU packing path builds, verified without any repacking.
+    Arrays must be C-contiguous numpy uint8 (lens any int dtype)."""
+    import numpy as np
+
+    lib = _lib()
+    if lib is None:
+        return None
+    n = pubs.shape[0]
+    if n == 0:
+        return False
+    lens64 = np.ascontiguousarray(lens, np.uint64)
+    return bool(lib.ed25519_batch_verify(
+        pubs.ctypes.data_as(ctypes.c_char_p),
+        sigs.ctypes.data_as(ctypes.c_char_p),
+        msgs.ctypes.data_as(ctypes.c_char_p),
+        lens64.ctypes.data_as(_U64P), n, os.urandom(32), msgs.shape[1]))
+
+
+def build_vote_sign_bytes(pre_commit: bytes, pre_nil: bytes, post: bytes,
+                          ts_ns, flags):
+    """Assemble one commit's canonical vote sign-bytes rows natively.
+
+    ``ts_ns`` int64 array (n,), ``flags`` uint8 array (n,) with 2 =
+    commit-variant prefix, else nil-variant.  Returns ``(msgs, lens)`` —
+    (n, stride) uint8 rows + true lengths — or None when unavailable.
+    """
+    import numpy as np
+
+    lib = _lib()
+    if lib is None:
+        return None
+    n = len(ts_ns)
+    stride = 5 + max(len(pre_commit), len(pre_nil)) + 19 + len(post)
+    out = np.zeros((n, stride), np.uint8)
+    lens = np.zeros((n,), np.uint64)
+    ts64 = np.ascontiguousarray(ts_ns, np.int64)
+    fl8 = np.ascontiguousarray(flags, np.uint8)
+    rc = lib.build_vote_sign_bytes(
+        pre_commit, len(pre_commit), pre_nil, len(pre_nil),
+        post, len(post),
+        ts64.ctypes.data_as(_I64P),
+        fl8.ctypes.data_as(ctypes.c_char_p), n,
+        out.ctypes.data_as(_U8P), stride,
+        lens.ctypes.data_as(_U64P))
+    if rc != 0:                      # stride undersized (can't happen with
+        raise RuntimeError("sign-bytes stride miscomputed")  # our formula)
+    return out, lens.astype(np.int64)

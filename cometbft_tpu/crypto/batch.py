@@ -381,6 +381,56 @@ class TpuBatchVerifier(BatchVerifier):
         return all(oks), oks
 
 
+def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None):
+    """Dense-array verification behind the same backend dispatch as
+    :func:`create_batch_verifier`: ``pubs`` (k,32) u8, ``sigs`` (k,64) u8,
+    ``msgs`` (k,L) u8 zero-padded rows, ``lens`` (k,) int — the matrices
+    the native sign-bytes builder emits.  All lanes must be ed25519.
+
+    Returns ``(all_ok, oks ndarray)``, or None when no dense-capable
+    backend exists (no native lib on a CPU box) — the caller falls back
+    to the per-lane object path.  Device wedging degrades to the native
+    CPU batch under the same bounded wait as TpuBatchVerifier."""
+    import numpy as np
+
+    from . import _native_ed25519 as _nat
+
+    k = pubs.shape[0]
+    if k == 0:
+        return True, np.zeros((0,), bool)
+    _, lanes, _ = _metrics()
+    want_device = backend in ("tpu", "jax")
+    if backend == "auto":
+        if device is None and _PROBE_RESULT is None:
+            _start_probe_background()      # serve this batch from host
+        else:
+            dev = device if device is not None else _accelerator_device()
+            want_device = (dev is not None
+                           and getattr(dev, "platform", "cpu") != "cpu")
+            if want_device:
+                device = dev
+    if want_device and k >= TpuBatchVerifier.MIN_DEVICE_LANES:
+        out = _device_call(lambda: device_verify_ed25519(
+            pubs, np.ascontiguousarray(sigs[:, :32]),
+            np.ascontiguousarray(sigs[:, 32:]), msgs, lens, device))
+        if out is not None:
+            lanes.inc(k, route="device")
+            return bool(out.all()), out
+        # device busy/wedged: bounded fallback to the native host batch
+    res = _nat.batch_verify_dense(pubs, sigs, msgs, lens)
+    if res is None:
+        return None
+    if res:
+        lanes.inc(k, route="cpu_batch")
+        return True, np.ones((k,), bool)
+    # refuted: localize per lane with the exact native single verify
+    oks = np.fromiter(
+        (_nat.verify(pubs[i].tobytes(), msgs[i, :int(lens[i])].tobytes(),
+                     sigs[i].tobytes()) for i in range(k)), bool, k)
+    lanes.inc(k, route="cpu")
+    return bool(oks.all()), oks
+
+
 _PROBE_RESULT: list | None = None    # [bool] once probed: accel usable?
 _PROBE_LOCK = None                   # created lazily (threading.Lock)
 

@@ -692,9 +692,12 @@ int ed25519_verify(const u8* pub, const u8* sig, const u8* msg,
 // RLC batch verification: 1 iff EVERY signature is ZIP-215-valid (with
 // probability 1 - 2^-127 over the z_i; callers fall back to per-signature
 // verification on 0 to localize failures, like the reference's voi path).
-// msgs is the concatenation of all messages; msg_lens[i] their lengths.
+// msgs holds all messages: packed back-to-back when msg_stride == 0, or
+// as fixed-stride rows (the dense fast path hands its row matrix
+// directly, no repacking) otherwise; msg_lens[i] are the true lengths.
 int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
-                         const u64* msg_lens, u64 n, const u8* seed32) {
+                         const u64* msg_lens, u64 n, const u8* seed32,
+                         u64 msg_stride) {
     if (n == 0) return 0;
     std::vector<ge> points;
     std::vector<sc> scalars;
@@ -711,7 +714,8 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
         if (!ge_decompress_zip215(A, pub)) return 0;
         if (!ge_decompress_zip215(R, sig)) return 0;
         sc h;
-        hash_ram(h, sig, pub, msgs + msg_off, msg_lens[i]);
+        const u8* msg = msg_stride ? msgs + i * msg_stride : msgs + msg_off;
+        hash_ram(h, sig, pub, msg, msg_lens[i]);
         msg_off += msg_lens[i];
         // z_i: 128 bits from SHA-512(seed || i), forced odd (nonzero)
         Sha512 zc;
@@ -747,6 +751,73 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
     ge_double(T, T);
     ge_double(T, T);
     return ge_is_identity(T) ? 1 : 0;
+}
+
+}  // extern "C"
+
+// --------------------------------------------- canonical vote sign bytes
+// The native encoder SURVEY §2.9-4 mandates for the VerifyCommit latency
+// path: assembles the N sign-bytes rows of one commit (they differ only
+// in timestamp and commit-vs-nil prefix) into a dense (n, row_stride)
+// matrix the batch verifier and the TPU kernel consume directly.
+// Byte-exact with cometbft_tpu/types/canonical.py (tested against it).
+
+static inline u64 put_varint(u8* out, u64 v) {
+    u64 i = 0;
+    while (v >= 0x80) { out[i++] = (u8)(v | 0x80); v >>= 7; }
+    out[i++] = (u8)v;
+    return i;
+}
+
+extern "C" {
+
+// flags[i] == 2 (commit) selects pre_commit, anything else pre_nil.
+// Each row = varint(body_len) || pre || ts_field || post, zero-padded to
+// row_stride; lens[i] receives the true length.  Returns 0 on success or
+// the required stride when row_stride is too small (nothing written).
+u64 build_vote_sign_bytes(const u8* pre_commit, u64 pre_commit_len,
+                          const u8* pre_nil, u64 pre_nil_len,
+                          const u8* post, u64 post_len,
+                          const int64_t* ts_ns, const u8* flags, u64 n,
+                          u8* out, u64 row_stride, u64* lens) {
+    // worst-case timestamp field: tag(1) + len(1) + [tag+varint(10)] +
+    // [tag+varint(5)] = 19 bytes; worst-case body-length prefix: 5
+    u64 maxpre = pre_commit_len > pre_nil_len ? pre_commit_len : pre_nil_len;
+    u64 need = 5 + maxpre + 19 + post_len;
+    if (need > row_stride) return need;
+    for (u64 i = 0; i < n; i++) {
+        // Timestamp{seconds, nanos} with floor division (python divmod)
+        int64_t ns = ts_ns[i];
+        int64_t secs = ns / 1000000000;
+        int64_t nanos = ns % 1000000000;
+        if (nanos < 0) { nanos += 1000000000; secs -= 1; }
+        u8 tsf[19];
+        u64 tl = 0;
+        if (secs != 0) {               // field 1 varint, omitted when 0
+            tsf[tl++] = 0x08;
+            tl += put_varint(tsf + tl, (u64)secs);
+        }
+        if (nanos != 0) {              // field 2 varint, omitted when 0
+            tsf[tl++] = 0x10;
+            tl += put_varint(tsf + tl, (u64)nanos);
+        }
+        const u8* pre = (flags[i] == 2) ? pre_commit : pre_nil;
+        u64 pre_len = (flags[i] == 2) ? pre_commit_len : pre_nil_len;
+        u64 body_len = pre_len + 2 + tl + post_len;
+        u8* row = out + i * row_stride;
+        u64 off = put_varint(row, body_len);
+        memcpy(row + off, pre, pre_len);
+        off += pre_len;
+        row[off++] = 0x2a;             // field 5, wire type 2 (always emitted)
+        row[off++] = (u8)tl;           // ts submessage length (<= 17)
+        memcpy(row + off, tsf, tl);
+        off += tl;
+        memcpy(row + off, post, post_len);
+        off += post_len;
+        memset(row + off, 0, row_stride - off);
+        lens[i] = off;
+    }
+    return 0;
 }
 
 }  // extern "C"

@@ -86,15 +86,68 @@ class Commit:
         verifies.  Uses a per-commit template encoder (only the timestamp
         and the commit-vs-nil block id vary between a commit's sigs)."""
         cs = self.signatures[idx]
-        is_commit = cs.block_id_flag == BLOCK_ID_FLAG_COMMIT
+        enc = self._sb_encoder(chain_id,
+                               cs.block_id_flag == BLOCK_ID_FLAG_COMMIT)
+        return enc.sign_bytes(cs.timestamp_ns)
+
+    def __deepcopy__(self, memo):
+        # derived caches (_dense_cols, _sb_encoders) must not survive a
+        # copy: the copy's signatures are routinely mutated (tests,
+        # evidence construction) and stale columns would verify the OLD
+        # bytes
+        import copy as _copy
+
+        return Commit(self.height, self.round,
+                      _copy.deepcopy(self.block_id, memo),
+                      _copy.deepcopy(self.signatures, memo))
+
+    def dense_columns(self):
+        """Columnar view for the dense VerifyCommit fast path: ``(flags
+        uint8 (N,), timestamps int64 (N,), sigs uint8 (N,64))``, cached on
+        the commit (commits are immutable once decoded).  Returns None
+        when any non-absent signature isn't 64 bytes — the dense path
+        doesn't apply and callers use the per-lane loop."""
+        cols = self.__dict__.get("_dense_cols", False)
+        if cols is not False:
+            return cols
+        import numpy as np
+
+        sigs = self.signatures
+        n = len(sigs)
+        flags = np.fromiter((cs.block_id_flag for cs in sigs), np.uint8, n)
+        ts = np.fromiter((cs.timestamp_ns for cs in sigs), np.int64, n)
+        buf = bytearray(n * 64)
+        cols = None
+        for i, cs in enumerate(sigs):
+            if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+                continue
+            if len(cs.signature) != 64:
+                break
+            buf[i * 64:(i + 1) * 64] = cs.signature
+        else:
+            sigmat = np.frombuffer(bytes(buf), np.uint8).reshape(n, 64) \
+                if n else np.zeros((0, 64), np.uint8)
+            cols = (flags, ts, sigmat)
+        self.__dict__["_dense_cols"] = cols
+        return cols
+
+    def sign_bytes_templates(self, chain_id: str):
+        """(pre_commit, pre_nil, post) body fragments for the native
+        sign-bytes builder: everything except the timestamp field, for
+        both the commit-BlockID and nil variants."""
+        enc_c = self._sb_encoder(chain_id, True)
+        enc_n = self._sb_encoder(chain_id, False)
+        return enc_c._prefix, enc_n._prefix, enc_c._suffix
+
+    def _sb_encoder(self, chain_id: str, is_commit: bool):
         cache = self.__dict__.setdefault("_sb_encoders", {})
         enc = cache.get((chain_id, is_commit))
         if enc is None:
+            bid = self.block_id if is_commit else BlockID()
             enc = canonical.CanonicalVoteEncoder(
-                chain_id, PRECOMMIT_TYPE, self.height, self.round,
-                cs.block_id(self.block_id))
+                chain_id, PRECOMMIT_TYPE, self.height, self.round, bid)
             cache[(chain_id, is_commit)] = enc
-        return enc.sign_bytes(cs.timestamp_ns)
+        return enc
 
     def to_vote(self, idx: int) -> Vote:
         cs = self.signatures[idx]

@@ -79,6 +79,11 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     count_all=False allows early exit once the tally clears the threshold
     (remaining signatures are NOT verified — VerifyCommitLight semantics).
     """
+    if not lookup_by_address and _dense_verify(
+            chain_id, vals, commit, voting_power_needed,
+            count_all=count_all, verify_nil_sigs=verify_nil_sigs,
+            backend=backend or _DEFAULT_BACKEND):
+        return
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
     lanes: list[int] = []          # commit-sig indices added to the batch
     tally = 0
@@ -115,6 +120,95 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     if tally <= voting_power_needed:
         raise ErrNotEnoughVotingPower(
             f"tallied {tally} <= needed {voting_power_needed}")
+
+
+def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
+                  needed: int, *, count_all: bool, verify_nil_sigs: bool,
+                  backend: str) -> bool:
+    """Vectorized VerifyCommit core: columnar valset/commit views + the
+    native sign-bytes builder + one dense batch dispatch.  At 10k
+    validators this cuts the host side from ~60 ms of per-lane Python to
+    ~3 ms (the BASELINE <5 ms p50 headline needs the host share small).
+
+    Returns True when it fully handled verification (raising on bad sigs
+    or insufficient power), False when not applicable — mixed key types,
+    odd signature sizes, or no native encoder — and the caller runs the
+    per-lane loop.  Semantics mirror the loop exactly, including Light's
+    early exit after the lane that clears the threshold."""
+    import numpy as np
+
+    from ..crypto import _native_ed25519 as nat
+
+    if not count_all and verify_nil_sigs:
+        # no caller uses this combination; the early-exit cumsum below
+        # would count nil-vote power toward the threshold (the loop only
+        # tallies commit lanes) — refuse rather than miscount
+        return False
+    dense = vals.dense()
+    cols = commit.dense_columns()
+    if dense is None or cols is None or not nat.available():
+        return False
+    pubs, powers = dense
+    flags, ts, sigmat = cols
+    if len(flags) != len(powers):
+        return False                   # size mismatch: let the loop raise
+    from .commit import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
+
+    commit_mask = flags == BLOCK_ID_FLAG_COMMIT
+    if count_all:
+        if verify_nil_sigs:
+            scope = np.nonzero(flags != BLOCK_ID_FLAG_ABSENT)[0]
+        else:
+            scope = np.nonzero(commit_mask)[0]
+        tally = int(powers[scope][commit_mask[scope]].sum()) if scope.size \
+            else 0
+    else:
+        scope, tally = _dense_light_scope(powers, flags, needed)
+    if scope.size:
+        built = _dense_build_rows(chain_id, commit, ts, flags, scope)
+        if built is None:
+            return False
+        msgs, lens = built
+        res = cryptobatch.verify_dense(
+            backend, np.ascontiguousarray(pubs[scope]),
+            np.ascontiguousarray(sigmat[scope]), msgs, lens)
+        if res is None:
+            return False
+        ok, oks = res
+        if not ok:
+            raise ErrInvalidSignature(int(scope[np.nonzero(~oks)[0][0]]))
+    if tally <= needed:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tally} <= needed {needed}")
+    return True
+
+
+def _dense_light_scope(powers, flags, needed):
+    """VerifyCommitLight lane selection, shared by the single-commit and
+    cross-block dense paths so the consensus-critical early-exit math
+    lives in exactly one place: commit-flag lanes up to AND including the
+    lane whose power pushes the tally past ``needed`` (the loop breaks
+    after adding that lane).  Returns ``(scope indices, tally)``."""
+    import numpy as np
+
+    from .commit import BLOCK_ID_FLAG_COMMIT
+
+    scope = np.nonzero(flags == BLOCK_ID_FLAG_COMMIT)[0]
+    cum = np.cumsum(powers[scope]) if scope.size else np.zeros(0)
+    over = np.nonzero(cum > needed)[0]
+    if over.size:
+        return scope[:int(over[0]) + 1], int(cum[int(over[0])])
+    return scope, int(cum[-1]) if cum.size else 0
+
+
+def _dense_build_rows(chain_id: str, commit: Commit, ts, flags, scope):
+    """Native sign-bytes rows for the selected lanes of one commit, or
+    None when the native builder is unavailable."""
+    from ..crypto import _native_ed25519 as nat
+
+    pre_c, pre_n, post = commit.sign_bytes_templates(chain_id)
+    return nat.build_vote_sign_bytes(pre_c, pre_n, post, ts[scope],
+                                     flags[scope])
 
 
 def VerifyCommit(chain_id: str, vals: ValidatorSet, block_id, height: int,
@@ -188,6 +282,10 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
     number of signatures verified.  Raises ErrBatchItemInvalid naming the
     first offending item.
     """
+    n = _dense_verify_commits_batched(chain_id, vals, items,
+                                      backend or _DEFAULT_BACKEND)
+    if n is not None:
+        return n
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
     lanes: list[tuple[int, int]] = []      # (item idx, commit-sig idx)
     needed = vals.total_voting_power() * 2 // 3
@@ -217,6 +315,68 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
             k, idx = lanes[oks.index(False)]
             raise ErrBatchItemInvalid(k, items[k][1],
                                       ErrInvalidSignature(idx))
+    return len(lanes)
+
+
+def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
+                                  items: list, backend: str) -> int | None:
+    """Vectorized core of :func:`verify_commits_light_batched`: per-commit
+    basics/tally checks in item order (matching the loop's raise order),
+    then ONE dense verification over every selected lane of every commit.
+    Returns the lane count, or None when not applicable (caller loops)."""
+    import numpy as np
+
+    from ..crypto import _native_ed25519 as nat
+
+    dense = vals.dense()
+    if dense is None or not nat.available():
+        return None
+    pubs, powers = dense
+    needed = vals.total_voting_power() * 2 // 3
+    sel_pubs, sel_sigs, sel_msgs, sel_lens = [], [], [], []
+    lanes: list[tuple[int, int]] = []
+    stride = 0
+    for k, (block_id, height, commit) in enumerate(items):
+        try:
+            _check_commit_basics(vals, commit, height, block_id)
+        except CommitVerificationError as e:
+            raise ErrBatchItemInvalid(k, height, e) from e
+        cols = commit.dense_columns()
+        if cols is None:
+            return None
+        flags, ts, sigmat = cols
+        scope, tally = _dense_light_scope(powers, flags, needed)
+        if tally <= needed:
+            raise ErrBatchItemInvalid(
+                k, height,
+                ErrNotEnoughVotingPower(f"tallied {tally} <= {needed}"))
+        built = _dense_build_rows(chain_id, commit, ts, flags, scope)
+        if built is None:
+            return None
+        msgs, lens = built
+        sel_pubs.append(pubs[scope])
+        sel_sigs.append(sigmat[scope])
+        sel_msgs.append(msgs)
+        sel_lens.append(lens)
+        stride = max(stride, msgs.shape[1])
+        lanes.extend((k, int(i)) for i in scope)
+    if not lanes:
+        return 0
+    # strides are equal in practice (same chain_id; fixed-width height);
+    # pad defensively if a template ever differs
+    sel_msgs = [m if m.shape[1] == stride else np.pad(
+        m, ((0, 0), (0, stride - m.shape[1]))) for m in sel_msgs]
+    res = cryptobatch.verify_dense(
+        backend, np.ascontiguousarray(np.concatenate(sel_pubs)),
+        np.ascontiguousarray(np.concatenate(sel_sigs)),
+        np.ascontiguousarray(np.concatenate(sel_msgs)),
+        np.concatenate(sel_lens))
+    if res is None:
+        return None
+    ok, oks = res
+    if not ok:
+        k, idx = lanes[int(np.nonzero(~oks)[0][0])]
+        raise ErrBatchItemInvalid(k, items[k][1], ErrInvalidSignature(idx))
     return len(lanes)
 
 

@@ -115,6 +115,31 @@ class ValidatorSet:
             return self.validators[idx]
         return None
 
+    def dense(self):
+        """Cached columnar view for the dense VerifyCommit fast path:
+        ``(pubkeys uint8 (N,32), powers int64 (N,))`` — or None when any
+        validator key isn't ed25519 (mixed sets use the per-lane loop).
+        Invalidated by :meth:`update_with_change_set`; validator sets are
+        otherwise immutable in membership and power."""
+        d = self.__dict__.get("_dense", False)
+        if d is not False:
+            return d
+        import numpy as np
+
+        n = len(self.validators)
+        d = None
+        if n and all(v.pub_key.type() == "ed25519"
+                     and len(v.pub_key.bytes()) == 32
+                     for v in self.validators):
+            pubs = np.frombuffer(
+                b"".join(v.pub_key.bytes() for v in self.validators),
+                np.uint8).reshape(n, 32)
+            powers = np.fromiter((v.voting_power for v in self.validators),
+                                 np.int64, n)
+            d = (pubs, powers)
+        self.__dict__["_dense"] = d
+        return d
+
     def has_address(self, addr: bytes) -> bool:
         return self.get_by_address(addr)[0] >= 0
 
@@ -239,6 +264,7 @@ class ValidatorSet:
 
         self.validators = sorted(cur.values(), key=lambda v: v.address)
         self._total = None
+        self.__dict__.pop("_dense", None)     # membership/powers changed
         self.total_voting_power()
         self._rescale_priorities(
             PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())

@@ -1,0 +1,134 @@
+"""Dense VerifyCommit fast path: exact behavioral parity with the
+per-lane loop (types/validation._verify), including Light's early exit,
+nil/absent handling, and failure localization."""
+
+import copy
+
+import pytest
+
+from cometbft_tpu.testing import make_light_chain
+from cometbft_tpu.types import validation as V
+from cometbft_tpu.types.commit import (BLOCK_ID_FLAG_ABSENT,
+                                       BLOCK_ID_FLAG_COMMIT,
+                                       BLOCK_ID_FLAG_NIL)
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return make_light_chain(1, n_vals=40)[0]
+
+
+def outcomes(fn, *args, **kw):
+    """(type(exc) | None, exc.idx if any) for comparing the two paths."""
+    try:
+        fn(*args, **kw)
+        return None, None
+    except V.CommitVerificationError as e:
+        return type(e), getattr(e, "idx", None)
+
+
+def both_paths(monkeypatch, fn, chain_id, vals, commit, lb):
+    fast = outcomes(fn, chain_id, vals, commit.block_id, lb.height, commit,
+                    backend="cpu")
+    monkeypatch.setattr(V, "_dense_verify", lambda *a, **k: False)
+    slow = outcomes(fn, chain_id, vals, commit.block_id, lb.height, commit,
+                    backend="cpu")
+    monkeypatch.undo()
+    return fast, slow
+
+
+@pytest.mark.parametrize("fn", [V.VerifyCommit, V.VerifyCommitLight,
+                                V.VerifyCommitLightAllSignatures])
+def test_parity_valid_commit(monkeypatch, chain, fn):
+    fast, slow = both_paths(monkeypatch, fn, "light-chain",
+                            chain.validators, chain.commit, chain)
+    assert fast == slow == (None, None)
+
+
+@pytest.mark.parametrize("fn", [V.VerifyCommit, V.VerifyCommitLight,
+                                V.VerifyCommitLightAllSignatures])
+@pytest.mark.parametrize("bad_idx", [0, 17, 39])
+def test_parity_bad_signature(monkeypatch, chain, fn, bad_idx):
+    c = copy.deepcopy(chain.commit)
+    c.signatures[bad_idx].signature = bytes(64)
+    fast, slow = both_paths(monkeypatch, fn, "light-chain",
+                            chain.validators, c, chain)
+    assert fast == slow
+    # early-exit variants may or may not reach the lane; when they raise,
+    # both must name the same lane
+    if fast[0] is not None:
+        assert fast[0] is V.ErrInvalidSignature and fast[1] == bad_idx
+
+
+def test_parity_nil_and_absent_lanes(monkeypatch, chain):
+    c = copy.deepcopy(chain.commit)
+    # nil-ify some lanes (their sigs no longer match -> VerifyCommit,
+    # which checks nil sigs, must fail; Light skips them)
+    for i in (3, 5):
+        c.signatures[i].block_id_flag = BLOCK_ID_FLAG_NIL
+    for i in (7,):
+        c.signatures[i].block_id_flag = BLOCK_ID_FLAG_ABSENT
+        c.signatures[i].signature = b""
+        c.signatures[i].validator_address = b""
+    for fn in (V.VerifyCommit, V.VerifyCommitLight,
+               V.VerifyCommitLightAllSignatures):
+        fast, slow = both_paths(monkeypatch, fn, "light-chain",
+                                chain.validators, c, chain)
+        assert fast == slow, fn.__name__
+    # VerifyCommit must reject (nil lanes signed the commit block id, so
+    # their sigs don't verify against the nil-variant sign bytes)
+    assert outcomes(V.VerifyCommit, "light-chain", chain.validators,
+                    c.block_id, chain.height, c,
+                    backend="cpu")[0] is V.ErrInvalidSignature
+
+
+def test_light_early_exit_skips_trailing_bad_sig(monkeypatch, chain):
+    """A bad signature in the last lane is never verified by Light once
+    2/3 is already tallied — on BOTH paths."""
+    c = copy.deepcopy(chain.commit)
+    c.signatures[-1].signature = bytes(64)
+    fast, slow = both_paths(monkeypatch, V.VerifyCommitLight, "light-chain",
+                            chain.validators, c, chain)
+    assert fast == slow == (None, None)
+    # the all-signatures variant does verify it
+    fast, slow = both_paths(monkeypatch, V.VerifyCommitLightAllSignatures,
+                            "light-chain", chain.validators, c, chain)
+    assert fast == slow and fast[0] is V.ErrInvalidSignature
+
+
+def test_not_enough_power_parity(monkeypatch, chain):
+    c = copy.deepcopy(chain.commit)
+    for cs in c.signatures[: len(c.signatures) * 2 // 3 + 1]:
+        cs.block_id_flag = BLOCK_ID_FLAG_ABSENT
+        cs.signature = b""
+        cs.validator_address = b""
+    for fn in (V.VerifyCommit, V.VerifyCommitLight):
+        fast, slow = both_paths(monkeypatch, fn, "light-chain",
+                                chain.validators, c, chain)
+        assert fast == slow and fast[0] is V.ErrNotEnoughVotingPower
+
+
+def test_dense_cache_invalidation():
+    from cometbft_tpu.types.validator_set import Validator
+
+    lb = make_light_chain(1, n_vals=8)[0]
+    vals = lb.validators.copy()
+    d1 = vals.dense()
+    assert d1 is not None and d1[0].shape == (8, 32)
+    grown = vals.validators[0].copy()
+    grown.voting_power += 5
+    vals.update_with_change_set([grown])
+    d2 = vals.dense()
+    assert d2 is not None
+    assert d2[1][[v.address for v in vals.validators].index(
+        grown.address)] == grown.voting_power
+
+
+def test_dense_not_applicable_odd_sig_size(monkeypatch, chain):
+    """A 63-byte signature disables the dense path; outcomes still match."""
+    c = copy.deepcopy(chain.commit)
+    c.signatures[2].signature = c.signatures[2].signature[:63]
+    assert c.dense_columns() is None
+    fast, slow = both_paths(monkeypatch, V.VerifyCommit, "light-chain",
+                            chain.validators, c, chain)
+    assert fast == slow and fast[0] is V.ErrInvalidSignature
