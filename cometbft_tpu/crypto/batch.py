@@ -150,6 +150,52 @@ def _compiled_verify():
     return jax.jit(_kernel.verify_padded)
 
 
+@functools.cache
+def _compiled_verify_sharded(devices: tuple):
+    """Kernel jitted over a 1-D mesh of ``devices`` with every argument
+    sharded on the lane axis (SURVEY §2.10: verification is data-parallel
+    over lanes, so the step is collective-free and scales linearly over
+    ICI).  Cached per device tuple; jit's cache handles shapes."""
+    from ..jaxenv import enable_compile_cache, harden_cpu_pinned_env
+    from ..parallel.mesh import batch_mesh, sharded_verify_fn
+
+    harden_cpu_pinned_env()
+    try:
+        enable_compile_cache()
+    except Exception:
+        pass
+    return sharded_verify_fn(batch_mesh(list(devices)))
+
+
+_DEVICES: tuple | None = None    # explicit multi-device set (config hook)
+
+
+def set_devices(devices) -> None:
+    """Config/multihost hook: shard every device batch over these devices
+    (None or a single device restores single-chip dispatch).  The node
+    wires this from config; ``dryrun_multichip`` uses it so the driver
+    artifact exercises the production sharded path."""
+    global _DEVICES
+    _DEVICES = tuple(devices) if devices else None
+
+
+def _resolve_devices(device) -> tuple:
+    """Devices a batch should run on: an explicit single device wins,
+    then the configured set, else all visible accelerator chips (so a
+    multi-chip host shards automatically).  Empty tuple = jit default."""
+    if device is not None:
+        return (device,)
+    if _DEVICES is not None:
+        return _DEVICES
+    try:
+        import jax
+
+        accels = tuple(d for d in jax.devices() if d.platform != "cpu")
+        return accels if len(accels) > 1 else ()
+    except Exception:
+        return ()
+
+
 def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                   device=None) -> int:
     """Pre-compile the verify kernel for the hot bucket shapes so the
@@ -202,7 +248,13 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     from ..ops import sha512 as _sha
 
     b = pubs.shape[0]
+    devices = _resolve_devices(device)
     bb = _bucket(b, _LANE_BUCKETS)
+    if len(devices) > 1:
+        # each chip takes an equal contiguous slab of lanes: the bucket
+        # must divide evenly (power-of-two buckets already divide
+        # power-of-two meshes; round up for odd mesh sizes)
+        bb += (-bb) % len(devices)
     # hash input is R || A || M
     hin = np.zeros((bb, 64 + msgs.shape[1]), np.uint8)
     hin[:b, :32] = rs
@@ -221,11 +273,21 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     hin[b:] = hin[0]
     lens[b:] = lens[0]
     blocks, active = _sha.host_pad(hin, lens, nb)
-    fn = _compiled_verify()
     args = (pad(pubs, 32), pad(rs, 32), pad(ss, 32), blocks, active)
-    if device is not None:
+    if len(devices) > 1:
+        # production multi-chip path: lane-sharded jit over the device
+        # mesh; the in_shardings spec moves each slab to its chip
+        fn = _compiled_verify_sharded(devices)
+        return np.asarray(fn(*args))[:b]
+    fn = _compiled_verify()
+    # single-chip placement: the caller's pin wins, else a configured
+    # 1-device set (set_devices must actually pin THAT chip), else the
+    # jit default device
+    place = device if device is not None else (
+        devices[0] if devices else None)
+    if place is not None:
         import jax
-        args = jax.device_put(args, device)
+        args = jax.device_put(args, place)
     return np.asarray(fn(*args))[:b]
 
 
@@ -381,6 +443,26 @@ class TpuBatchVerifier(BatchVerifier):
         return all(oks), oks
 
 
+def _backend_wants_device(backend: str, device) -> bool:
+    """Shared backend dispatch for the object and dense paths: should
+    this batch attempt the device route?  Under "auto" with no probe
+    verdict yet, kicks off the background probe and answers False (the
+    batch serves from host so consensus never blocks on discovery).
+    Raises ValueError on unknown backend names — misconfigurations must
+    surface identically on every path."""
+    if backend in ("tpu", "jax"):
+        return True
+    if backend == "cpu":
+        return False
+    if backend != "auto":
+        raise ValueError(f"unknown batch-verifier backend {backend!r}")
+    if device is None and _PROBE_RESULT is None:
+        _start_probe_background()
+        return False
+    dev = device if device is not None else _accelerator_device()
+    return dev is not None and getattr(dev, "platform", "cpu") != "cpu"
+
+
 def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None):
     """Dense-array verification behind the same backend dispatch as
     :func:`create_batch_verifier`: ``pubs`` (k,32) u8, ``sigs`` (k,64) u8,
@@ -399,17 +481,8 @@ def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None):
     if k == 0:
         return True, np.zeros((0,), bool)
     _, lanes, _ = _metrics()
-    want_device = backend in ("tpu", "jax")
-    if backend == "auto":
-        if device is None and _PROBE_RESULT is None:
-            _start_probe_background()      # serve this batch from host
-        else:
-            dev = device if device is not None else _accelerator_device()
-            want_device = (dev is not None
-                           and getattr(dev, "platform", "cpu") != "cpu")
-            if want_device:
-                device = dev
-    if want_device and k >= TpuBatchVerifier.MIN_DEVICE_LANES:
+    if _backend_wants_device(backend, device) \
+            and k >= TpuBatchVerifier.MIN_DEVICE_LANES:
         out = _device_call(lambda: device_verify_ed25519(
             pubs, np.ascontiguousarray(sigs[:, :32]),
             np.ascontiguousarray(sigs[:, 32:]), msgs, lens, device))
@@ -545,20 +618,9 @@ def create_batch_verifier(backend: str = "auto",
     backend: "auto" | "tpu" | "jax" | "cpu".  The small-batch CPU
     threshold is process-wide via :func:`set_min_device_lanes`.
     """
-    if backend == "cpu":
-        return CpuBatchVerifier()
-    if backend in ("tpu", "jax"):
+    # device=None on the device backends lets the dispatch shard over
+    # ALL visible chips (SURVEY §2.10 — multi-chip in the production hot
+    # path); a caller-pinned device restores single-chip dispatch
+    if _backend_wants_device(backend, device):
         return TpuBatchVerifier(device)
-    if backend == "auto":
-        if device is None and _PROBE_RESULT is None:
-            # first auto-selection: the device probe can take seconds
-            # (subprocess, 15s worst case on a wedged relay) — run it in
-            # the background and serve this batch from host crypto so a
-            # node's consensus loop never blocks on backend discovery
-            _start_probe_background()
-            return CpuBatchVerifier()
-        dev = device if device is not None else _accelerator_device()
-        if dev is not None and getattr(dev, "platform", "cpu") != "cpu":
-            return TpuBatchVerifier(dev)
-        return CpuBatchVerifier()
-    raise ValueError(f"unknown batch-verifier backend {backend!r}")
+    return CpuBatchVerifier()

@@ -114,8 +114,17 @@ class Commit:
 
         sigs = self.signatures
         n = len(sigs)
-        flags = np.fromiter((cs.block_id_flag for cs in sigs), np.uint8, n)
-        ts = np.fromiter((cs.timestamp_ns for cs in sigs), np.int64, n)
+        try:
+            # peer-supplied ints can exceed uint8/int64 (the codec does
+            # not bound them); the loop path handles such commits, so
+            # out-of-range values mean "dense not applicable", not a
+            # crash a malicious block could use to kill blocksync
+            flags = np.fromiter((cs.block_id_flag for cs in sigs),
+                                np.uint8, n)
+            ts = np.fromiter((cs.timestamp_ns for cs in sigs), np.int64, n)
+        except (OverflowError, ValueError, TypeError):
+            self.__dict__["_dense_cols"] = None
+            return None
         buf = bytearray(n * 64)
         cols = None
         for i, cs in enumerate(sigs):

