@@ -139,14 +139,9 @@ def _compiled_verify():
     not once per process (VERDICT r1 weak-point 5)."""
     import jax
 
-    from ..jaxenv import enable_compile_cache, harden_cpu_pinned_env
     from ..ops import ed25519 as _kernel
 
-    harden_cpu_pinned_env()
-    try:
-        enable_compile_cache()
-    except Exception:
-        pass                 # cache dir unwritable: compile-only, still works
+    _jit_env()
     return jax.jit(_kernel.verify_padded)
 
 
@@ -156,15 +151,130 @@ def _compiled_verify_sharded(devices: tuple):
     sharded on the lane axis (SURVEY §2.10: verification is data-parallel
     over lanes, so the step is collective-free and scales linearly over
     ICI).  Cached per device tuple; jit's cache handles shapes."""
-    from ..jaxenv import enable_compile_cache, harden_cpu_pinned_env
     from ..parallel.mesh import batch_mesh, sharded_verify_fn
+
+    _jit_env()
+    return sharded_verify_fn(batch_mesh(list(devices)))
+
+
+def _jit_env():
+    """Every jit entry point must harden a CPU-pinned process against
+    the wedgeable accelerator factory AND enable the persistent XLA
+    cache (VERDICT r1 weak-point 5) before first backend init."""
+    from ..jaxenv import enable_compile_cache, harden_cpu_pinned_env
 
     harden_cpu_pinned_env()
     try:
         enable_compile_cache()
     except Exception:
-        pass
-    return sharded_verify_fn(batch_mesh(list(devices)))
+        pass                 # cache dir unwritable: compile-only
+
+
+@functools.cache
+def _compiled_prepare_tables():
+    import jax
+
+    from ..ops import ed25519 as _kernel
+
+    _jit_env()
+    return jax.jit(_kernel.prepare_pubkey_tables)
+
+
+@functools.cache
+def _compiled_verify_gather(devices: tuple):
+    """jit of the cached-table verify: the whole-valset table is
+    replicated (every chip gathers its own lanes' rows), the per-lane
+    args shard on the lane axis.  devices=() compiles for the default
+    single device."""
+    import jax
+
+    from ..ops import ed25519 as _kernel
+
+    _jit_env()
+    if len(devices) <= 1:
+        return jax.jit(_kernel.verify_padded_gather)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import batch_mesh
+
+    mesh = batch_mesh(list(devices))
+    lane = NamedSharding(mesh, P("batch"))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        _kernel.verify_padded_gather,
+        in_shardings=(repl, repl, lane, lane, lane, lane, lane),
+        out_shardings=lane)
+
+
+# Whole-validator-set device tables, keyed by the identity of the
+# valset's cached pubkey matrix (regenerated on membership changes, so
+# identity == valset version).  Entries hold a strong ref to the matrix,
+# making id() reuse impossible while cached.
+_VALSET_TABLES: "dict" = {}
+_VALSET_TABLES_MAX = 4
+
+
+def _valset_tables(pubs_full, devices: tuple):
+    """Device [j](-A) tables + ok mask for a full validator set, padded
+    to the lane bucket; cached so consecutive commits from the same set
+    skip decompression and table building on device."""
+    key = (id(pubs_full), devices)
+    ent = _VALSET_TABLES.get(key)
+    if ent is not None and ent[0] is pubs_full:
+        return ent[1], ent[2], ent[3]
+    n = pubs_full.shape[0]
+    nb = _bucket(n, _LANE_BUCKETS)
+    if len(devices) > 1:
+        nb += (-nb) % len(devices)
+    padded = np.zeros((nb, 32), np.int32)
+    padded[:n] = pubs_full
+    padded[n:] = pubs_full[0] if n else 0
+    if len(devices) == 1:
+        # pinned single chip: build the table THERE, not on the default
+        import jax
+
+        padded = jax.device_put(padded, devices[0])
+    tab, ok = _compiled_prepare_tables()(padded)
+    while len(_VALSET_TABLES) >= _VALSET_TABLES_MAX:
+        _VALSET_TABLES.pop(next(iter(_VALSET_TABLES)))
+    _VALSET_TABLES[key] = (pubs_full, tab, ok, nb)
+    return tab, ok, nb
+
+
+def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
+                                 msgs, msg_lens, device=None) -> np.ndarray:
+    """Dense verify through the per-valset table cache: like
+    :func:`device_verify_ed25519` but A decompression + table building
+    happen once per validator set, not once per batch.  ``scope`` (B,)
+    are validator indices into ``valset_pubs``; ``pubs_rows`` (B,32) are
+    the gathered pubkey bytes (still needed for the R||A||M hash)."""
+    b = pubs_rows.shape[0]
+    if b == 0:
+        return np.zeros((0,), bool)
+    devices = _resolve_devices(device)
+    tab, ok, n_pad = _valset_tables(valset_pubs, devices)
+    place = _single_device_place(device, devices)
+    results = np.zeros((b,), bool)
+    cap = _LANE_BUCKETS[-1]
+    for start in range(0, b, cap):
+        end = min(start + cap, b)
+        c = end - start
+        sl = slice(start, end)
+        bb = _chunk_bucket(c, devices)
+        _, r32, s32, blocks, active = _padded_lane_args(
+            pubs_rows[sl], rs[sl], ss[sl], msgs[sl], msg_lens[sl], bb)
+        idx = np.zeros((bb,), np.int32)
+        idx[:c] = np.asarray(scope[sl], np.int32)
+        idx[c:] = idx[0]
+        lane_args = (idx, r32, s32, blocks, active)
+        if place is not None:
+            import jax
+
+            lane_args = jax.device_put(lane_args, place)
+        fn = _compiled_verify_gather(devices)
+        out = fn(tab, ok, *lane_args)
+        results[start:end] = np.asarray(out)[:c]
+    return results
 
 
 _DEVICES: tuple | None = None    # explicit multi-device set (config hook)
@@ -198,10 +308,12 @@ def _resolve_devices(device) -> tuple:
 
 def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                   device=None) -> int:
-    """Pre-compile the verify kernel for the hot bucket shapes so the
-    first real commit verification doesn't stall consensus for an XLA
-    compile (node startup warmup; shapes beyond these hit the persistent
-    cache or compile on demand).  Returns the number of shapes compiled."""
+    """Pre-compile BOTH verify kernels (plain and cached-table gather —
+    the dense VerifyCommit path uses the latter) for the hot bucket
+    shapes so the first real commit verification doesn't stall consensus
+    for an XLA compile (node startup warmup; shapes beyond these hit the
+    persistent cache or compile on demand).  Returns the number of
+    shapes compiled."""
     import numpy as np
 
     done = 0
@@ -214,11 +326,15 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
             msg_len = nb * 128 - 64 - 17
             msgs = np.zeros((lanes, msg_len), np.uint8)
             lens = np.full((lanes,), msg_len, np.int64)
+            scope = np.zeros((lanes,), np.int64)
             try:
                 _device_verify_chunk(pubs, rs, ss, msgs, lens, device)
+                device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
+                                             msgs, lens, device)
                 done += 1
             except Exception:
                 return done
+    _VALSET_TABLES.clear()        # warmup matrices aren't real valsets
     return done
 
 
@@ -244,17 +360,24 @@ def device_verify_ed25519(pubs: np.ndarray, rs: np.ndarray, ss: np.ndarray,
     return results
 
 
-def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
+def _chunk_bucket(b: int, devices: tuple) -> int:
+    """Lane bucket for a chunk: next size bucket, rounded up so each chip
+    of a mesh takes an equal contiguous slab (power-of-two buckets
+    already divide power-of-two meshes; round up for odd sizes)."""
+    bb = _bucket(b, _LANE_BUCKETS)
+    if len(devices) > 1:
+        bb += (-bb) % len(devices)
+    return bb
+
+
+def _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb):
+    """The lane/block padding protocol shared by the cached and uncached
+    device routes: R||A||M hash-input assembly, lens padding, block
+    bucketing, repeat-lane-0 fill, int32 byte matrices.  Returns
+    ``(pub32, r32, s32, blocks, active)``."""
     from ..ops import sha512 as _sha
 
     b = pubs.shape[0]
-    devices = _resolve_devices(device)
-    bb = _bucket(b, _LANE_BUCKETS)
-    if len(devices) > 1:
-        # each chip takes an equal contiguous slab of lanes: the bucket
-        # must divide evenly (power-of-two buckets already divide
-        # power-of-two meshes; round up for odd mesh sizes)
-        bb += (-bb) % len(devices)
     # hash input is R || A || M
     hin = np.zeros((bb, 64 + msgs.shape[1]), np.uint8)
     hin[:b, :32] = rs
@@ -262,29 +385,41 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     hin[:b, 64:] = msgs
     lens = np.full((bb,), 64, np.int64)
     lens[:b] = 64 + np.asarray(msg_lens, np.int64)
+    hin[b:] = hin[0]
+    lens[b:] = lens[0]
     nb = _bucket(int(_sha.max_blocks_for_len(int(lens.max()))), _BLOCK_BUCKETS)
+    blocks, active = _sha.host_pad(hin, lens, nb)
 
-    def pad(a, width):
-        out = np.zeros((bb, width), np.int32)
+    def pad(a):
+        out = np.zeros((bb, 32), np.int32)
         out[:b] = a
         out[b:] = a[0] if b else 0          # repeat lane 0 into padding
         return out
 
-    hin[b:] = hin[0]
-    lens[b:] = lens[0]
-    blocks, active = _sha.host_pad(hin, lens, nb)
-    args = (pad(pubs, 32), pad(rs, 32), pad(ss, 32), blocks, active)
+    return pad(pubs), pad(rs), pad(ss), blocks, active
+
+
+def _single_device_place(device, devices: tuple):
+    """The chip a non-sharded dispatch must pin its arrays to: the
+    caller's pin wins, else a configured 1-device set (set_devices must
+    actually pin THAT chip), else None for the jit default."""
+    if device is not None:
+        return device
+    return devices[0] if len(devices) == 1 else None
+
+
+def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
+    b = pubs.shape[0]
+    devices = _resolve_devices(device)
+    bb = _chunk_bucket(b, devices)
+    args = _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb)
     if len(devices) > 1:
         # production multi-chip path: lane-sharded jit over the device
         # mesh; the in_shardings spec moves each slab to its chip
         fn = _compiled_verify_sharded(devices)
         return np.asarray(fn(*args))[:b]
     fn = _compiled_verify()
-    # single-chip placement: the caller's pin wins, else a configured
-    # 1-device set (set_devices must actually pin THAT chip), else the
-    # jit default device
-    place = device if device is not None else (
-        devices[0] if devices else None)
+    place = _single_device_place(device, devices)
     if place is not None:
         import jax
         args = jax.device_put(args, place)
@@ -463,11 +598,16 @@ def _backend_wants_device(backend: str, device) -> bool:
     return dev is not None and getattr(dev, "platform", "cpu") != "cpu"
 
 
-def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None):
+def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None,
+                 valset_pubs=None, scope=None):
     """Dense-array verification behind the same backend dispatch as
     :func:`create_batch_verifier`: ``pubs`` (k,32) u8, ``sigs`` (k,64) u8,
     ``msgs`` (k,L) u8 zero-padded rows, ``lens`` (k,) int — the matrices
     the native sign-bytes builder emits.  All lanes must be ed25519.
+
+    ``valset_pubs``/``scope`` (optional): the FULL validator-set pubkey
+    matrix plus this batch's validator indices — lets the device route
+    reuse per-valset decompressed-point tables across commits.
 
     Returns ``(all_ok, oks ndarray)``, or None when no dense-capable
     backend exists (no native lib on a CPU box) — the caller falls back
@@ -483,9 +623,14 @@ def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None):
     _, lanes, _ = _metrics()
     if _backend_wants_device(backend, device) \
             and k >= TpuBatchVerifier.MIN_DEVICE_LANES:
-        out = _device_call(lambda: device_verify_ed25519(
-            pubs, np.ascontiguousarray(sigs[:, :32]),
-            np.ascontiguousarray(sigs[:, 32:]), msgs, lens, device))
+        rs = np.ascontiguousarray(sigs[:, :32])
+        ss = np.ascontiguousarray(sigs[:, 32:])
+        if valset_pubs is not None and scope is not None:
+            out = _device_call(lambda: device_verify_ed25519_cached(
+                valset_pubs, scope, pubs, rs, ss, msgs, lens, device))
+        else:
+            out = _device_call(lambda: device_verify_ed25519(
+                pubs, rs, ss, msgs, lens, device))
         if out is not None:
             lanes.inc(k, route="device")
             return bool(out.all()), out

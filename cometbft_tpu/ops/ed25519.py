@@ -28,7 +28,8 @@ from .edwards import (Cached, Ext, Niels, add_cached, add_niels, cache,
                       mul_by_cofactor, neg_ext)
 from ..crypto import _ed25519_py as _ref
 
-__all__ = ["verify_padded", "BASE_NIELS"]
+__all__ = ["verify_padded", "verify_padded_gather",
+           "prepare_pubkey_tables", "BASE_NIELS"]
 
 
 def _base_niels_table() -> np.ndarray:
@@ -78,22 +79,29 @@ def _gather_cached(tab: Cached, digit) -> Cached:
         jnp.take_along_axis(c, idx, axis=-2)[..., 0, :] for c in tab])
 
 
-def verify_padded(pub, rb, sb, blocks, active):
-    """Verify a padded batch of Ed25519 signatures on device.
+def prepare_pubkey_tables(pub):
+    """Per-validator precomputation, cacheable across commits: decompress
+    A and build the 16-entry [j](-A) cached table for every lane.
 
-    pub/rb/sb: (…,32) int32 bytes (pubkey, sig[0:32], sig[32:64]);
-    blocks: (…,NB,32) uint32 prepadded SHA blocks of R||A||M (sha512.host_pad);
-    active: (…,) int32 per-lane active block count.
-    Returns (…,) bool.  Jit per (batch-shape, NB) bucket.
+    pub (N,32) int32 -> (Cached tables stacked on the lane axis, (N,)
+    ok mask).  Validator sets are ~static across heights, so a node
+    verifying consecutive commits re-uses these device arrays and the
+    verify kernel skips decompression + table building entirely
+    (TPU-side analogue of the reference's expanded-pubkey cache,
+    ``crypto/ed25519/ed25519.go:42-67`` — but for whole validator sets).
     """
     a_pt, ok_a = decompress_zip215(pub)
+    return _build_neg_a_table(neg_ext(a_pt)), ok_a
+
+
+def _verify_core(neg_a_tab, ok_a, rb, sb, blocks, active, lane_shape):
+    """Shared Straus ladder over precomputed per-lane [j](-A) tables."""
     r_pt, ok_r = decompress_zip215(rb)
     s_limbs = scalar.bytes32_to_limbs(sb)
     ok_s = scalar.lt_l(s_limbs)
     s_dig = scalar.nibbles(s_limbs)
     h_dig = scalar.nibbles(scalar.reduce512(sha512.sha512_blocks(blocks, active)))
 
-    neg_a_tab = _build_neg_a_table(neg_ext(a_pt))
     base_tab = jnp.asarray(BASE_NIELS)
 
     def window(i, acc):
@@ -107,6 +115,30 @@ def verify_padded(pub, rb, sb, blocks, active):
         acc = add_cached(acc, _gather_cached(neg_a_tab, dh))
         return acc
 
-    acc = jax.lax.fori_loop(0, 64, window, identity(pub.shape[:-1]))
+    acc = jax.lax.fori_loop(0, 64, window, identity(lane_shape))
     acc = add_cached(acc, cache(neg_ext(r_pt)))
     return ok_a & ok_r & ok_s & is_identity(mul_by_cofactor(acc))
+
+
+def verify_padded(pub, rb, sb, blocks, active):
+    """Verify a padded batch of Ed25519 signatures on device.
+
+    pub/rb/sb: (…,32) int32 bytes (pubkey, sig[0:32], sig[32:64]);
+    blocks: (…,NB,32) uint32 prepadded SHA blocks of R||A||M (sha512.host_pad);
+    active: (…,) int32 per-lane active block count.
+    Returns (…,) bool.  Jit per (batch-shape, NB) bucket.
+    """
+    neg_a_tab, ok_a = prepare_pubkey_tables(pub)
+    return _verify_core(neg_a_tab, ok_a, rb, sb, blocks, active,
+                        pub.shape[:-1])
+
+
+def verify_padded_gather(tab, ok_a, idx, rb, sb, blocks, active):
+    """Verify using a CACHED whole-validator-set table: ``tab``/``ok_a``
+    are ``prepare_pubkey_tables`` output for all N validators; ``idx``
+    (B,) int32 selects this batch's lanes (commit scope, padded to the
+    lane bucket).  Skips per-call decompression and table building."""
+    lane_tab = Cached(*[jnp.take(c, idx, axis=0) for c in tab])
+    lane_ok = jnp.take(ok_a, idx, axis=0)
+    return _verify_core(lane_tab, lane_ok, rb, sb, blocks, active,
+                        idx.shape)

@@ -171,7 +171,8 @@ def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
         msgs, lens = built
         res = cryptobatch.verify_dense(
             backend, np.ascontiguousarray(pubs[scope]),
-            np.ascontiguousarray(sigmat[scope]), msgs, lens)
+            np.ascontiguousarray(sigmat[scope]), msgs, lens,
+            valset_pubs=pubs, scope=scope)
         if res is None:
             return False
         ok, oks = res
@@ -334,6 +335,7 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
     pubs, powers = dense
     needed = vals.total_voting_power() * 2 // 3
     sel_pubs, sel_sigs, sel_msgs, sel_lens = [], [], [], []
+    sel_scope = []
     lanes: list[tuple[int, int]] = []
     stride = 0
     for k, (block_id, height, commit) in enumerate(items):
@@ -358,6 +360,7 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
         sel_sigs.append(sigmat[scope])
         sel_msgs.append(msgs)
         sel_lens.append(lens)
+        sel_scope.append(scope)
         stride = max(stride, msgs.shape[1])
         lanes.extend((k, int(i)) for i in scope)
     if not lanes:
@@ -370,7 +373,8 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
         backend, np.ascontiguousarray(np.concatenate(sel_pubs)),
         np.ascontiguousarray(np.concatenate(sel_sigs)),
         np.ascontiguousarray(np.concatenate(sel_msgs)),
-        np.concatenate(sel_lens))
+        np.concatenate(sel_lens),
+        valset_pubs=pubs, scope=np.concatenate(sel_scope))
     if res is None:
         return None
     ok, oks = res

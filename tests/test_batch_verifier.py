@@ -322,3 +322,45 @@ def test_verify_dense_shards_over_mesh(monkeypatch):
     ok, oks = res
     assert ok and oks.all() and len(oks) == 24
     assert calls and len(calls[0]) == 8
+
+
+def test_valset_table_cache_path():
+    """device_verify_ed25519_cached: per-valset [j](-A) tables are built
+    once, reused across batches (cache hit by matrix identity), and give
+    identical verdicts to the uncached kernel — incl. partial scopes
+    (Light early exit) and bad lanes."""
+    import numpy as np
+
+    import cometbft_tpu.crypto.batch as B
+    from cometbft_tpu.testing import dense_signature_batch
+
+    _, host_items = dense_signature_batch(12, msg_len=40, seed=23)
+    pubs = np.frombuffer(b"".join(p for p, _, _ in host_items),
+                         np.uint8).reshape(-1, 32)
+    sigs = np.frombuffer(b"".join(s for _, _, s in host_items),
+                         np.uint8).reshape(-1, 64)
+    msgs = np.zeros((12, 40), np.uint8)
+    lens = np.full((12,), 40, np.int64)
+    for i, (_, m, _) in enumerate(host_items):
+        msgs[i] = np.frombuffer(m, np.uint8)
+    rs = np.ascontiguousarray(sigs[:, :32])
+    ss = np.ascontiguousarray(sigs[:, 32:])
+
+    scope = np.arange(12, dtype=np.int64)
+    B._VALSET_TABLES.clear()
+    out = B.device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
+                                         msgs, lens, None)
+    assert out.all() and len(out) == 12
+    assert len(B._VALSET_TABLES) == 1
+    ref = B.device_verify_ed25519(pubs, rs, ss, msgs, lens, None)
+    assert (out == ref).all()
+
+    # cache hit on a second batch from the same valset, partial scope
+    sub = np.arange(3, 9, dtype=np.int64)
+    bad_ss = ss.copy()
+    bad_ss[5] ^= 1
+    out2 = B.device_verify_ed25519_cached(pubs, sub, pubs[sub], rs[sub],
+                                          bad_ss[sub], msgs[sub],
+                                          lens[sub], None)
+    assert len(B._VALSET_TABLES) == 1      # same entry, no rebuild
+    assert not out2[2] and out2.sum() == 5  # lane 5 == sub position 2
