@@ -317,24 +317,26 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
     import numpy as np
 
     done = 0
-    for lanes in lane_buckets:
-        for nb in block_buckets:
-            pubs = np.zeros((lanes, 32), np.uint8)
-            rs = ss = pubs
-            # longest message that still fits nb SHA-512 blocks after the
-            # 64-byte R||A prefix and 17 bytes of padding
-            msg_len = nb * 128 - 64 - 17
-            msgs = np.zeros((lanes, msg_len), np.uint8)
-            lens = np.full((lanes,), msg_len, np.int64)
-            scope = np.zeros((lanes,), np.int64)
-            try:
-                _device_verify_chunk(pubs, rs, ss, msgs, lens, device)
-                device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
-                                             msgs, lens, device)
-                done += 1
-            except Exception:
-                return done
-    _VALSET_TABLES.clear()        # warmup matrices aren't real valsets
+    try:
+        for lanes in lane_buckets:
+            for nb in block_buckets:
+                pubs = np.zeros((lanes, 32), np.uint8)
+                rs = ss = pubs
+                # longest message that still fits nb SHA-512 blocks after
+                # the 64-byte R||A prefix and 17 bytes of padding
+                msg_len = nb * 128 - 64 - 17
+                msgs = np.zeros((lanes, msg_len), np.uint8)
+                lens = np.full((lanes,), msg_len, np.int64)
+                scope = np.zeros((lanes,), np.int64)
+                try:
+                    _device_verify_chunk(pubs, rs, ss, msgs, lens, device)
+                    device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
+                                                 msgs, lens, device)
+                    done += 1
+                except Exception:
+                    return done
+    finally:
+        _VALSET_TABLES.clear()    # warmup matrices aren't real valsets
     return done
 
 

@@ -24,6 +24,9 @@ class _MempoolTx:
     tx: bytes
     gas_wanted: int
     height: int          # height when first admitted
+    seq: int = 0         # arrival order (assigned BEFORE the app
+    #   round-trip, so concurrent admissions completing out of order
+    #   still reap/gossip in arrival-FIFO order)
 
 
 class TxRejectedError(Exception):
@@ -31,6 +34,75 @@ class TxRejectedError(Exception):
         self.code = code
         self.log = log
         super().__init__(f"tx rejected: code={code} {log}")
+
+
+class _AdmissionGate:
+    """Reader-writer gate for admission vs update.
+
+    Readers are concurrent ``check_tx`` admissions: each spans an app
+    round-trip, and serializing them on one lock lets a single slow
+    CheckTx stall every other admission AND the gossip intake (the
+    reference instead pipelines async CheckTx on a dedicated connection,
+    ``mempool/clist_mempool.go:241``).  The writer is the executor's
+    FinalizeBlock..Commit..update critical section (and flush), which
+    must see no in-flight admissions.  Writer-preferring, so a stream of
+    admissions can never starve block execution.
+
+    Scope note: this removes the MEMPOOL's serialization.  How much
+    actually overlaps depends on the app connection: SocketClient
+    pipelines (futures matched by id), so concurrent admissions overlap
+    transport latency and server queueing; LocalClient serializes on one
+    lock because the ABCI app contract is serial per connection — the
+    same bound the reference's mutex-guarded local client has."""
+
+    def __init__(self):
+        self._readers = 0
+        self._writers_waiting = 0
+        self._writer_active = False
+        self._cond = asyncio.Condition()
+
+    async def acquire_read(self):
+        async with self._cond:
+            while self._writer_active or self._writers_waiting:
+                await self._cond.wait()
+            self._readers += 1
+
+    async def release_read(self):
+        async with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    async def acquire_write(self):
+        async with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer_active or self._readers:
+                    await self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer_active = True
+
+    async def release_write(self):
+        async with self._cond:
+            self._writer_active = False
+            self._cond.notify_all()
+
+    def write_locked(self) -> "_WriteCtx":
+        return _WriteCtx(self)
+
+
+class _WriteCtx:
+    __slots__ = ("_gate",)
+
+    def __init__(self, gate: _AdmissionGate):
+        self._gate = gate
+
+    async def __aenter__(self):
+        await self._gate.acquire_write()
+
+    async def __aexit__(self, *exc):
+        await self._gate.release_write()
 
 
 class CListMempool(Mempool):
@@ -42,8 +114,9 @@ class CListMempool(Mempool):
         self.max_tx_bytes = max_tx_bytes
         self.cache = LRUTxCache(cache_size)
         self.keep_invalid = keep_invalid_txs_in_cache
-        self._txs: dict[bytes, _MempoolTx] = {}      # insertion-ordered FIFO
-        self._lock = asyncio.Lock()
+        self._txs: dict[bytes, _MempoolTx] = {}      # arrival-seq FIFO
+        self._gate = _AdmissionGate()
+        self._arrival = 0                # next arrival sequence number
         self._txs_available = asyncio.Event()
         self._notified_available = False
         # edge callback fired once per height on the first admitted tx
@@ -63,15 +136,27 @@ class CListMempool(Mempool):
         key = TxKey(tx)
         if not self.cache.push(key):
             return                       # seen before (maybe committed)
-        async with self._lock:
+        # reader side of the gate: many admissions run their app
+        # round-trips CONCURRENTLY (one slow CheckTx no longer stalls
+        # every other admission); update/flush take the writer side
+        await self._gate.acquire_read()
+        try:
+            self._arrival += 1
+            seq = self._arrival          # before the await: arrival order
             res = await self.app.check_tx(tx, recheck=False)
             if not res.is_ok:
                 if not self.keep_invalid:
                     self.cache.remove(key)
                 raise TxRejectedError(res.code, res.log)
+            if len(self._txs) >= self.max_txs:
+                self.cache.remove(key)   # full while we were in flight
+                raise TxRejectedError(1, "mempool is full")
             if key not in self._txs:
-                self._txs[key] = _MempoolTx(tx, res.gas_wanted, self.height)
+                self._txs[key] = _MempoolTx(tx, res.gas_wanted,
+                                            self.height, seq)
                 self._notify_available()
+        finally:
+            await self._gate.release_read()
 
     def _notify_available(self):
         if self._txs and not self._notified_available:
@@ -85,10 +170,20 @@ class CListMempool(Mempool):
 
     # --------------------------------------------------------------- reaping
 
+    def _ordered(self) -> list:
+        """Items in arrival order.  Insertion order usually IS arrival
+        order; it diverges only when concurrent admissions complete out
+        of order, so sort lazily (timsort on nearly-sorted is ~O(n))."""
+        items = list(self._txs.values())
+        for a, b in zip(items, items[1:]):
+            if a.seq > b.seq:
+                return sorted(items, key=lambda i: i.seq)
+        return items
+
     def reap_max_bytes_max_gas(self, max_bytes: int,
                                max_gas: int) -> list[bytes]:
         out, total_bytes, total_gas = [], 0, 0
-        for item in self._txs.values():
+        for item in self._ordered():
             total_bytes += len(item.tx)
             if max_bytes >= 0 and total_bytes > max_bytes:
                 break
@@ -99,14 +194,15 @@ class CListMempool(Mempool):
         return out
 
     def reap_max_txs(self, n: int) -> list[bytes]:
-        return [item.tx for item in list(self._txs.values())[:n]]
+        return [item.tx for item in self._ordered()[:n]]
 
     # ---------------------------------------------------------------- update
 
     def lock(self):
         """The executor holds this across FinalizeBlock-Commit-update
-        (state/execution.go:295,391-460)."""
-        return self._lock
+        (state/execution.go:295,391-460): the writer side of the
+        admission gate — exclusive against in-flight check_tx readers."""
+        return self._gate.write_locked()
 
     async def update(self, height: int, txs: list[bytes],
                      tx_results: list) -> None:
@@ -143,12 +239,12 @@ class CListMempool(Mempool):
         return sum(len(i.tx) for i in self._txs.values())
 
     async def flush(self) -> None:
-        async with self._lock:
+        async with self._gate.write_locked():
             self._txs.clear()
             self.cache.reset()
             self._txs_available.clear()
             self._notified_available = False
 
     def contents(self) -> list[bytes]:
-        """Iteration snapshot for the gossip reactor."""
-        return [i.tx for i in self._txs.values()]
+        """Iteration snapshot for the gossip reactor (arrival order)."""
+        return [i.tx for i in self._ordered()]

@@ -20,6 +20,11 @@ from ..libs import log as tmlog
 from .stateprovider import StateProvider
 
 CHUNK_TIMEOUT = 10.0
+# Outstanding chunk requests per serving peer (the reference runs 4
+# concurrent chunk fetchers, statesync/syncer.go chunkFetchers): enough
+# to keep every peer's pipe full, bounded so one node is never flooded
+# and restore throughput scales with the number of serving peers.
+MAX_INFLIGHT_PER_PEER = 4
 DISCOVERY_TIME = 0.5
 
 
@@ -196,24 +201,45 @@ class Syncer:
 
         snapshot = pending.snapshot
         applied: set[int] = set()
-        requested: dict[int, float] = {}     # chunk -> last request time
+        requested: dict[int, tuple[float, str]] = {}  # chunk -> (t, peer)
         retries: dict[int, int] = {}
         next_peer = 0
         while len(applied) < snapshot.chunks:
             # request chunks that were never requested or whose request
             # timed out — NOT everything missing on every wakeup, which
-            # would re-transfer in-flight chunks O(n^2)
+            # would re-transfer in-flight chunks O(n^2).  Each peer holds
+            # at most MAX_INFLIGHT_PER_PEER outstanding requests, so
+            # restore bandwidth scales with serving peers instead of
+            # flooding one.
             now = _time.monotonic()
+            inflight: dict[str, int] = {}
+            for i, (t, peer) in requested.items():
+                # an assignment consumes its peer's budget until the
+                # chunk arrives OR the chunk is re-requested elsewhere
+                # (which overwrites requested[i]) — aging it out earlier
+                # would let a slow-but-alive peer accumulate 2x the cap
+                if i not in self._chunks and i not in applied:
+                    inflight[peer] = inflight.get(peer, 0) + 1
             for i in range(snapshot.chunks):
                 if i in self._chunks or i in applied:
                     continue
-                if now - requested.get(i, -1e9) < CHUNK_TIMEOUT / 2:
+                prev = requested.get(i)
+                if prev is not None and now - prev[0] < CHUNK_TIMEOUT / 2:
                     continue
                 if not pending.peers:
                     raise StatesyncError("no peers serving the snapshot")
-                peer = pending.peers[next_peer % len(pending.peers)]
-                next_peer += 1
-                requested[i] = now
+                # next peer with spare in-flight budget (round-robin)
+                peer = None
+                for _ in range(len(pending.peers)):
+                    cand = pending.peers[next_peer % len(pending.peers)]
+                    next_peer += 1
+                    if inflight.get(cand, 0) < MAX_INFLIGHT_PER_PEER:
+                        peer = cand
+                        break
+                if peer is None:
+                    break           # every peer's pipe is full this round
+                inflight[peer] = inflight.get(peer, 0) + 1
+                requested[i] = (now, peer)
                 if self.reactor is not None:
                     self.reactor.request_chunk(peer, snapshot.height,
                                                snapshot.format, i,
