@@ -77,6 +77,8 @@ class P2PConfig:
     recv_rate: int = 5 * 1024 * 1024
     pex: bool = True
     pex_interval_seconds: float = 30.0     # ensurePeersPeriod
+    seed_mode: bool = False    # crawl + serve addresses, hang up after
+    #   harvesting (pex_reactor.go crawlPeersRoutine)
     # one-way inter-node delay injected at the MConnection receive side;
     # the e2e runner uses it to emulate geo-distribution on one machine
     # (reference test/e2e/runner/latency_emulation.go)

@@ -276,7 +276,8 @@ class Node:
             self.pex_reactor = PexReactor(
                 self.addr_book, self.node_key.id,
                 max_outbound=cfg.p2p.max_num_outbound_peers,
-                request_interval=cfg.p2p.pex_interval_seconds)
+                request_interval=cfg.p2p.pex_interval_seconds,
+                seed_mode=cfg.p2p.seed_mode)
             self.switch.add_reactor("pex", self.pex_reactor)
         return self
 

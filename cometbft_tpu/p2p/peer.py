@@ -23,6 +23,12 @@ class Peer:
         self._data: dict = {}               # reactor-attached state
 
     @property
+    def remote_addr(self) -> str:
+        """Proven socket-level address of the peer (empty if unknown)."""
+        conn = getattr(self.mconn, "conn", None)
+        return getattr(conn, "remote_addr", "") or ""
+
+    @property
     def id(self) -> str:
         return self.node_info.node_id
 

@@ -1,113 +1,63 @@
-"""Peer exchange (PEX) + address book (reference: ``p2p/pex/pex_reactor.go``
-and ``p2p/pex/addrbook.go``; channel 0x00 from ``pex_reactor.go:22``).
+"""Peer exchange (PEX) + seed crawling (reference: ``p2p/pex/pex_reactor.go``;
+channel 0x00 from ``pex_reactor.go:22``).
 
-The address book persists known ``node_id -> dialable address`` entries as
-JSON (the reference's old/new bucket machinery guards against address
-poisoning at internet scale; this book keeps the same interface —
-add/pick/mark good/bad — with a flat store and ban-on-bad semantics).
-The reactor asks peers for addresses when connectivity is low and dials
-newly learned peers, so a node bootstraps the full mesh from one seed."""
+The address book lives in :mod:`cometbft_tpu.p2p.addrbook` — a bucketed
+old/new design with hashed placement that bounds how much of the book an
+address-flooding peer can touch.  The reactor asks peers for addresses
+when connectivity is low and dials newly learned peers, so a node
+bootstraps the full mesh from one seed; successful connections promote
+entries to the vetted tier (``mark_good``), failed dials count attempts.
+
+Seed crawling (``pex_reactor.go crawlPeersRoutine``): a node in
+``seed_mode`` continuously dials book addresses, handshakes, exchanges
+address books, and hangs up — it exists to harvest and serve addresses,
+not to hold connections.
+"""
 
 from __future__ import annotations
 
 import asyncio
-import json
-import os
 import random
 
 import msgpack
 
 from ..libs import log as tmlog
+from .addrbook import AddrBook
 from .reactor import ChannelDescriptor, Reactor
+
+__all__ = ["AddrBook", "PexReactor", "PEX_CHANNEL"]
 
 PEX_CHANNEL = 0x00
 REQUEST_INTERVAL = 30.0          # ensurePeersPeriod (pex_reactor.go)
 MAX_ADDRS_PER_RESPONSE = 32
-MAX_BOOK_SIZE = 1000
-
-
-class AddrBook:
-    def __init__(self, path: str | None = None):
-        self.path = path
-        self._addrs: dict[str, str] = {}       # node_id -> "host:port"
-        self._banned: set[str] = set()
-        if path and os.path.exists(path):
-            self._load()
-
-    def _load(self) -> None:
-        try:
-            with open(self.path) as f:
-                d = json.load(f)
-            self._addrs = dict(d.get("addrs", {}))
-            self._banned = set(d.get("banned", []))
-        except (OSError, json.JSONDecodeError):
-            self._addrs = {}
-
-    def save(self) -> None:
-        if not self.path:
-            return
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"addrs": self._addrs,
-                       "banned": sorted(self._banned)}, f, indent=2)
-        os.replace(tmp, self.path)
-
-    def add(self, node_id: str, addr: str, persist: bool = True) -> bool:
-        """``persist=False`` defers the disk write — callers processing a
-        batch (a PEX response) save once at the end, not per address."""
-        if not addr or node_id in self._banned:
-            return False
-        if self._addrs.get(node_id) == addr:
-            return False
-        if node_id not in self._addrs and len(self._addrs) >= MAX_BOOK_SIZE:
-            return False
-        self._addrs[node_id] = addr
-        if persist:
-            self.save()
-        return True
-
-    def mark_bad(self, node_id: str) -> None:
-        """addrbook MarkBad: ban and forget."""
-        self._banned.add(node_id)
-        self._addrs.pop(node_id, None)
-        self.save()
-
-    def pick(self, exclude: set[str], n: int = 1) -> list[tuple[str, str]]:
-        cands = [(i, a) for i, a in self._addrs.items()
-                 if i not in exclude]
-        random.shuffle(cands)
-        return cands[:n]
-
-    def sample(self, n: int = MAX_ADDRS_PER_RESPONSE) -> list[tuple[str, str]]:
-        cands = list(self._addrs.items())
-        random.shuffle(cands)
-        return cands[:n]
-
-    def size(self) -> int:
-        return len(self._addrs)
+CRAWL_LINGER = 3.0               # seed mode: seconds before hanging up
 
 
 class PexReactor(Reactor):
     def __init__(self, book: AddrBook, own_id: str,
                  max_outbound: int = 10,
-                 request_interval: float = REQUEST_INTERVAL):
+                 request_interval: float = REQUEST_INTERVAL,
+                 seed_mode: bool = False):
         super().__init__()
         self.book = book
         self.own_id = own_id
         self.max_outbound = max_outbound
         self.request_interval = request_interval
+        self.seed_mode = seed_mode
         self.log = tmlog.logger("pex", node=own_id[:8])
         self._task: asyncio.Task | None = None
         self._dialing: set[str] = set()
         self._requested: set[str] = set()    # peers we asked for addrs
+        self._crawl_hangups: set[str] = set()
 
     def get_channels(self):
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
                                   send_queue_capacity=10, name="pex")]
 
     async def start(self) -> None:
-        self._task = asyncio.create_task(self._ensure_peers_routine())
+        routine = self._crawl_routine if self.seed_mode \
+            else self._ensure_peers_routine
+        self._task = asyncio.create_task(routine())
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -115,10 +65,58 @@ class PexReactor(Reactor):
         self.book.save()
 
     def add_peer(self, peer) -> None:
-        # learn the peer's self-advertised dial-back address
-        addr = peer.node_info.listen_addr
-        if addr:
-            self.book.add(peer.id, addr)
+        if peer.outbound:
+            # the address WE successfully dialed is proven: record and
+            # vet exactly that one (addrbook MarkGood)
+            addr = peer.dial_addr or peer.node_info.listen_addr
+            if addr:
+                self.book.add(peer.id, addr, persist=False,
+                              source=peer.remote_addr)
+            self.book.mark_good(peer.id)
+        else:
+            # an inbound handshake proves nothing about the listen_addr
+            # it advertises — hearsay into the new tier only, attributed
+            # to the proven socket address; promoting it would let an
+            # attacker fill the protected old tier with invented
+            # addresses
+            addr = peer.node_info.listen_addr
+            if addr:
+                self.book.add(peer.id, addr, persist=False,
+                              source=peer.remote_addr)
+        if self.seed_mode:
+            # harvest the newcomer's book, then hang up shortly: a seed
+            # serves addresses, it doesn't hold connections
+            self._requested.add(peer.id)
+            peer.send(PEX_CHANNEL, msgpack.packb({"@": "pex_req"},
+                                                 use_bin_type=True))
+            self._schedule_hangup(peer)
+
+    def _schedule_hangup(self, peer) -> None:
+        if peer.id in self._crawl_hangups:
+            return
+        self._crawl_hangups.add(peer.id)
+
+        async def hangup():
+            try:
+                await asyncio.sleep(CRAWL_LINGER)
+                # identity check, not id membership: a reconnect within
+                # the linger must not get its NEW connection evicted by
+                # the stale timer
+                if self.switch is not None and \
+                        getattr(self.switch, "peers", {}).get(
+                            peer.id) is peer:
+                    await self.switch.stop_peer_gracefully(peer)
+            finally:
+                self._crawl_hangups.discard(peer.id)
+
+        asyncio.ensure_future(hangup())
+
+    def remove_peer(self, peer, reason) -> None:
+        # a disconnect revokes any outstanding address-request
+        # authorization (otherwise _requested grows forever on a
+        # long-lived seed and a reconnecting peer could answer a
+        # request it was never re-sent)
+        self._requested.discard(peer.id)
 
     def receive(self, channel_id: int, peer, msg: bytes) -> None:
         d = msgpack.unpackb(msg, raw=False)
@@ -127,7 +125,8 @@ class PexReactor(Reactor):
             peer.send(PEX_CHANNEL, msgpack.packb(
                 {"@": "pex_res",
                  "addrs": [{"id": i, "addr": a}
-                           for i, a in self.book.sample()]},
+                           for i, a in self.book.sample(
+                               MAX_ADDRS_PER_RESPONSE)]},
                 use_bin_type=True))
         elif tag == "pex_res":
             # only accept what we asked for: unsolicited responses are the
@@ -137,11 +136,22 @@ class PexReactor(Reactor):
                                peer=peer.id[:8])
                 return
             self._requested.discard(peer.id)
+            # the advertiser's PROVEN socket address scopes bucket
+            # placement: one source can only thrash the buckets its
+            # group hashes to.  (Never the self-advertised listen_addr,
+            # and never empty — an un-attributable response would let
+            # each invented address become its own source group.)
+            source = peer.remote_addr
+            if not source:
+                self.log.debug("pex_res without proven source dropped",
+                               peer=peer.id[:8])
+                return
             changed = False
             for entry in d.get("addrs", [])[:MAX_ADDRS_PER_RESPONSE]:
                 nid, addr = entry.get("id", ""), entry.get("addr", "")
                 if nid and nid != self.own_id:
-                    changed |= self.book.add(nid, addr, persist=False)
+                    changed |= self.book.add(nid, addr, persist=False,
+                                             source=source)
             if changed:
                 self.book.save()     # one write per response, not per addr
 
@@ -179,12 +189,37 @@ class PexReactor(Reactor):
             self._dialing.add(nid)
             asyncio.ensure_future(self._dial(nid, addr))
 
+    # ------------------------------------------------------------ crawling
+
+    async def _crawl_routine(self) -> None:
+        """Seed-node loop (pex_reactor.go crawlPeersRoutine): dial book
+        entries round after round — connections harvest addresses via
+        ``add_peer`` and hang up after CRAWL_LINGER — so the book stays
+        fresh and every inbound node gets a broad sample."""
+        while True:
+            try:
+                self._crawl()
+            except Exception as e:
+                self.log.warn("crawl failed", err=repr(e))
+            await asyncio.sleep(self.request_interval
+                                * (0.75 + 0.5 * random.random()))
+
+    def _crawl(self) -> None:
+        sw = self.switch
+        if sw is None:
+            return
+        exclude = set(sw.peers) | self._dialing | {self.own_id}
+        for nid, addr in self.book.pick(exclude, n=4):
+            self._dialing.add(nid)
+            asyncio.ensure_future(self._dial(nid, addr))
+
     async def _dial(self, nid: str, addr: str) -> None:
         try:
             await self.switch.dial_peer(addr)
             self.log.debug("pex dialed", peer=nid[:8], addr=addr)
         except Exception as e:
             if "duplicate peer" not in str(e):
+                self.book.mark_attempt(nid)
                 self.log.debug("pex dial failed", addr=addr, err=repr(e))
         finally:
             self._dialing.discard(nid)

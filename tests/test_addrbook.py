@@ -1,0 +1,189 @@
+"""Bucketed address book (p2p/addrbook.py): anti-poisoning placement,
+old/new tiers, promotion, persistence, and seed crawling — fresh
+implementation of the defensive ideas in the reference's
+``p2p/pex/addrbook.go``."""
+
+import asyncio
+
+import pytest
+
+from cometbft_tpu.p2p.addrbook import (BUCKET_SIZE, BUCKETS_PER_SOURCE,
+                                       MAX_ATTEMPTS, AddrBook)
+
+pytestmark = pytest.mark.timeout(60)
+
+
+def nid(i: int) -> str:
+    return f"{i:040x}"
+
+
+def test_flood_cannot_evict_vetted_entries(tmp_path):
+    """One malicious source flooding thousands of invented addresses can
+    neither evict old-tier entries nor occupy more than its bounded
+    bucket share of the new tier."""
+    book = AddrBook(str(tmp_path / "book.json"))
+    # 40 known-good peers, vetted by successful connections
+    good = []
+    for i in range(40):
+        node = nid(i)
+        assert book.add(node, f"10.0.{i}.1:26656")
+        book.mark_good(node)
+        good.append(node)
+    assert book.num_old() == 40
+
+    # flood: 5000 addresses from ONE source (one /16 group)
+    for j in range(5000):
+        book.add(nid(10_000 + j), f"203.0.{j % 256}.{j // 256}:26656",
+                 persist=False, source="66.66.1.2:26656")
+
+    # every vetted entry survives untouched
+    assert book.num_old() == 40
+    assert all(book.is_good(g) for g in good)
+    # the flood is confined to its bucket share
+    assert book.num_new() <= BUCKETS_PER_SOURCE * BUCKET_SIZE
+    # and the vetted tier still dominates dial selection
+    picked = {p for p, _ in book.pick(set(), n=20)}
+    assert picked & set(good), "flood crowded vetted peers out of pick()"
+
+
+def test_flood_from_many_sources_still_bounded_per_source(tmp_path):
+    """Each distinct source group gets its own bounded bucket share; no
+    single source exceeds it."""
+    book = AddrBook(None)
+    for s in range(4):
+        for j in range(3000):
+            book.add(nid(s * 10_000 + j),
+                     f"198.{s}.{j % 250}.1:26656",
+                     persist=False, source=f"4{s}.1.2.3:26656")
+    # total is bounded by 4 sources x share (with hash collisions it can
+    # only be smaller)
+    assert book.num_new() <= 4 * BUCKETS_PER_SOURCE * BUCKET_SIZE
+
+
+def test_promotion_and_attempts(tmp_path):
+    book = AddrBook(str(tmp_path / "b.json"))
+    book.add(nid(1), "1.2.3.4:26656")
+    assert not book.is_good(nid(1))
+    book.mark_good(nid(1))
+    assert book.is_good(nid(1))
+    # a later hearsay add cannot displace the vetted address
+    assert not book.add(nid(1), "6.6.6.6:26656", source="9.9.9.9:1")
+    assert book.is_good(nid(1))
+
+    # failed dials eventually drop an UNVETTED entry
+    book.add(nid(2), "2.3.4.5:26656")
+    for _ in range(MAX_ATTEMPTS + 1):
+        book.mark_attempt(nid(2))
+    assert book.pick({nid(1)}) == []
+    # a vetted entry DEMOTES after repeated failures (the peer moved) so
+    # hearsay can finally replace its stale address; one more failure
+    # drops it
+    for _ in range(MAX_ATTEMPTS + 1):
+        book.mark_attempt(nid(1))
+    assert not book.is_good(nid(1))
+    assert book.add(nid(1), "7.7.7.7:26656", source="8.8.8.8:1")
+    assert dict(book.pick(set(), n=5))[nid(1)] == "7.7.7.7:26656"
+
+
+def test_persistence_round_trip(tmp_path):
+    path = str(tmp_path / "book.json")
+    book = AddrBook(path)
+    book.add(nid(1), "1.1.1.1:1")
+    book.mark_good(nid(1))
+    book.add(nid(2), "2.2.2.2:2", source="3.3.3.3:3")
+    book.mark_bad(nid(9))
+    book.save()
+
+    book2 = AddrBook(path)
+    assert book2.is_good(nid(1))
+    assert book2.size() == 2
+    assert not book2.add(nid(9), "9.9.9.9:9")      # ban persisted
+    # salt persisted -> same placement across restarts
+    assert book._salt == book2._salt
+
+
+def test_legacy_flat_format_import(tmp_path):
+    import json
+
+    path = str(tmp_path / "legacy.json")
+    with open(path, "w") as f:
+        json.dump({"addrs": {nid(5): "5.5.5.5:5", nid(6): "6.6.6.6:6"},
+                   "banned": [nid(7)]}, f)
+    book = AddrBook(path)
+    assert book.size() == 2
+    assert not book.add(nid(7), "7.7.7.7:7")
+    assert {p for p, _ in book.pick(set(), n=5)} == {nid(5), nid(6)}
+
+
+def test_seed_crawl_dials_and_hangs_up(monkeypatch):
+    """A seed-mode reactor crawls book addresses and disconnects after
+    the linger: connections are harvested, not held."""
+    from cometbft_tpu.p2p import pex as pexmod
+    from cometbft_tpu.p2p.pex import PexReactor
+
+    monkeypatch.setattr(pexmod, "CRAWL_LINGER", 0.05)
+
+    class FakeNodeInfo:
+        listen_addr = "8.8.8.8:26656"
+
+    class FakePeer:
+        def __init__(self, pid, outbound=False):
+            self.id = pid
+            self.node_info = FakeNodeInfo()
+            self.outbound = outbound
+            self.remote_addr = "8.8.8.8:41234"
+            self.dial_addr = "8.8.8.8:26656" if outbound else None
+            self.sent = []
+
+        def send(self, ch, msg):
+            self.sent.append((ch, msg))
+
+    class FakeSwitch:
+        def __init__(self):
+            self.peers = {}
+            self.dialed = []
+            self.stopped = []
+
+        async def dial_peer(self, addr, persistent=False):
+            self.dialed.append(addr)
+            return None
+
+        async def stop_peer_gracefully(self, peer):
+            self.stopped.append(peer.id)
+            self.peers.pop(peer.id, None)
+
+    async def main():
+        book = AddrBook(None)
+        for i in range(6):
+            book.add(nid(i), f"12.0.0.{i}:26656")
+        r = PexReactor(book, own_id=nid(99), seed_mode=True,
+                       request_interval=0.02)
+        sw = FakeSwitch()
+        r.switch = sw
+        await r.start()
+        await asyncio.sleep(0.06)          # a crawl round fires
+        assert sw.dialed, "crawler never dialed book addresses"
+
+        # an inbound peer gets harvested and then hung up — but its
+        # self-advertised address is NOT vetted (inbound proves nothing)
+        p = FakePeer(nid(50))
+        sw.peers[p.id] = p
+        r.add_peer(p)
+        assert p.sent and b"pex_req" in p.sent[0][1]
+        assert not book.is_good(p.id)
+        await asyncio.sleep(0.12)
+        assert p.id in sw.stopped, "seed kept the connection open"
+
+        # an OUTBOUND connection (we dialed the address) does vet it
+        po = FakePeer(nid(51), outbound=True)
+        sw.peers[po.id] = po
+        r.add_peer(po)
+        assert book.is_good(po.id)
+        await r.stop()
+        return True
+
+    loop = asyncio.new_event_loop()
+    try:
+        assert loop.run_until_complete(main())
+    finally:
+        loop.close()
