@@ -548,11 +548,32 @@ def cmd_compact_db(args) -> int:
     return 0
 
 
+def cmd_e2e_gen(args) -> int:
+    """test/e2e/generator analogue: emit deterministic random manifests;
+    each failure reproduces from its seed alone."""
+    from ..e2e.generator import generate_manifest
+    from ..e2e.manifest import manifest_to_toml
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    for seed in range(args.seed, args.seed + args.runs):
+        m = generate_manifest(seed, compact=args.compact)
+        path = _join(args.output_dir, f"gen-{seed:05d}.toml")
+        with open(path, "w") as f:
+            f.write(manifest_to_toml(m))
+        print(f"{path}: {len(m.nodes)} nodes, final_height "
+              f"{m.final_height}")
+    return 0
+
+
 def cmd_e2e(args) -> int:
     """test/e2e/runner analogue: run a manifest-described testnet of OS
     processes, apply its perturbation schedule, check invariants."""
     from ..e2e import Runner, RunnerError, load_manifest
 
+    if not args.manifest:
+        print("--manifest is required (generate one with e2e-gen)",
+              file=sys.stderr)
+        return 1
     try:
         manifest = load_manifest(args.manifest)
     except Exception as e:
@@ -879,11 +900,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("e2e", help="manifest-driven multi-process "
                         "testnet runner (test/e2e)")
-    sp.add_argument("--manifest", required=True, help="TOML manifest path")
+    sp.add_argument("--manifest", help="TOML manifest path")
     sp.add_argument("--dir", default="./e2e-net")
     sp.add_argument("--base-port", type=int, default=26656)
     sp.add_argument("--deadline", type=float, default=240.0)
     sp.set_defaults(fn=cmd_e2e)
+
+    sp = sub.add_parser("e2e-gen", help="deterministic random manifest "
+                        "generator (test/e2e/generator): seed -> TOML "
+                        "manifests sweeping db/abci/key/sync/perturb axes")
+    sp.add_argument("--seed", type=int, default=1)
+    sp.add_argument("--runs", type=int, default=1,
+                    help="manifests to emit (seeds seed..seed+runs-1)")
+    sp.add_argument("--output-dir", default="./e2e-gen")
+    sp.add_argument("--compact", action="store_true",
+                    help="CI-sized topologies (<= 4 backing nodes)")
+    sp.set_defaults(fn=cmd_e2e_gen)
 
     sp = sub.add_parser("debug", help="post-mortem capture")
     dsub = sp.add_subparsers(dest="debug_command", required=True)

@@ -168,12 +168,18 @@ async def _run_repl(args, interactive: bool) -> int:
 
 def _run_kvstore(args) -> int:
     """Serve the example kvstore app over the ABCI socket protocol
-    (abci-cli.go:266)."""
+    (abci-cli.go:266), or over gRPC with ``--grpc``."""
     from ..abci.kvstore import KVStoreApplication
-    from ..abci.server import ABCIServer
 
     async def main():
-        server = ABCIServer(KVStoreApplication(), port=args.port)
+        if getattr(args, "grpc", False):
+            from ..abci.grpc import GRPCABCIServer
+
+            server = GRPCABCIServer(KVStoreApplication(), port=args.port)
+        else:
+            from ..abci.server import ABCIServer
+
+            server = ABCIServer(KVStoreApplication(), port=args.port)
         await server.start()
         print(f"ABCI kvstore server listening on "
               f"{server.host}:{server.port}", flush=True)
@@ -246,6 +252,8 @@ def register(sub) -> None:
             ap.add_argument("args", nargs="*")
         ap.set_defaults(fn=cmd_abci)
     ap = asub.add_parser("kvstore", help="run the example kvstore app "
-                         "as an ABCI socket server")
+                         "as an ABCI socket (or --grpc) server")
     ap.add_argument("--port", type=int, default=26658)
+    ap.add_argument("--grpc", action="store_true",
+                    help="serve over gRPC instead of the socket protocol")
     ap.set_defaults(fn=cmd_abci)

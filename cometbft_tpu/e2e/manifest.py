@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 
 MODES = ("validator", "full", "seed", "light")
 PERTURBATIONS = ("kill", "restart", "pause", "resume")
+DATABASES = ("logdb", "native", "memdb")
+ABCI_PROTOCOLS = ("builtin", "socket", "grpc")
 
 
 class ManifestError(Exception):
@@ -45,6 +47,9 @@ class NodeManifest:
     mode: str = "validator"            # manifest.go:158 ModeStr
     start_at: int = 0                  # join when the chain reaches this
     key_type: str = "ed25519"
+    database: str = "logdb"            # storage.db_backend sweep axis
+    abci_protocol: str = "builtin"     # builtin | socket | grpc (the
+    #   runner spawns an external kvstore app process for the latter two)
     # "action:height" entries, applied when the chain passes height
     perturb: list[str] = field(default_factory=list)
 
@@ -96,6 +101,18 @@ class Manifest:
         for n in self.nodes.values():
             if n.mode not in MODES:
                 raise ManifestError(f"bad mode {n.mode!r} for {n.name}")
+            if n.database not in DATABASES:
+                raise ManifestError(f"bad database {n.database!r} for "
+                                    f"{n.name} (want one of {DATABASES})")
+            if n.abci_protocol not in ABCI_PROTOCOLS:
+                raise ManifestError(
+                    f"bad abci_protocol {n.abci_protocol!r} for {n.name} "
+                    f"(want one of {ABCI_PROTOCOLS})")
+            if n.database == "memdb" and any(
+                    p.startswith(("kill", "restart")) for p in n.perturb):
+                raise ManifestError(
+                    f"{n.name}: memdb does not survive kill/restart "
+                    f"perturbations")
             n.schedule()
         for h, updates in self.validator_updates.items():
             if h <= 0:
@@ -125,6 +142,49 @@ class Manifest:
                 if n.mode == "validator"}
 
 
+def manifest_to_toml(m: Manifest) -> str:
+    """Serialize a manifest back to the TOML the runner/CLI consume —
+    the generator's output format."""
+    def q(s: str) -> str:
+        return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+
+    lines = [f"chain_id = {q(m.chain_id)}",
+             f"initial_height = {m.initial_height}",
+             f"final_height = {m.final_height}"]
+    if m.emulated_latency_ms:
+        lines.append(f"emulated_latency_ms = {m.emulated_latency_ms}")
+    if m.fuzz:
+        lines.append("fuzz = true")
+    if m.validators:
+        lines.append("\n[validators]")
+        for name, power in m.validators.items():
+            lines.append(f"{name} = {power}")
+    for name, n in m.nodes.items():
+        lines.append(f"\n[node.{name}]")
+        if n.mode != "validator":
+            lines.append(f"mode = {q(n.mode)}")
+        if n.start_at:
+            lines.append(f"start_at = {n.start_at}")
+        if n.key_type != "ed25519":
+            lines.append(f"key_type = {q(n.key_type)}")
+        if n.database != "logdb":
+            lines.append(f"database = {q(n.database)}")
+        if n.abci_protocol != "builtin":
+            lines.append(f"abci_protocol = {q(n.abci_protocol)}")
+        if n.perturb:
+            lines.append("perturb = ["
+                         + ", ".join(q(p) for p in n.perturb) + "]")
+    for h, updates in sorted(m.validator_updates.items()):
+        lines.append(f"\n[validator_update.{h}]")
+        for name, power in updates.items():
+            lines.append(f"{name} = {power}")
+    lines.append("\n[load]")
+    lines.append(f"rate = {m.load.rate}")
+    lines.append(f"duration = {m.load.duration}")
+    lines.append(f"size = {m.load.size}")
+    return "\n".join(lines) + "\n"
+
+
 def load_manifest(path: str) -> Manifest:
     import tomllib
 
@@ -146,6 +206,8 @@ def manifest_from_dict(doc: dict) -> Manifest:
         nm.mode = nd.get("mode", "validator")
         nm.start_at = int(nd.get("start_at", 0))
         nm.key_type = nd.get("key_type", "ed25519")
+        nm.database = nd.get("database", "logdb")
+        nm.abci_protocol = nd.get("abci_protocol", "builtin")
         nm.perturb = list(nd.get("perturb", []))
         m.nodes[name] = nm
     for h, updates in doc.get("validator_update", {}).items():

@@ -38,13 +38,18 @@ class Runner:
         self.fast_timeouts = fast_timeouts
         self.log = log
         self.procs: dict[str, subprocess.Popen] = {}
+        self.app_procs: dict[str, subprocess.Popen] = {}
         self.paused: set[str] = set()
         # stable order: validators first so port 0 is a validator RPC
         self.names = sorted(
             self.m.nodes,
             key=lambda n: (self.m.nodes[n].mode != "validator", n))
-        self.ports = {name: base_port + 2 * i
+        self.ports = {name: base_port + 3 * i
                       for i, name in enumerate(self.names)}
+
+    def app_port(self, name: str) -> int:
+        """Port of the external ABCI app process (socket/grpc nodes)."""
+        return self.ports[name] + 2
 
     # ---------------------------------------------------------- setup
 
@@ -80,6 +85,12 @@ class Runner:
         def tweak(spec, cfg) -> None:
             cfg.base.signature_backend = "cpu"
             cfg.p2p.emulated_latency_ms = self.m.emulated_latency_ms
+            node = self.m.nodes[spec.name]
+            cfg.storage.db_backend = node.database
+            cfg.p2p.seed_mode = spec.name in seeds
+            if node.abci_protocol != "builtin":
+                cfg.base.abci = node.abci_protocol
+                cfg.base.proxy_app = f"127.0.0.1:{self.app_port(spec.name)}"
             if seeds and spec.name not in seeds:
                 cfg.p2p.seeds = ",".join(
                     f"tcp://127.0.0.1:{self.ports[s]}" for s in seeds)
@@ -101,6 +112,22 @@ class Runner:
     def _spawn(self, name: str) -> None:
         node = self.m.nodes[name]
         env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+        if node.abci_protocol != "builtin" and name not in self.app_procs:
+            # out-of-process app: one kvstore server per node, living
+            # across node kill/restart perturbations (the external-app
+            # topology the reference's generator sweeps)
+            app_cmd = [sys.executable, "-m", "cometbft_tpu", "abci",
+                       "kvstore", "--port", str(self.app_port(name))]
+            if node.abci_protocol == "grpc":
+                app_cmd.append("--grpc")
+            app_log = open(os.path.join(self.base_dir,
+                                        f"{name}.app.log"), "ab")
+            self.log(f"[e2e] starting {name} app ({node.abci_protocol})")
+            self.app_procs[name] = subprocess.Popen(
+                app_cmd, stdout=app_log, stderr=subprocess.STDOUT,
+                env=env, cwd=_REPO)
+            app_log.close()
+            self._wait_for_port(self.app_port(name), 20.0)
         if node.mode == "light":
             cmd = self._light_cmd(name)
         else:
@@ -113,6 +140,22 @@ class Runner:
             cmd, stdout=log_f, stderr=subprocess.STDOUT,
             env=env, cwd=_REPO)
         log_f.close()          # the child keeps its own fd
+
+    def _wait_for_port(self, port: int, timeout_s: float) -> None:
+        """Block until the app server accepts connections: the node
+        process has no connect-retry, so losing the interpreter-startup
+        race would crash it at boot with ConnectionRefused."""
+        import socket
+
+        end = time.monotonic() + timeout_s
+        while time.monotonic() < end:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1.0):
+                    return
+            except OSError:
+                time.sleep(0.1)
+        raise RunnerError(f"app server on port {port} never came up")
 
     def _light_cmd(self, name: str) -> list[str]:
         primary = self._primary_name()
@@ -395,7 +438,10 @@ class Runner:
                 if name in self.paused:
                     proc.send_signal(signal.SIGCONT)
                 proc.send_signal(signal.SIGTERM)
-        for proc in self.procs.values():
+        for proc in list(self.procs.values()) + list(
+                self.app_procs.values()):
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:

@@ -181,22 +181,31 @@ class AddrBook:
         return (self._old if tier == "old" else self._new)[idx].get(node_id)
 
     def add(self, node_id: str, addr: str, persist: bool = True,
-            source: str = "") -> bool:
+            source: str = "", proven: bool = False) -> bool:
         """Learn an address.  ``source`` is the advertising peer's own
         address (its group scopes which new-bucket the entry can land
-        in).  Existing old-tier entries are never displaced by adds."""
+        in).  Hearsay never displaces an old-tier entry; a PROVEN
+        address (we dialed it successfully — pex outbound path) replaces
+        any entry and lands directly in the vetted tier, so a peer that
+        moved updates cleanly."""
         if not addr or node_id in self._banned:
             return False
+        import time as _time
+
         cur = self._get(node_id)
         if cur is not None:
             if cur.addr == addr:
                 return False
             tier = self._where[node_id][0]
-            if tier == "old":
+            if tier == "old" and not proven:
                 return False           # vetted address wins over hearsay
             self._drop(node_id)
-        ok = self._place(_Entry(node_id, addr, _group(source or addr)),
-                         "new")
+        e = _Entry(node_id, addr, _group(source or addr))
+        if proven:
+            e.last_success = _time.time()
+            ok = self._place(e, "old") or self._place(e, "new")
+        else:
+            ok = self._place(e, "new")
         if ok and persist:
             self.save()
         return ok

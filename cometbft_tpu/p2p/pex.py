@@ -48,7 +48,6 @@ class PexReactor(Reactor):
         self._task: asyncio.Task | None = None
         self._dialing: set[str] = set()
         self._requested: set[str] = set()    # peers we asked for addrs
-        self._crawl_hangups: set[str] = set()
 
     def get_channels(self):
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
@@ -67,11 +66,12 @@ class PexReactor(Reactor):
     def add_peer(self, peer) -> None:
         if peer.outbound:
             # the address WE successfully dialed is proven: record and
-            # vet exactly that one (addrbook MarkGood)
+            # vet exactly that one (addrbook MarkGood), replacing any
+            # stale vetted address (the peer moved)
             addr = peer.dial_addr or peer.node_info.listen_addr
             if addr:
                 self.book.add(peer.id, addr, persist=False,
-                              source=peer.remote_addr)
+                              source=peer.remote_addr, proven=True)
             self.book.mark_good(peer.id)
         else:
             # an inbound handshake proves nothing about the listen_addr
@@ -92,22 +92,16 @@ class PexReactor(Reactor):
             self._schedule_hangup(peer)
 
     def _schedule_hangup(self, peer) -> None:
-        if peer.id in self._crawl_hangups:
-            return
-        self._crawl_hangups.add(peer.id)
-
+        # one timer per peer OBJECT (add_peer fires once per connection);
+        # the identity check means a stale timer from a dropped
+        # connection can never evict a reconnect, and the reconnect's
+        # own timer still hangs it up
         async def hangup():
-            try:
-                await asyncio.sleep(CRAWL_LINGER)
-                # identity check, not id membership: a reconnect within
-                # the linger must not get its NEW connection evicted by
-                # the stale timer
-                if self.switch is not None and \
-                        getattr(self.switch, "peers", {}).get(
-                            peer.id) is peer:
-                    await self.switch.stop_peer_gracefully(peer)
-            finally:
-                self._crawl_hangups.discard(peer.id)
+            await asyncio.sleep(CRAWL_LINGER)
+            if self.switch is not None and \
+                    getattr(self.switch, "peers", {}).get(
+                        peer.id) is peer:
+                await self.switch.stop_peer_gracefully(peer)
 
         asyncio.ensure_future(hangup())
 

@@ -187,3 +187,18 @@ def test_seed_crawl_dials_and_hangs_up(monkeypatch):
         assert loop.run_until_complete(main())
     finally:
         loop.close()
+
+
+def test_proven_address_replaces_stale_vetted_entry():
+    """A peer that MOVED: its old vetted address is replaced when we
+    successfully dial the new one (proven), while hearsay still can't
+    touch the vetted entry."""
+    book = AddrBook(None)
+    book.add(nid(1), "1.1.1.1:26656")
+    book.mark_good(nid(1))
+    # hearsay about a new address: refused
+    assert not book.add(nid(1), "2.2.2.2:26656", source="9.9.9.9:1")
+    # proven (we dialed it): replaces and stays vetted
+    assert book.add(nid(1), "2.2.2.2:26656", proven=True)
+    assert book.is_good(nid(1))
+    assert dict(book.sample(5))[nid(1)] == "2.2.2.2:26656"
