@@ -128,7 +128,10 @@ class StorageConfig:
 
 @dataclass
 class TxIndexConfig:
-    indexer: str = "kv"               # kv | null
+    indexer: str = "kv"               # kv | psql | null
+    # DSN for indexer="psql" (state/indexer/sink/psql): the node writes
+    # blocks/tx_results/events/attributes rows; queries go to SQL
+    psql_conn: str = ""
 
 
 @dataclass
@@ -243,10 +246,13 @@ class Config:
             raise ConfigError(
                 f"storage.db_backend must be logdb|native|memdb, "
                 f"got {self.storage.db_backend!r}")
-        if self.tx_index.indexer not in ("kv", "null"):
+        if self.tx_index.indexer not in ("kv", "psql", "null"):
             raise ConfigError(
-                f"tx_index.indexer must be kv|null, "
+                f"tx_index.indexer must be kv|psql|null, "
                 f"got {self.tx_index.indexer!r}")
+        if self.tx_index.indexer == "psql" and not self.tx_index.psql_conn:
+            raise ConfigError(
+                "tx_index.indexer='psql' requires tx_index.psql_conn")
         if self.p2p.fuzz_mode not in ("drop", "delay"):
             raise ConfigError(f"p2p.fuzz_mode must be drop|delay, "
                               f"got {self.p2p.fuzz_mode!r}")

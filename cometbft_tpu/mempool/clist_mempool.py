@@ -108,7 +108,8 @@ class _WriteCtx:
 class CListMempool(Mempool):
     def __init__(self, app_conn: ABCIClient, max_txs: int = 5000,
                  max_tx_bytes: int = 1024 * 1024, cache_size: int = 10_000,
-                 keep_invalid_txs_in_cache: bool = False):
+                 keep_invalid_txs_in_cache: bool = False,
+                 metrics_node: str = ""):
         self.app = app_conn
         self.max_txs = max_txs
         self.max_tx_bytes = max_tx_bytes
@@ -117,6 +118,13 @@ class CListMempool(Mempool):
         self._txs: dict[bytes, _MempoolTx] = {}      # arrival-seq FIFO
         self._gate = _AdmissionGate()
         self._arrival = 0                # next arrival sequence number
+        from ..libs import metrics as _m
+
+        # labeled per node: multi-node in-process ensembles (tier-1
+        # tests) share the process-wide registry
+        self._m_node = metrics_node
+        self._m_size = _m.gauge("mempool_size",
+                                "txs currently in the mempool")
         self._txs_available = asyncio.Event()
         self._notified_available = False
         # edge callback fired once per height on the first admitted tx
@@ -154,6 +162,7 @@ class CListMempool(Mempool):
             if key not in self._txs:
                 self._txs[key] = _MempoolTx(tx, res.gas_wanted,
                                             self.height, seq)
+                self._m_size.set(len(self._txs), node=self._m_node)
                 self._notify_available()
         finally:
             await self._gate.release_read()
@@ -229,6 +238,7 @@ class CListMempool(Mempool):
                 del self._txs[key]
                 if not self.keep_invalid:
                     self.cache.remove(key)
+        self._m_size.set(len(self._txs), node=self._m_node)
         if self._txs:
             self._notify_available()
 
@@ -241,6 +251,7 @@ class CListMempool(Mempool):
     async def flush(self) -> None:
         async with self._gate.write_locked():
             self._txs.clear()
+            self._m_size.set(0, node=self._m_node)
             self.cache.reset()
             self._txs_available.clear()
             self._notified_available = False

@@ -176,7 +176,8 @@ class Node:
             self.app_conns.mempool, max_txs=cfg.mempool.size,
             max_tx_bytes=cfg.mempool.max_tx_bytes,
             cache_size=cfg.mempool.cache_size,
-            keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache)
+            keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache,
+            metrics_node=name)
         ev_db = make_db("evidence.db")
         self.evidence_pool = EvidencePool(
             ev_db, state_store=self.state_store,
@@ -258,6 +259,19 @@ class Node:
             self.block_indexer = BlockIndexer(make_db("block_index.db"))
             self.indexer_service = IndexerService(
                 self.event_bus, self.tx_indexer, self.block_indexer,
+                name=f"{name}.idx")
+        elif cfg.tx_index.indexer == "psql":
+            # external SQL sink (state/indexer/sink/psql): same pump,
+            # rows instead of kv postings; write-only from the node
+            from ..indexer import IndexerService
+            from ..indexer.psql import PsqlEventSink
+
+            sink = PsqlEventSink(dsn=cfg.tx_index.psql_conn,
+                                 chain_id=genesis_doc.chain_id)
+            self.tx_indexer = sink
+            self.block_indexer = sink.block_indexer()
+            self.indexer_service = IndexerService(
+                self.event_bus, sink, self.block_indexer,
                 name=f"{name}.idx")
 
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)

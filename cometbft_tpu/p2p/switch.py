@@ -41,6 +41,12 @@ class Switch:
         self._running = False
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
         transport.on_accept = self._on_accepted
+        from ..libs import metrics as _m
+
+        # labeled per node id: multi-node in-process ensembles share the
+        # process-wide registry
+        self._m_node = transport.node_key.id[:8]
+        self._m_peers = _m.gauge("p2p_peers", "connected peers")
 
     # ----------------------------------------------------------- reactors
 
@@ -116,6 +122,7 @@ class Switch:
         peer = Peer(node_info, mconn, outbound, persistent, dial_addr)
         peer_box.append(peer)
         self.peers[peer.id] = peer
+        self._m_peers.set(len(self.peers), node=self._m_node)
         mconn.start()
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
@@ -134,6 +141,7 @@ class Switch:
 
     async def _remove_peer(self, peer: Peer, reason) -> None:
         self.peers.pop(peer.id, None)
+        self._m_peers.set(len(self.peers), node=self._m_node)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)
