@@ -15,6 +15,7 @@ ed25519 lanes fill the device batch (BASELINE configs[5])."""
 
 from __future__ import annotations
 
+import functools as _functools
 import hashlib
 import os
 
@@ -35,6 +36,36 @@ _HALF_N = _N // 2
 PUB_KEY_SIZE = 33          # compressed
 PRIV_KEY_SIZE = 32
 SIG_SIZE = 64
+
+
+def _native_verify(pub: bytes, msg: bytes, sig: bytes) -> bool | None:
+    """Native C++ ECDSA verify (native/secp256k1.cpp) — ~1.7x the
+    OpenSSL-via-`cryptography` path, which pays per-call DER encoding
+    and object overhead.  None when the lib is unavailable (caller
+    falls back)."""
+    lib = _native_lib()
+    if lib is None:
+        return None
+    return bool(lib.secp256k1_verify(pub, sig, msg, len(msg)))
+
+
+@_functools.cache
+def _native_lib():
+    """CDLL for native/secp256k1.cpp, or None when the on-demand build
+    fails (same lazy-load shape as crypto/_native_ed25519)."""
+    import ctypes
+
+    try:
+        from ..native import lib_path
+
+        lib = ctypes.CDLL(lib_path("secp256k1"))
+        lib.secp256k1_verify.restype = ctypes.c_int
+        lib.secp256k1_verify.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_uint64]
+        return lib
+    except Exception:
+        return None
 
 
 class Secp256k1PubKey(PubKey):
@@ -62,6 +93,9 @@ class Secp256k1PubKey(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIG_SIZE:
             return False
+        native = _native_verify(self._raw, msg, sig)
+        if native is not None:
+            return native
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:], "big")
         if not (1 <= r < _N and 1 <= s < _N):

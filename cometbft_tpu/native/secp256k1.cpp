@@ -1,0 +1,676 @@
+// Native secp256k1 ECDSA verification (reference seam:
+// crypto/secp256k1/secp256k1.go, backed there by dcrd's C-accelerated
+// library).  Original implementation, same design discipline as
+// native/ed25519.cpp: radix-2^52 field limbs with unsigned __int128
+// accumulation, Jacobian point arithmetic (a=0 short Weierstrass),
+// Barrett scalar arithmetic mod n, and one Shamir joint ladder for
+// u1*G + u2*Q.  Semantics match cometbft_tpu/crypto/secp256k1.py
+// exactly: 33-byte compressed pubkeys, 64-byte r||s big-endian
+// signatures, 1 <= r,s < n, LOW-S ONLY, e = SHA-256(msg) mod n,
+// valid iff R != inf and R.x mod n == r.
+//
+// Exported C ABI (ctypes):
+//   secp256k1_verify(pub33, sig64, msg, msg_len) -> 1/0
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+typedef uint8_t u8;
+
+// ------------------------------------------------------------------ sha256
+// FIPS 180-4.
+
+static const uint32_t SHA256_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+    0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+    0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+    0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+    0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+    0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+    0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+    0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+    0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t ror32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha256(const u8* msg, u64 len, u8 out[32]) {
+    uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                     0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    u64 total = len;
+    const u8* p = msg;
+    // process: full blocks, then padding block(s)
+    u8 tail[128];
+    u64 tail_len = len % 64;
+    u64 full = len - tail_len;
+    memcpy(tail, p + full, tail_len);
+    tail[tail_len] = 0x80;
+    u64 pad_total = (tail_len + 9 <= 64) ? 64 : 128;
+    memset(tail + tail_len + 1, 0, pad_total - tail_len - 1 - 8);
+    u64 bits = total * 8;
+    for (int i = 0; i < 8; i++)
+        tail[pad_total - 8 + i] = (u8)(bits >> (56 - 8 * i));
+    for (u64 off = 0; off <= full + pad_total - 64; off += 64) {
+        const u8* b = (off < full) ? p + off : tail + (off - full);
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = ((uint32_t)b[4 * i] << 24) | ((uint32_t)b[4 * i + 1] << 16)
+                 | ((uint32_t)b[4 * i + 2] << 8) | b[4 * i + 3];
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = ror32(w[i - 15], 7) ^ ror32(w[i - 15], 18)
+                        ^ (w[i - 15] >> 3);
+            uint32_t s1 = ror32(w[i - 2], 17) ^ ror32(w[i - 2], 19)
+                        ^ (w[i - 2] >> 10);
+            w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+        }
+        uint32_t a = h[0], bb = h[1], c = h[2], d = h[3];
+        uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = ror32(e, 6) ^ ror32(e, 11) ^ ror32(e, 25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + S1 + ch + SHA256_K[i] + w[i];
+            uint32_t S0 = ror32(a, 2) ^ ror32(a, 13) ^ ror32(a, 22);
+            uint32_t maj = (a & bb) ^ (a & c) ^ (bb & c);
+            uint32_t t2 = S0 + maj;
+            hh = g; g = f; f = e; e = d + t1;
+            d = c; c = bb; bb = a; a = t1 + t2;
+        }
+        h[0] += a; h[1] += bb; h[2] += c; h[3] += d;
+        h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+    }
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 4; j++)
+            out[4 * i + j] = (u8)(h[i] >> (24 - 8 * j));
+}
+
+// ----------------------------------------------- field GF(2^256 - 2^32 - 977)
+// Radix-2^52, 5 limbs.  2^260 == 16*C (mod p) with C = 2^32 + 977, so
+// overflow limbs fold back with a single small multiply.
+
+struct fe { u64 v[5]; };
+
+static const u64 M52 = (1ULL << 52) - 1;
+static const u64 C16 = 0x1000003D10ULL;     // 16 * (2^32 + 977)
+
+static const fe FE_SEVEN = {{7, 0, 0, 0, 0}};
+static const fe GX = {{0x2815b16f81798ULL, 0xdb2dce28d959fULL,
+                       0xe870b07029bfcULL, 0xbbac55a06295cULL,
+                       0x79be667ef9dcULL}};
+static const fe GY = {{0x7d08ffb10d4b8ULL, 0x48a68554199c4ULL,
+                       0xe1108a8fd17b4ULL, 0xc4655da4fbfc0ULL,
+                       0x483ada7726a3ULL}};
+
+static inline void fe_carry_weak(fe& r) {
+    // bring limbs under ~2^52 (top limb may hold up to 2^48+eps after a
+    // fold; 2^260 overflow recycles through C16/16 at limb 0)
+    u64 c;
+    c = r.v[4] >> 48;            // keep top limb at 48 bits so products
+    r.v[4] &= (1ULL << 48) - 1;  // never reach the fold limit
+    // c * 2^(4*52+48) = c * 2^256 == c * (2^32+977) = c * (C16 >> 4)
+    u128 t = (u128)c * (C16 >> 4) + r.v[0];
+    r.v[0] = (u64)t & M52;
+    r.v[1] += (u64)(t >> 52);
+    for (int i = 1; i < 4; i++) {
+        c = r.v[i] >> 52;
+        r.v[i] &= M52;
+        r.v[i + 1] += c;
+    }
+}
+
+static inline void fe_add(fe& r, const fe& a, const fe& b) {
+    for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
+    fe_carry_weak(r);
+}
+
+// 4p as per-limb multiples (NOT normalized) for the subtraction bias:
+// every limb is >= 2^53, strictly above the largest weakly-reduced
+// operand limb (~2^52 + 2^41), so a.v[i] + BIAS4P[i] - b.v[i] can never
+// underflow u64 (a 2p bias with a normalized low limb CAN underflow —
+// it sat below 2^52 — and silently corrupted ~2^-19 of decompressions)
+static const u64 BIAS4P[5] = {0x3ffffbfffff0bcULL, 0x3ffffffffffffcULL,
+                              0x3ffffffffffffcULL, 0x3ffffffffffffcULL,
+                              0x3fffffffffffcULL};
+
+static inline void fe_sub(fe& r, const fe& a, const fe& b) {
+    for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + BIAS4P[i] - b.v[i];
+    fe_carry_weak(r);
+}
+
+static inline void _fold10(const u128 acc[10], fe& r) {
+    // carry into 10 exact 52-bit limbs (a*b < 2^512 < 2^520, so the
+    // chain terminates with no residue past limb 9), then fold: limb
+    // (5+k) has weight 2^(260+52k) == 16C * 2^(52k).  lo[5+k]*C16 <=
+    // 2^52 * 2^41 = 2^93, so t fits u128; the fold carry stays <= 2^41.
+    u64 lo[10];
+    u128 carry = 0;
+    for (int i = 0; i < 10; i++) {
+        carry += acc[i];
+        lo[i] = (u64)carry & M52;
+        carry >>= 52;
+    }
+    u64 res[6] = {lo[0], lo[1], lo[2], lo[3], lo[4], 0};
+    u64 cc = 0;
+    for (int k = 0; k < 5; k++) {
+        u128 t = (u128)lo[5 + k] * C16 + res[k] + cc;
+        res[k] = (u64)t & M52;
+        cc = (u64)(t >> 52);
+    }
+    res[5] = cc;                          // weight 2^260 again, <= 2^41
+    u128 t2 = (u128)res[5] * C16 + res[0];
+    res[0] = (u64)t2 & M52;
+    res[1] += (u64)(t2 >> 52);            // <= 2^30 extra: no overflow
+    fe out = {{res[0], res[1], res[2], res[3], res[4]}};
+    fe_carry_weak(out);
+    r = out;
+}
+
+static void fe_mul(fe& r, const fe& a, const fe& b) {
+    u128 acc[10] = {0};
+    for (int i = 0; i < 5; i++)
+        for (int j = 0; j < 5; j++)
+            acc[i + j] += (u128)a.v[i] * b.v[j];
+    _fold10(acc, r);
+}
+
+static inline void fe_sq(fe& r, const fe& a) {
+    u64 a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3], a4 = a.v[4];
+    u128 acc[10];
+    acc[0] = (u128)a0 * a0;
+    acc[1] = (u128)(2 * a0) * a1;
+    acc[2] = (u128)(2 * a0) * a2 + (u128)a1 * a1;
+    acc[3] = (u128)(2 * a0) * a3 + (u128)(2 * a1) * a2;
+    acc[4] = (u128)(2 * a0) * a4 + (u128)(2 * a1) * a3 + (u128)a2 * a2;
+    acc[5] = (u128)(2 * a1) * a4 + (u128)(2 * a2) * a3;
+    acc[6] = (u128)(2 * a2) * a4 + (u128)a3 * a3;
+    acc[7] = (u128)(2 * a3) * a4;
+    acc[8] = (u128)a4 * a4;
+    acc[9] = 0;
+    _fold10(acc, r);
+}
+
+static void fe_frombytes(fe& r, const u8 s[32]) {
+    // big-endian 32 bytes -> 5x52 limbs
+    u64 w[4];
+    for (int i = 0; i < 4; i++) {
+        w[i] = 0;
+        for (int j = 0; j < 8; j++)
+            w[i] = (w[i] << 8) | s[8 * i + j];   // w[0] = most significant
+    }
+    u64 w0 = w[3], w1 = w[2], w2 = w[1], w3 = w[0];   // little-endian now
+    r.v[0] = w0 & M52;
+    r.v[1] = ((w0 >> 52) | (w1 << 12)) & M52;
+    r.v[2] = ((w1 >> 40) | (w2 << 24)) & M52;
+    r.v[3] = ((w2 >> 28) | (w3 << 36)) & M52;
+    r.v[4] = w3 >> 16;
+    fe_carry_weak(r);
+}
+
+static void fe_tobytes(u8 s[32], const fe& a) {
+    fe t = a;
+    fe_carry_weak(t);
+    fe_carry_weak(t);
+    // canonical: add C and check overflow of 2^256 (t >= p iff t + C
+    // carries past bit 256, with C = 2^32 + 977)
+    u64 c0 = C16 >> 4;
+    u64 q = (t.v[0] + c0) >> 52;
+    q = (t.v[1] + q) >> 52;
+    q = (t.v[2] + q) >> 52;
+    q = (t.v[3] + q) >> 52;
+    q = (t.v[4] + q) >> 48;              // top limb holds 48 bits
+    // if q: t -= p  (equivalently t = t + C, dropping bit 256)
+    if (q) {
+        u128 tt = (u128)t.v[0] + c0;
+        t.v[0] = (u64)tt & M52;
+        u64 cc = (u64)(tt >> 52);
+        for (int i = 1; i < 5; i++) {
+            u64 s2 = t.v[i] + cc;
+            cc = s2 >> 52;
+            t.v[i] = s2 & M52;
+        }
+        t.v[4] &= (1ULL << 48) - 1;      // drop 2^256
+    }
+    u64 w0 = t.v[0] | (t.v[1] << 52);
+    u64 w1 = (t.v[1] >> 12) | (t.v[2] << 40);
+    u64 w2 = (t.v[2] >> 24) | (t.v[3] << 28);
+    u64 w3 = (t.v[3] >> 36) | (t.v[4] << 16);
+    u64 w[4] = {w3, w2, w1, w0};         // big-endian order
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            s[8 * i + j] = (u8)(w[i] >> (56 - 8 * j));
+}
+
+static bool fe_iszero(const fe& a) {
+    u8 b[32];
+    fe_tobytes(b, a);
+    u8 acc = 0;
+    for (int i = 0; i < 32; i++) acc |= b[i];
+    return acc == 0;
+}
+
+static bool fe_equal(const fe& a, const fe& b) {
+    fe d;
+    fe_sub(d, a, b);
+    return fe_iszero(d);
+}
+
+static bool fe_isodd(const fe& a) {
+    u8 b[32];
+    fe_tobytes(b, a);
+    return b[31] & 1;
+}
+
+// generic pow over a big-endian 32-byte exponent (fixed public exponents)
+static void fe_pow(fe& r, const fe& a, const u8 exp[32]) {
+    fe acc;
+    bool started = false;
+    for (int byte = 0; byte < 32; byte++) {
+        for (int bit = 7; bit >= 0; bit--) {
+            if (started) fe_sq(acc, acc);
+            if ((exp[byte] >> bit) & 1) {
+                if (started) fe_mul(acc, acc, a);
+                else { acc = a; started = true; }
+            }
+        }
+    }
+    r = acc;
+}
+
+static void fe_invert(fe& r, const fe& a) {
+    // p - 2, big-endian
+    static const u8 e[32] = {
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xfe, 0xff, 0xff, 0xfc, 0x2d};
+    fe_pow(r, a, e);
+}
+
+static bool fe_sqrt(fe& r, const fe& a) {
+    // p == 3 (mod 4): candidate = a^((p+1)/4); verify square
+    static const u8 e[32] = {
+        0x3f, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
+        0xff, 0xff, 0xff, 0xff, 0xbf, 0xff, 0xff, 0x0c};
+    fe cand, chk;
+    fe_pow(cand, a, e);
+    fe_sq(chk, cand);
+    if (!fe_equal(chk, a)) return false;
+    r = cand;
+    return true;
+}
+
+// -------------------------------------------------------------- scalars mod n
+
+static const u64 SC_N[4] = {0xbfd25e8cd0364141ULL, 0xbaaedce6af48a03bULL,
+                            0xfffffffffffffffeULL, 0xffffffffffffffffULL};
+static const u64 SC_HALF_N[4] = {0xdfe92f46681b20a0ULL,
+                                 0x5d576e7357a4501dULL,
+                                 0xffffffffffffffffULL,
+                                 0x7fffffffffffffffULL};
+static const u64 SC_MU[5] = {0x402da1732fc9bec0ULL, 0x4551231950b75fc4ULL,
+                             0x1ULL, 0x0ULL, 0x1ULL};
+
+struct sc { u64 v[4]; };
+
+static inline int sc_geq(const u64 a[4], const u64 b[4]) {
+    for (int i = 3; i >= 0; i--) {
+        if (a[i] > b[i]) return 1;
+        if (a[i] < b[i]) return 0;
+    }
+    return 1;
+}
+
+static inline bool sc_iszero(const sc& a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+static void sc_reduce512(sc& r, const u64 x[8]) {
+    u64 prod[13] = {0};
+    for (int i = 0; i < 8; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < 5; j++) {
+            u128 t = (u128)x[i] * SC_MU[j] + prod[i + j] + carry;
+            prod[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        prod[i + 5] += carry;
+    }
+    u64 q[5];
+    for (int i = 0; i < 5; i++) q[i] = prod[8 + i];
+    u64 ql[8] = {0};
+    for (int i = 0; i < 5; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < 4 && i + j < 8; j++) {
+            u128 t = (u128)q[i] * SC_N[j] + ql[i + j] + carry;
+            ql[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        if (i + 4 < 8) ql[i + 4] += carry;
+    }
+    u64 rem[8];
+    u64 borrow = 0;
+    for (int i = 0; i < 8; i++) {
+        u64 bi = ql[i] + borrow;
+        borrow = (bi < borrow) ? 1 : (x[i] < bi ? 1 : 0);
+        rem[i] = x[i] - bi;
+    }
+    for (int k = 0; k < 3; k++)
+        if (rem[4] | rem[5] | rem[6] | rem[7] || sc_geq(rem, SC_N)) {
+            u64 borrow2 = 0;
+            for (int i = 0; i < 8; i++) {
+                u64 bi = (i < 4 ? SC_N[i] : 0) + borrow2;
+                borrow2 = (bi < borrow2) ? 1 : (rem[i] < bi ? 1 : 0);
+                rem[i] = rem[i] - bi;
+            }
+        }
+    for (int i = 0; i < 4; i++) r.v[i] = rem[i];
+}
+
+static void sc_mul(sc& r, const sc& a, const sc& b) {
+    u64 prod[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)a.v[i] * b.v[j] + prod[i + j] + carry;
+            prod[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        prod[i + 4] = carry;
+    }
+    sc_reduce512(r, prod);
+}
+
+// load 32 big-endian bytes; true if the value is in [1, n)
+static bool sc_from_bytes_checked(sc& r, const u8 b[32]) {
+    for (int i = 0; i < 4; i++) {
+        r.v[i] = 0;
+        for (int j = 0; j < 8; j++)
+            r.v[i] = (r.v[i] << 8) | b[(3 - i) * 8 + j];
+    }
+    return !sc_iszero(r) && !sc_geq(r.v, SC_N);
+}
+
+static void sc_from_hash(sc& r, const u8 b[32]) {
+    u64 x[8] = {0};
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            x[i] = (x[i] << 8) | b[(3 - i) * 8 + j];
+    sc_reduce512(r, x);
+}
+
+// 256-bit helpers for the inversion (variable-time is fine: ECDSA
+// verification handles only public values)
+static inline bool u256_iszero(const u64 a[4]) {
+    return (a[0] | a[1] | a[2] | a[3]) == 0;
+}
+
+static inline bool u256_iseven(const u64 a[4]) { return !(a[0] & 1); }
+
+static inline void u256_rshift1(u64 a[4]) {
+    a[0] = (a[0] >> 1) | (a[1] << 63);
+    a[1] = (a[1] >> 1) | (a[2] << 63);
+    a[2] = (a[2] >> 1) | (a[3] << 63);
+    a[3] >>= 1;
+}
+
+static inline u64 u256_add(u64 r[4], const u64 a[4], const u64 b[4]) {
+    u128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (u128)a[i] + b[i];
+        r[i] = (u64)c;
+        c >>= 64;
+    }
+    return (u64)c;
+}
+
+static inline void u256_sub(u64 r[4], const u64 a[4], const u64 b[4]) {
+    u64 borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u64 bi = b[i] + borrow;
+        borrow = (bi < borrow) ? 1 : (a[i] < bi ? 1 : 0);
+        r[i] = a[i] - bi;
+    }
+}
+
+static void sc_invert(sc& r, const sc& a) {
+    // binary extended gcd mod n (~15x faster than the Fermat ladder of
+    // Barrett multiplications; gcd(a, n) == 1 since n is prime)
+    u64 u[4] = {a.v[0], a.v[1], a.v[2], a.v[3]};
+    u64 v[4] = {SC_N[0], SC_N[1], SC_N[2], SC_N[3]};
+    u64 x1[4] = {1, 0, 0, 0};
+    u64 x2[4] = {0, 0, 0, 0};
+    while (!u256_iszero(u) && !u256_iszero(v)) {
+        while (u256_iseven(u)) {
+            u256_rshift1(u);
+            if (u256_iseven(x1)) {
+                u256_rshift1(x1);
+            } else {
+                u64 carry = u256_add(x1, x1, SC_N);
+                u256_rshift1(x1);
+                x1[3] |= carry << 63;
+            }
+        }
+        while (u256_iseven(v)) {
+            u256_rshift1(v);
+            if (u256_iseven(x2)) {
+                u256_rshift1(x2);
+            } else {
+                u64 carry = u256_add(x2, x2, SC_N);
+                u256_rshift1(x2);
+                x2[3] |= carry << 63;
+            }
+        }
+        if (sc_geq(u, v)) {
+            u256_sub(u, u, v);
+            // x1 = (x1 - x2) mod n
+            if (sc_geq(x1, x2)) {
+                u256_sub(x1, x1, x2);
+            } else {
+                u64 t[4];
+                u256_sub(t, x2, x1);
+                u256_sub(x1, SC_N, t);
+            }
+        } else {
+            u256_sub(v, v, u);
+            if (sc_geq(x2, x1)) {
+                u256_sub(x2, x2, x1);
+            } else {
+                u64 t[4];
+                u256_sub(t, x1, x2);
+                u256_sub(x2, SC_N, t);
+            }
+        }
+    }
+    const u64* out = u256_iszero(u) ? x2 : x1;
+    for (int i = 0; i < 4; i++) r.v[i] = out[i];
+}
+
+static inline int sc_window(const sc& a, int pos, int width) {
+    int word = pos >> 6, shift = pos & 63;
+    u64 w = a.v[word] >> shift;
+    if (shift + width > 64 && word + 1 < 4)
+        w |= a.v[word + 1] << (64 - shift);
+    return (int)(w & ((1ULL << width) - 1));
+}
+
+// ---------------------------------------------------- points (Jacobian, a=0)
+
+struct ge { fe X, Y, Z; bool inf; };
+
+static const ge GE_INF = {{{0}}, {{0}}, {{0}}, true};
+
+static void ge_double(ge& r, const ge& p) {
+    if (p.inf) { r = p; return; }
+    // y = 0 cannot happen on y^2 = x^3 + 7 (would need x^3 = -7, and
+    // such points have y=0 only if on curve; handle defensively)
+    if (fe_iszero(p.Y)) { r = GE_INF; return; }
+    fe A, B, Cc, D, X3, Y3, Z3, t;
+    fe_sq(A, p.X);                       // A = X^2
+    fe_sq(B, p.Y);                       // B = Y^2
+    fe_sq(Cc, B);                        // C = B^2
+    fe_add(t, p.X, B);
+    fe_sq(t, t);
+    fe_sub(t, t, A);
+    fe_sub(t, t, Cc);
+    fe_add(D, t, t);                     // D = 2((X+B)^2 - A - C)
+    fe M;
+    fe_add(M, A, A);
+    fe_add(M, M, A);                     // M = 3A (a = 0)
+    fe_sq(X3, M);
+    fe_sub(X3, X3, D);
+    fe_sub(X3, X3, D);                   // X3 = M^2 - 2D
+    fe c8;
+    fe_add(c8, Cc, Cc);
+    fe_add(c8, c8, c8);
+    fe_add(c8, c8, c8);                  // 8C
+    fe_sub(Y3, D, X3);
+    fe_mul(Y3, M, Y3);
+    fe_sub(Y3, Y3, c8);                  // Y3 = M(D - X3) - 8C
+    fe_mul(Z3, p.Y, p.Z);
+    fe_add(Z3, Z3, Z3);                  // Z3 = 2YZ
+    r.X = X3; r.Y = Y3; r.Z = Z3; r.inf = false;
+}
+
+static void ge_add(ge& r, const ge& p, const ge& q) {
+    if (p.inf) { r = q; return; }
+    if (q.inf) { r = p; return; }
+    fe Z1Z1, Z2Z2, U1, U2, S1, S2, H, Rr, t;
+    fe_sq(Z1Z1, p.Z);
+    fe_sq(Z2Z2, q.Z);
+    fe_mul(U1, p.X, Z2Z2);
+    fe_mul(U2, q.X, Z1Z1);
+    fe_mul(S1, p.Y, q.Z);
+    fe_mul(S1, S1, Z2Z2);
+    fe_mul(S2, q.Y, p.Z);
+    fe_mul(S2, S2, Z1Z1);
+    fe_sub(H, U2, U1);
+    fe_sub(Rr, S2, S1);
+    if (fe_iszero(H)) {
+        if (fe_iszero(Rr)) { ge_double(r, p); return; }
+        r = GE_INF;                      // P + (-P)
+        return;
+    }
+    fe HH, HHH, V, X3, Y3, Z3;
+    fe_sq(HH, H);
+    fe_mul(HHH, HH, H);
+    fe_mul(V, U1, HH);
+    fe_sq(X3, Rr);
+    fe_sub(X3, X3, HHH);
+    fe_sub(X3, X3, V);
+    fe_sub(X3, X3, V);                   // X3 = R^2 - HHH - 2V
+    fe_sub(t, V, X3);
+    fe_mul(Y3, Rr, t);
+    fe_mul(t, S1, HHH);
+    fe_sub(Y3, Y3, t);                   // Y3 = R(V - X3) - S1*HHH
+    fe_mul(Z3, p.Z, q.Z);
+    fe_mul(Z3, Z3, H);                   // Z3 = Z1 Z2 H
+    r.X = X3; r.Y = Y3; r.Z = Z3; r.inf = false;
+}
+
+static bool ge_decompress(ge& r, const u8 pub[33]) {
+    if (pub[0] != 0x02 && pub[0] != 0x03) return false;
+    fe x, y2, y;
+    // reject non-canonical x (>= p): round-trip the bytes
+    fe_frombytes(x, pub + 1);
+    u8 chk[32];
+    fe_tobytes(chk, x);
+    if (memcmp(chk, pub + 1, 32) != 0) return false;
+    fe_sq(y2, x);
+    fe_mul(y2, y2, x);
+    fe_add(y2, y2, FE_SEVEN);            // y^2 = x^3 + 7
+    if (!fe_sqrt(y, y2)) return false;
+    if (fe_isodd(y) != (pub[0] == 0x03)) {
+        fe zero = {{0, 0, 0, 0, 0}};
+        fe_sub(y, zero, y);
+    }
+    r.X = x; r.Y = y;
+    r.Z.v[0] = 1; r.Z.v[1] = r.Z.v[2] = r.Z.v[3] = r.Z.v[4] = 0;
+    r.inf = false;
+    return true;
+}
+
+// ------------------------------------------------------------- verification
+
+// 4-bit base-point window, built once at library load (dlopen runs
+// initializers single-threaded, so no init race across ctypes calls)
+static ge G_TAB[16];
+static const bool _gtab_ready = []() {
+    G_TAB[0] = GE_INF;
+    G_TAB[1].X = GX;
+    G_TAB[1].Y = GY;
+    G_TAB[1].Z = {{1, 0, 0, 0, 0}};
+    G_TAB[1].inf = false;
+    for (int i = 2; i < 16; i++) ge_add(G_TAB[i], G_TAB[i - 1], G_TAB[1]);
+    return true;
+}();
+
+extern "C" {
+
+// 1 = valid, 0 = invalid.  pub: 33-byte compressed SEC1; sig: r||s
+// big-endian, low-s enforced; e = SHA-256(msg) mod n.
+int secp256k1_verify(const u8* pub, const u8* sig, const u8* msg,
+                     u64 msg_len) {
+    sc r_s, s_s;
+    if (!sc_from_bytes_checked(r_s, sig)) return 0;
+    if (!sc_from_bytes_checked(s_s, sig + 32)) return 0;
+    if (sc_geq(s_s.v, SC_HALF_N) && !(s_s.v[0] == SC_HALF_N[0]
+        && s_s.v[1] == SC_HALF_N[1] && s_s.v[2] == SC_HALF_N[2]
+        && s_s.v[3] == SC_HALF_N[3])) {
+        // s > n/2: reject malleable signatures (matches the Python
+        // seam's low-s rule; s == n/2 itself is allowed)
+        return 0;
+    }
+    ge Q;
+    if (!ge_decompress(Q, pub)) return 0;
+
+    u8 h[32];
+    sha256(msg, msg_len, h);
+    sc e, w, u1, u2;
+    sc_from_hash(e, h);
+    sc_invert(w, s_s);
+    sc_mul(u1, e, w);
+    sc_mul(u2, r_s, w);
+
+    // Shamir joint ladder: 4-bit windows over u1 (static G table) and
+    // u2 (per-verify Q table)
+    ge qt[16];
+    qt[0] = GE_INF;
+    qt[1] = Q;
+    for (int i = 2; i < 16; i++) ge_add(qt[i], qt[i - 1], Q);
+
+    ge acc = GE_INF;
+    for (int wdx = 63; wdx >= 0; wdx--) {
+        for (int k = 0; k < 4; k++) ge_double(acc, acc);
+        int d1 = sc_window(u1, 4 * wdx, 4);
+        if (d1) ge_add(acc, acc, G_TAB[d1]);
+        int d2 = sc_window(u2, 4 * wdx, 4);
+        if (d2) ge_add(acc, acc, qt[d2]);
+    }
+    if (acc.inf) return 0;
+
+    // R.x mod n == r  (affine x = X / Z^2)
+    fe zinv, zinv2, xa;
+    fe_invert(zinv, acc.Z);
+    fe_sq(zinv2, zinv);
+    fe_mul(xa, acc.X, zinv2);
+    u8 xb[32];
+    fe_tobytes(xb, xa);
+    sc xs;
+    u64 xw[8] = {0};
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            xw[i] = (xw[i] << 8) | xb[(3 - i) * 8 + j];
+    sc_reduce512(xs, xw);
+    return (xs.v[0] == r_s.v[0] && xs.v[1] == r_s.v[1]
+            && xs.v[2] == r_s.v[2] && xs.v[3] == r_s.v[3]) ? 1 : 0;
+}
+
+}  // extern "C"

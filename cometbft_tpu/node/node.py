@@ -391,12 +391,14 @@ class Node:
             cryptobatch.set_device_wait(self.config.base.device_wait_s)
 
         def _warm_native():
-            # build/load the C++ RLC batch verifier off the event loop so
-            # a fresh checkout's first commit verification doesn't eat a
+            # build/load the C++ verifiers off the event loop so a fresh
+            # checkout's first commit verification doesn't eat a
             # multi-second g++ compile on the consensus hot path
             from ..crypto import _native_ed25519 as nat
+            from ..crypto import secp256k1 as secp
 
             nat.available()
+            secp._native_lib()
 
         asyncio.get_running_loop().run_in_executor(None, _warm_native)
         if self.config.base.device_warmup and \

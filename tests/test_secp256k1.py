@@ -162,3 +162,61 @@ def test_bls_validator_backend_guard(monkeypatch):
         doc.validate_and_complete()
     monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
     doc.validate_and_complete()              # explicit opt-in unblocks
+
+
+def test_native_secp256k1_matches_openssl_oracle():
+    """native/secp256k1.cpp differential: valid, tampered, malleable
+    (high-s), boundary r/s, and malformed-pubkey cases must all agree
+    with the OpenSSL-backed path."""
+    import random
+    import secrets
+
+    from cometbft_tpu.crypto import secp256k1 as s
+
+    lib = s._native_lib()
+    assert lib is not None, "native secp256k1 must build on this image"
+
+    def oracle(pub, m, sig):
+        """The full python path with native disabled (OpenSSL oracle)."""
+        import unittest.mock as mock
+
+        with mock.patch.object(s, "_native_lib", lambda: None):
+            return pub.verify_signature(m, sig)
+
+    random.seed(5)
+    for i in range(25):
+        sk = s.Secp256k1PrivKey.from_secret(b"n%d" % i)
+        pub = sk.pub_key()
+        m = secrets.token_bytes(random.randrange(0, 150))
+        sig = sk.sign(m)
+        assert s._native_verify(pub.bytes(), m, sig) is True
+        bad = bytearray(sig)
+        bad[random.randrange(64)] ^= 1
+        assert s._native_verify(pub.bytes(), m, bytes(bad)) == \
+            oracle(pub, m, bytes(bad))
+
+    # regression: this key's sqrt-candidate negation underflowed the old
+    # 2p subtraction bias, making native reject a VALID signature (a
+    # consensus divergence between native and fallback nodes)
+    sk = s.Secp256k1PrivKey.from_secret(b"probe204524")
+    m = b"underflow-probe"
+    sig = sk.sign(m)
+    assert oracle(sk.pub_key(), m, sig) is True
+    assert s._native_verify(sk.pub_key().bytes(), m, sig) is True
+
+    sk = s.Secp256k1PrivKey.from_secret(b"edge")
+    pub, m = sk.pub_key().bytes(), b"edge-msg"
+    sig = sk.sign(m)
+    r = int.from_bytes(sig[:32], "big")
+    sval = int.from_bytes(sig[32:], "big")
+    # high-s (malleable) flip must be rejected
+    flipped = sig[:32] + (s._N - sval).to_bytes(32, "big")
+    assert s._native_verify(pub, m, flipped) is False
+    # r/s out of range
+    assert s._native_verify(pub, m, b"\x00" * 32 + sig[32:]) is False
+    assert s._native_verify(
+        pub, m, s._N.to_bytes(32, "big") + sig[32:]) is False
+    # x coordinate >= p in the pubkey encoding
+    P = 2**256 - 2**32 - 977
+    assert s._native_verify(
+        b"\x02" + P.to_bytes(32, "big"), m, sig) is False
