@@ -251,8 +251,108 @@ def _child_stress(backend: str, n_vals: int, secp_pct: int) -> None:
     }), flush=True)
 
 
+def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
+    """Single-node end-to-end throughput: one validator committing load
+    txs through the FULL stack (RPC -> mempool -> consensus -> ABCI
+    kvstore -> storage).  Reference baseline: ~700-723 tx/s single-node
+    (docs/references/storage/README.md:193)."""
+    import shutil
+    import tempfile
+
+    def note(msg):
+        print(f"[bench:node] {msg}", file=sys.stderr, flush=True)
+
+    base = tempfile.mkdtemp(prefix="bench-node-")
+    home = os.path.join(base, "n0")
+    try:
+        from cometbft_tpu import loadtime
+        from cometbft_tpu.config import test_consensus_config
+        from cometbft_tpu.e2e.gen import HomeSpec, generate_homes
+        from cometbft_tpu.rpc import HTTPClient
+
+        rpc_port = int(os.environ.get("BENCH_NODE_RPC", "28657"))
+
+        def tweak(spec, cfg):
+            cfg.base.signature_backend = "cpu"
+            cfg.consensus = test_consensus_config()
+            cfg.mempool.size = 20000
+
+        generate_homes(base, [HomeSpec(name="n0", p2p_port=rpc_port - 1,
+                                       rpc_port=rpc_port, power=10)],
+                       "bench-node", tweak=tweak)
+        note("starting node process")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        with open(os.path.join(base, "node.log"), "ab") as lf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu", "--home", home,
+                 "start"], stdout=lf, stderr=subprocess.STDOUT, env=env,
+                cwd=REPO)
+        try:
+            import asyncio
+
+            async def drive():
+                cli = HTTPClient("127.0.0.1", rpc_port)
+                for _ in range(120):           # wait for RPC
+                    try:
+                        st = await cli.call("status")
+                        if st["node_info"]["network"] != "bench-node":
+                            # a STALE node from another run holds the
+                            # port: driving it would record a bogus 0
+                            raise RuntimeError(
+                                f"port {rpc_port} is serving chain "
+                                f"{st['node_info']['network']!r}, not "
+                                f"the bench node")
+                        break
+                    except RuntimeError:
+                        raise
+                    except Exception:
+                        await asyncio.sleep(0.25)
+                else:
+                    raise RuntimeError(
+                        "bench node RPC never came up (see node.log)")
+                conns = int(os.environ.get("BENCH_NODE_CONNS", "8"))
+                note(f"driving {rate:.0f} tx/s for {duration_s:.0f}s "
+                     f"({tx_size}B txs, {conns} connections)")
+                gen = await loadtime.generate(cli, rate, duration_s,
+                                              tx_size=tx_size,
+                                              connections=conns)
+                await asyncio.sleep(2.0)       # let the tail commit
+                rep = await loadtime.report(cli, run_id=gen["run_id"])
+                return gen, rep
+
+            gen, rep = asyncio.run(drive())
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        tput = rep.get("throughput_tx_s") or 0.0
+        print(json.dumps({
+            "metric": f"single-node end-to-end throughput "
+                      f"({tx_size}B txs, builtin kvstore)",
+            "value": tput,
+            "unit": "tx/s",
+            # reference storage study: ~700 tx/s single node
+            "vs_baseline": round(tput / 700.0, 2),
+            "sent": gen["sent"],
+            "send_errors": gen["errors"],
+            "committed": rep.get("txs", 0),
+            "p50_latency_s": rep.get("p50_s"),
+            "p99_latency_s": rep.get("p99_s"),
+            "blocks": rep.get("blocks"),
+            "backend": "cpu",
+        }), flush=True)
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
+    if mode == "node":
+        return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
+                           float(os.environ.get("BENCH_DURATION", "20")),
+                           int(os.environ.get("BENCH_TX_SIZE", "256")))
     if mode == "light":
         return _child_light(backend,
                             int(os.environ.get("BENCH_HEADERS", "1000")),
@@ -436,6 +536,11 @@ def main() -> None:
 
     platforms = os.environ.get("JAX_PLATFORMS", "")
     want_tpu = ("cpu" != platforms.strip().lower())
+    if os.environ.get("BENCH_MODE") == "node":
+        # the node child hard-forces CPU (the full-stack throughput
+        # measurement has no device leg): skip the accelerator probe
+        # and the redundant tpu-labeled attempt
+        want_tpu = False
 
     if want_tpu:
         # cheap pre-probe: when the accelerator relay is wedged, backend
@@ -489,6 +594,7 @@ def main() -> None:
         "blocksync": ("blocksync replay, blocks/sec", "blocks/s"),
         "verifycommit": ("VerifyCommitLight latency", "ms"),
         "stress": ("mixed-key extended-commit verify", "sigs/s"),
+        "node": ("single-node end-to-end throughput", "tx/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

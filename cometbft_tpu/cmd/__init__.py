@@ -448,7 +448,8 @@ def cmd_load(args) -> int:
     async def go():
         client = _rpc_client(args.rpc)
         out = await loadtime.generate(client, args.rate, args.duration,
-                                      tx_size=args.size)
+                                      tx_size=args.size,
+                                      connections=args.connections)
         print(json.dumps(out))
 
     asyncio.run(go())
@@ -876,6 +877,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--rate", type=float, default=100.0, help="tx/s")
     sp.add_argument("--duration", type=float, default=10.0, help="seconds")
     sp.add_argument("--size", type=int, default=256, help="tx bytes")
+    sp.add_argument("--connections", type=int, default=1,
+                    help="concurrent sender loops splitting the rate "
+                         "(loadtime's -c; one serial loop caps ~600 tx/s)")
     sp.set_defaults(fn=cmd_load)
 
     sp = sub.add_parser("load-report",

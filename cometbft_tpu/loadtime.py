@@ -44,27 +44,40 @@ def parse_load_tx(tx: bytes) -> tuple[str, int, int] | None:
 
 async def generate(client, rate: float, duration_s: float,
                    tx_size: int = 256, run_id: str | None = None,
-                   broadcast: str = "broadcast_tx_async") -> dict:
+                   broadcast: str = "broadcast_tx_async",
+                   connections: int = 1) -> dict:
     """Drive ``rate`` tx/s at a node for ``duration_s`` through the RPC
-    client (loadtime's generator loop, minus the UUID machinery)."""
+    client (loadtime's generator loop, minus the UUID machinery).
+
+    ``connections`` runs that many concurrent sender loops splitting the
+    rate (loadtime's `-c` flag): one serial HTTP round-trip per tx caps
+    a single loop at ~600 tx/s, which under-drives a saturation
+    measurement."""
     run_id = run_id or format(int(time.time()) & 0xFFFFFF, "x")
-    interval = 1.0 / rate
-    sent = errors = 0
-    t_end = time.monotonic() + duration_s
-    next_at = time.monotonic()
-    while time.monotonic() < t_end:
-        tx = make_load_tx(run_id, sent, tx_size)
-        try:
-            await client.call(broadcast, tx=tx.hex())
-            sent += 1
-        except Exception:
-            errors += 1
-        next_at += interval
-        delay = next_at - time.monotonic()
-        if delay > 0:
-            await asyncio.sleep(delay)
-    return {"run_id": run_id, "sent": sent, "errors": errors,
-            "rate": rate, "duration_s": duration_s}
+    counters = {"sent": 0, "errors": 0}
+    seq = iter(range(1 << 62))
+
+    async def worker(worker_rate: float):
+        interval = 1.0 / worker_rate
+        t_end = time.monotonic() + duration_s
+        next_at = time.monotonic()
+        while time.monotonic() < t_end:
+            tx = make_load_tx(run_id, next(seq), tx_size)
+            try:
+                await client.call(broadcast, tx=tx.hex())
+                counters["sent"] += 1
+            except Exception:
+                counters["errors"] += 1
+            next_at += interval
+            delay = next_at - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+
+    n = max(1, int(connections))
+    await asyncio.gather(*(worker(rate / n) for _ in range(n)))
+    return {"run_id": run_id, "sent": counters["sent"],
+            "errors": counters["errors"], "rate": rate,
+            "duration_s": duration_s, "connections": n}
 
 
 async def report(client, run_id: str | None = None,
