@@ -21,6 +21,7 @@
 #include <cstring>
 #include <cstdlib>
 #include <vector>
+#include <mutex>
 
 typedef uint64_t u64;
 typedef unsigned __int128 u128;
@@ -663,6 +664,52 @@ static void hash_ram(sc& h, const u8 rbytes[32], const u8 pub[32],
     sc_from_bytes64(h, out);
 }
 
+// Decompressed-pubkey cache: validator sets are ~static across heights,
+// so the SAME A points decompress every commit; R points are unique per
+// signature and never cached.  Open-addressed, bounded, guarded by a
+// mutex (ctypes releases the GIL, so concurrent batch calls are real).
+// The analogue of the reference's expanded-pubkey cache
+// (crypto/ed25519/ed25519.go:42-67, cacheSize 4096).
+static const u64 A_CACHE_SLOTS = 8192;       // power of two
+struct ACacheEntry { u8 pub[32]; ge point; bool used; };
+static ACacheEntry* A_CACHE = nullptr;
+static std::mutex A_CACHE_MU;
+
+static inline u64 pub_hash(const u8* pub) {
+    u64 h = 1469598103934665603ULL;          // FNV-1a over the 32 bytes
+    for (int i = 0; i < 32; i++) { h ^= pub[i]; h *= 1099511628211ULL; }
+    return h;
+}
+
+// true + point on hit; on miss decompresses (false if invalid) and fills
+// the slot (evict-on-collision: bounded memory, no tombstones).  The
+// mutex guards only the lookup and the insert — the expensive
+// decompression runs OUTSIDE it, so concurrent batch calls serialize on
+// memcpy-sized critical sections, not on field exponentiations.
+static bool a_decompress_cached(ge& out, const u8* pub) {
+    u64 slot = pub_hash(pub) & (A_CACHE_SLOTS - 1);
+    {
+        std::lock_guard<std::mutex> lk(A_CACHE_MU);
+        if (A_CACHE == nullptr)
+            A_CACHE = new ACacheEntry[A_CACHE_SLOTS]();
+        ACacheEntry& e = A_CACHE[slot];
+        if (e.used && memcmp(e.pub, pub, 32) == 0) {
+            out = e.point;
+            return true;
+        }
+    }
+    if (!ge_decompress_zip215(out, pub)) return false;
+    {
+        std::lock_guard<std::mutex> lk(A_CACHE_MU);
+        ACacheEntry& e = A_CACHE[slot];
+        memcpy(e.pub, pub, 32);
+        e.point = out;
+        e.used = true;
+    }
+    return true;
+}
+
+
 extern "C" {
 
 // single ZIP-215 verification; returns 1 (valid) / 0 (invalid)
@@ -711,7 +758,7 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
         sc s;
         if (!sc_from_bytes32_checked(s, sig + 32)) return 0;
         ge A, R;
-        if (!ge_decompress_zip215(A, pub)) return 0;
+        if (!a_decompress_cached(A, pub)) return 0;
         if (!ge_decompress_zip215(R, sig)) return 0;
         sc h;
         const u8* msg = msg_stride ? msgs + i * msg_stride : msgs + msg_off;
