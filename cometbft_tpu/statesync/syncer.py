@@ -46,6 +46,75 @@ class _PendingSnapshot:
         self.peers: list[str] = []
 
 
+class _ChunkStore:
+    """Received-chunk spool (reference: ``statesync/chunks.go`` — chunks
+    land in a temp dir, NOT in memory): a snapshot can be many GB, and
+    out-of-order chunks would otherwise pile up in RAM while the strictly
+    sequential applier waits for the next index.  Dict-shaped so the
+    syncer reads naturally; senders stay in a small in-memory map."""
+
+    def __init__(self):
+        self._dir: str | None = None     # created on first write
+        self._senders: dict[int, str] = {}
+        self._closed = False             # late async writes must not
+        #   resurrect the spool dir after close()
+
+    def _path(self, idx: int) -> str:
+        import os
+        import tempfile
+
+        if self._dir is None:
+            self._dir = tempfile.mkdtemp(prefix="statesync-chunks-")
+        return os.path.join(self._dir, f"{idx}.chunk")
+
+    def __contains__(self, idx: int) -> bool:
+        return idx in self._senders
+
+    def __setitem__(self, idx: int, value) -> None:
+        import os
+
+        if self._closed:
+            return
+        data, sender = value
+        tmp = self._path(idx) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._path(idx))
+        self._senders[idx] = sender
+
+    def __getitem__(self, idx: int):
+        with open(self._path(idx), "rb") as f:
+            return f.read(), self._senders[idx]
+
+    def pop(self, idx: int, default=None):
+        import os
+
+        if idx not in self._senders:
+            return default
+        sender = self._senders.pop(idx)
+        try:
+            os.remove(self._path(idx))
+        except OSError:
+            pass
+        return sender
+
+    def indices_from(self, sender: str) -> list[int]:
+        return [i for i, s in self._senders.items() if s == sender]
+
+    def clear(self) -> None:
+        for idx in list(self._senders):
+            self.pop(idx)
+
+    def close(self) -> None:
+        import shutil
+
+        self._closed = True
+        self.clear()
+        if self._dir is not None:
+            shutil.rmtree(self._dir, ignore_errors=True)
+            self._dir = None
+
+
 class Syncer:
     def __init__(self, app_conns, state_provider: StateProvider,
                  reactor=None, name: str = "syncer"):
@@ -54,7 +123,7 @@ class Syncer:
         self.reactor = reactor
         self.log = tmlog.logger("statesync", node=name)
         self._snapshots: dict[tuple, _PendingSnapshot] = {}
-        self._chunks: dict[int, tuple[bytes, str]] = {}  # idx -> (data, sender)
+        self._chunks = _ChunkStore()     # idx -> (data, sender), on disk
         self._banned: set[str] = set()   # app-rejected senders
         self._chunk_event = asyncio.Event()
         self._current = None
@@ -78,10 +147,30 @@ class Syncer:
                 cur.snapshot.format != format_ or \
                 snapshot_hash != cur.snapshot.hash:
             return      # stale response from another snapshot: drop
+        # the index comes off the WIRE and becomes a spool filename:
+        # anything but an in-range int is malicious or corrupt
+        if not isinstance(index, int) or isinstance(index, bool) or \
+                not 0 <= index < cur.snapshot.chunks:
+            self.log.warn("dropping chunk with invalid index",
+                          peer=peer_id[:8], index=repr(index)[:40])
+            return
         if peer_id in self._banned:
             return      # late delivery from a sender the app rejected
-        self._chunks[index] = (chunk, peer_id)
-        self._chunk_event.set()
+        if not isinstance(chunk, (bytes, bytearray)):
+            return
+        # spool write off the event loop: a multi-GB snapshot's chunks
+        # must not stall consensus/p2p on disk IO.  The store ref is
+        # captured so a write landing after a snapshot switch goes to the
+        # (closed, write-refusing) OLD store, never the new one.
+        store = self._chunks
+
+        async def _spool():
+            await asyncio.to_thread(
+                store.__setitem__, index, (bytes(chunk), peer_id))
+            if self._chunks is store:
+                self._chunk_event.set()
+
+        asyncio.ensure_future(_spool())
 
     def remove_peer(self, peer_id: str) -> None:
         for pending in self._snapshots.values():
@@ -101,6 +190,17 @@ class Syncer:
         are requested (the reference's retryHook re-requests snapshots
         for the same reason)."""
         rejected_formats: set[int] = set()   # REJECT_FORMAT is final
+        try:
+            return await self._sync_rounds(discovery_time, rounds,
+                                           rejected_formats)
+        finally:
+            # success closed it already (idempotent); this covers the
+            # all-rounds-exhausted raise, whose spool would otherwise
+            # leak GBs in the temp dir for the process lifetime
+            self._chunks.close()
+
+    async def _sync_rounds(self, discovery_time: float, rounds: int,
+                           rejected_formats: set):
         for round_ in range(rounds):
             self._snapshots.clear()
             if self.reactor is not None:
@@ -165,7 +265,8 @@ class Syncer:
             raise StatesyncError(f"app rejected snapshot ({resp})")
 
         self._current = pending
-        self._chunks = {}
+        self._chunks.close()
+        self._chunks = _ChunkStore()
         # NOTE: self._banned persists across snapshots — a sender the
         # app rejected once stays distrusted for the whole sync
         try:
@@ -191,6 +292,7 @@ class Syncer:
             # cannot assemble the post-h state: a retryable condition,
             # not a fatal one
             raise StatesyncError(f"cannot build state at {h}: {e}")
+        self._chunks.close()          # spool dir gone; lazily recreated
         self.log.info("snapshot restored", height=h)
         return state, commit
 
@@ -270,8 +372,7 @@ class Syncer:
                         pending.peers.remove(bad)
                     # chunks.DiscardSender: everything unapplied from the
                     # rejected sender is poisoned
-                    for j in [j for j, (_, s) in self._chunks.items()
-                              if s == bad]:
+                    for j in self._chunks.indices_from(bad):
                         self._chunks.pop(j)
                         requested.pop(j, None)
                     self.log.warn("banned snapshot sender", peer=bad)
@@ -304,6 +405,7 @@ class Syncer:
                         break   # app wants this very chunk again: not
                                 # applied; the outer loop re-requests it
                     applied.add(i)
+                    self._chunks.pop(i)   # applied: free its spool file
                 else:
                     raise StatesyncError(
                         f"app aborted on chunk {i} ({resp.result})")

@@ -369,3 +369,56 @@ def test_concurrent_chunk_fetch_scales_with_peers():
     # jitter; the unscaled ratio would be ~1.0)
     assert t2 < t1 * 0.7, (t1, t2)
     assert t4 < t1 * 0.45, (t1, t4)
+
+
+def test_chunk_store_spools_to_disk(tmp_path, monkeypatch):
+    """Chunks live on disk while awaiting the sequential applier
+    (reference chunks.go), are freed as they apply, and the spool dir is
+    removed after a successful restore."""
+    import os
+    import tempfile
+
+    from cometbft_tpu.statesync.syncer import _ChunkStore
+
+    # pytest owns cleanup even if an assertion below fails mid-test
+    monkeypatch.setattr(tempfile, "tempdir", str(tmp_path))
+    store = _ChunkStore()
+    assert store._dir is None                 # lazy: no dir until a write
+    store[2] = (b"C2" * 100, "p1")
+    store[0] = (b"C0" * 100, "p2")
+    d = store._dir
+    assert d and len(os.listdir(d)) == 2      # bytes live on disk...
+    assert 0 in store and 1 not in store
+    assert store[2] == (b"C2" * 100, "p1")
+    assert store.indices_from("p2") == [0]
+    store.pop(0)
+    assert len(os.listdir(d)) == 1            # ...freed on apply
+    store.close()
+    assert not os.path.exists(d)
+
+
+def test_add_chunk_rejects_malicious_indices():
+    """A chunk index off the wire becomes a spool FILENAME: non-int,
+    negative, out-of-range, and bool indices must all be dropped (path
+    traversal / orphan-file defense)."""
+    from cometbft_tpu.abci.types import Snapshot
+    from cometbft_tpu.statesync.syncer import Syncer, _PendingSnapshot
+
+    async def main():
+        sy = Syncer(app_conns=None, state_provider=None)
+        snap = Snapshot(height=7, format=1, chunks=4, hash=b"\xcd" * 32,
+                        metadata=b"")
+        sy._current = _PendingSnapshot(snap)
+        for bad in ("../../etc/x", -1, 4, 10**9, True, None, 2.0):
+            sy.add_chunk("p", 7, 1, bad, b"data", b"\xcd" * 32)
+        await asyncio.sleep(0.05)      # let any (wrong) spool task land
+        assert sy._chunks._senders == {}
+        assert sy._chunks._dir is None, "a bad index touched the disk"
+        # a GOOD index still spools
+        sy.add_chunk("p", 7, 1, 2, b"data", b"\xcd" * 32)
+        await asyncio.sleep(0.05)
+        assert 2 in sy._chunks
+        sy._chunks.close()
+        return True
+
+    assert run(main())
