@@ -86,10 +86,29 @@ class PexReactor(Reactor):
         if self.seed_mode:
             # harvest the newcomer's book, then hang up shortly: a seed
             # serves addresses, it doesn't hold connections
-            self._requested.add(peer.id)
-            peer.send(PEX_CHANNEL, msgpack.packb({"@": "pex_req"},
-                                                 use_bin_type=True))
+            self._request_addrs(peer)
             self._schedule_hangup(peer)
+        elif self._wants_peers():
+            # under-connected: ask the newcomer for addresses NOW rather
+            # than on the next ensure-peers tick — a crawling seed hangs
+            # up within CRAWL_LINGER, long before a 30s interval fires
+            # (pex_reactor.go sends the first request on peer add too)
+            self._request_addrs(peer)
+
+    def _request_addrs(self, peer) -> None:
+        """Send pex_req AND register the solicitation — receive() drops
+        any pex_res we didn't register (the anti-poisoning gate), so the
+        two must never be separated."""
+        self._requested.add(peer.id)
+        peer.send(PEX_CHANNEL, msgpack.packb({"@": "pex_req"},
+                                             use_bin_type=True))
+
+    def _wants_peers(self) -> bool:
+        sw = self.switch
+        if sw is None:
+            return False
+        outbound = sum(1 for p in sw.peers.values() if p.outbound)
+        return outbound < self.max_outbound
 
     def _schedule_hangup(self, peer) -> None:
         # one timer per peer OBJECT (add_peer fires once per connection);
@@ -167,15 +186,12 @@ class PexReactor(Reactor):
         if sw is None:
             return
         connected = set(sw.peers)
-        outbound = sum(1 for p in sw.peers.values() if p.outbound)
-        if outbound >= self.max_outbound:
+        if not self._wants_peers():
             return
+        outbound = sum(1 for p in sw.peers.values() if p.outbound)
         # ask a random connected peer for more addresses
         if sw.peers:
-            peer = random.choice(list(sw.peers.values()))
-            self._requested.add(peer.id)
-            peer.send(PEX_CHANNEL, msgpack.packb({"@": "pex_req"},
-                                                 use_bin_type=True))
+            self._request_addrs(random.choice(list(sw.peers.values())))
         # dial someone new
         for nid, addr in self.book.pick(connected | self._dialing
                                         | {self.own_id},

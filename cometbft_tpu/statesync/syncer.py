@@ -54,17 +54,19 @@ class _ChunkStore:
     syncer reads naturally; senders stay in a small in-memory map."""
 
     def __init__(self):
+        import threading
+
         self._dir: str | None = None     # created on first write
         self._senders: dict[int, str] = {}
         self._closed = False             # late async writes must not
         #   resurrect the spool dir after close()
+        # guards the closed/dir transitions against writer threads
+        # (spool writes run in asyncio.to_thread)
+        self._mu = threading.Lock()
 
     def _path(self, idx: int) -> str:
         import os
-        import tempfile
 
-        if self._dir is None:
-            self._dir = tempfile.mkdtemp(prefix="statesync-chunks-")
         return os.path.join(self._dir, f"{idx}.chunk")
 
     def __contains__(self, idx: int) -> bool:
@@ -72,30 +74,49 @@ class _ChunkStore:
 
     def __setitem__(self, idx: int, value) -> None:
         import os
+        import tempfile
 
-        if self._closed:
-            return
         data, sender = value
-        tmp = self._path(idx) + ".tmp"
+        with self._mu:
+            if self._closed:
+                return
+            if self._dir is None:
+                self._dir = tempfile.mkdtemp(prefix="statesync-chunks-")
+            tmp = self._path(idx) + ".tmp"
+        # the chunk file carries its own sender (len-prefixed header), so
+        # a reader always sees an ATOMIC (sender, data) pair even while a
+        # duplicate delivery from another peer is mid-replace
+        sb = sender.encode()
         with open(tmp, "wb") as f:
-            f.write(data)
-        os.replace(tmp, self._path(idx))
-        self._senders[idx] = sender
+            f.write(bytes([len(sb)]) + sb + data)
+        with self._mu:
+            if self._closed:             # closed while writing: discard
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return
+            os.replace(tmp, self._path(idx))
+            self._senders[idx] = sender
 
     def __getitem__(self, idx: int):
         with open(self._path(idx), "rb") as f:
-            return f.read(), self._senders[idx]
+            raw = f.read()
+        n = raw[0]
+        return raw[1 + n:], raw[1:1 + n].decode()
 
     def pop(self, idx: int, default=None):
         import os
 
-        if idx not in self._senders:
-            return default
-        sender = self._senders.pop(idx)
-        try:
-            os.remove(self._path(idx))
-        except OSError:
-            pass
+        with self._mu:
+            if idx not in self._senders:
+                return default
+            sender = self._senders.pop(idx)
+            if self._dir is not None:
+                try:
+                    os.remove(self._path(idx))
+                except OSError:
+                    pass
         return sender
 
     def indices_from(self, sender: str) -> list[int]:
@@ -108,11 +129,12 @@ class _ChunkStore:
     def close(self) -> None:
         import shutil
 
-        self._closed = True
-        self.clear()
-        if self._dir is not None:
-            shutil.rmtree(self._dir, ignore_errors=True)
-            self._dir = None
+        with self._mu:
+            self._closed = True
+            d, self._dir = self._dir, None
+            self._senders.clear()
+        if d is not None:
+            shutil.rmtree(d, ignore_errors=True)
 
 
 class Syncer:
@@ -165,10 +187,23 @@ class Syncer:
         store = self._chunks
 
         async def _spool():
-            await asyncio.to_thread(
-                store.__setitem__, index, (bytes(chunk), peer_id))
-            if self._chunks is store:
-                self._chunk_event.set()
+            try:
+                await asyncio.to_thread(
+                    store.__setitem__, index, (bytes(chunk), peer_id))
+            except OSError as e:
+                # a full disk must surface as a DISK problem, not decay
+                # into a misleading fetch timeout
+                self.log.error("chunk spool write failed", index=index,
+                               err=repr(e))
+                return
+            if self._chunks is not store:
+                return                   # snapshot switched mid-write
+            if peer_id in self._banned:
+                # banned while the write was in flight: the purge already
+                # ran, so the late insert must not resurrect poison
+                store.pop(index)
+                return
+            self._chunk_event.set()
 
         asyncio.ensure_future(_spool())
 
@@ -306,6 +341,7 @@ class Syncer:
         requested: dict[int, tuple[float, str]] = {}  # chunk -> (t, peer)
         retries: dict[int, int] = {}
         next_peer = 0
+        last_progress = _time.monotonic()
         while len(applied) < snapshot.chunks:
             # request chunks that were never requested or whose request
             # timed out — NOT everything missing on every wakeup, which
@@ -346,12 +382,20 @@ class Syncer:
                     self.reactor.request_chunk(peer, snapshot.height,
                                                snapshot.format, i,
                                                snapshot.hash)
+            # wake on new chunks OR periodically: an in-flight async
+            # spool whose sender was banned mid-write leaves a stuck
+            # `requested` entry that only the age-out re-request path
+            # clears, so the loop must re-evaluate before the full
+            # timeout.  The timeout itself is PROGRESS-based (any chunk
+            # arrival or apply resets it).
             try:
                 await asyncio.wait_for(self._chunk_event.wait(),
-                                       CHUNK_TIMEOUT)
+                                       CHUNK_TIMEOUT / 4)
+                self._chunk_event.clear()
+                last_progress = _time.monotonic()
             except asyncio.TimeoutError:
-                raise StatesyncError("timed out fetching chunks")
-            self._chunk_event.clear()
+                if _time.monotonic() - last_progress > CHUNK_TIMEOUT:
+                    raise StatesyncError("timed out fetching chunks")
 
             # apply in STRICT index order (the ABCI restore contract —
             # reference chunks.Next() blocks for the next sequential
