@@ -56,15 +56,26 @@ async def generate(client, rate: float, duration_s: float,
     run_id = run_id or format(int(time.time()) & 0xFFFFFF, "x")
     counters = {"sent": 0, "errors": 0}
     seq = iter(range(1 << 62))
+    n = max(1, int(connections))
+    # one keep-alive connection per worker (HTTPClient serializes its own
+    # connection); worker 0 reuses the caller's client
+    clients = [client]
+    owned: list = []                # only close clients WE created
+    if n > 1 and hasattr(client, "host") and hasattr(client, "port"):
+        owned = [type(client)(client.host, client.port)
+                 for _ in range(n - 1)]
+        clients += owned
+    else:
+        clients *= n
 
-    async def worker(worker_rate: float):
+    async def worker(cli, worker_rate: float):
         interval = 1.0 / worker_rate
         t_end = time.monotonic() + duration_s
         next_at = time.monotonic()
         while time.monotonic() < t_end:
             tx = make_load_tx(run_id, next(seq), tx_size)
             try:
-                await client.call(broadcast, tx=tx.hex())
+                await cli.call(broadcast, tx=tx.hex())
                 counters["sent"] += 1
             except Exception:
                 counters["errors"] += 1
@@ -73,8 +84,12 @@ async def generate(client, rate: float, duration_s: float,
             if delay > 0:
                 await asyncio.sleep(delay)
 
-    n = max(1, int(connections))
-    await asyncio.gather(*(worker(rate / n) for _ in range(n)))
+    await asyncio.gather(*(worker(c, rate / n) for c in clients[:n]))
+    for c in owned:
+        try:
+            await c.close()
+        except Exception:
+            pass
     return {"run_id": run_id, "sent": counters["sent"],
             "errors": counters["errors"], "rate": rate,
             "duration_s": duration_s, "connections": n}

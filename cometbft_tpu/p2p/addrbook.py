@@ -136,7 +136,11 @@ class AddrBook:
             if nid not in self._where and nid not in self._banned:
                 self._place(_Entry(nid, addr, _group(addr)), "new")
 
+    SAVE_INTERVAL_S = 10.0      # debounce for hot-path mutations: the
+    #   reference dumps the book on a ticker, not per handshake
+
     def save(self) -> None:
+        """Unconditional full dump (shutdown / explicit persistence)."""
         if not self.path:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
@@ -149,6 +153,18 @@ class AddrBook:
                 "banned": sorted(self._banned),
             }, f, indent=1)
         os.replace(tmp, self.path)
+        self._dirty = False
+        self._last_save = time.time()
+
+    def _save_debounced(self) -> None:
+        """Hot-path persistence (every handshake calls mark_good): a
+        multi-MB JSON dump per event would block the p2p loop, so writes
+        are throttled; the book is a cache — losing the last few seconds
+        on crash is fine (PexReactor.stop() flushes via save())."""
+        self._dirty = True
+        if time.time() - getattr(self, "_last_save", 0.0) >= \
+                self.SAVE_INTERVAL_S:
+            self.save()
 
     # ------------------------------------------------------------- mutation
 
@@ -207,7 +223,7 @@ class AddrBook:
         else:
             ok = self._place(e, "new")
         if ok and persist:
-            self.save()
+            self._save_debounced()
         return ok
 
     def _drop(self, node_id: str) -> None:
@@ -229,7 +245,7 @@ class AddrBook:
             self._drop(node_id)
             if not self._place(e, "old"):
                 self._place(e, "new")      # old bucket full: stay new
-        self.save()
+        self._save_debounced()
 
     def mark_attempt(self, node_id: str) -> None:
         e = self._get(node_id)
@@ -252,7 +268,7 @@ class AddrBook:
         """Ban and forget (addrbook MarkBad)."""
         self._banned.add(node_id)
         self._drop(node_id)
-        self.save()
+        self._save_debounced()
 
     # ------------------------------------------------------------ selection
 

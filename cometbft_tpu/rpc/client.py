@@ -19,16 +19,34 @@ def _err(err: dict) -> "RPCError":
 
 
 class HTTPClient:
+    """Keep-alive JSON-RPC client: one persistent connection per client,
+    requests serialized on it (the server speaks HTTP/1.1 keep-alive).
+    Concurrency comes from using one client per task — see
+    ``loadtime.generate``'s per-worker clients."""
+
     def __init__(self, host: str, port: int):
         self.host = host
         self.port = port
         self._id = 0
+        self._conn = None                  # (reader, writer) when alive
+        self._lock = asyncio.Lock()        # one in-flight request/conn
+
+    async def close(self) -> None:
+        if self._conn is not None:
+            _, writer = self._conn
+            self._conn = None
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
 
     async def call(self, method: str, **params):
         self._id += 1
-        resp = await self._post(json.dumps(
-            {"jsonrpc": "2.0", "id": self._id,
-             "method": method, "params": params}).encode())
+        resp = await self._post(
+            json.dumps({"jsonrpc": "2.0", "id": self._id,
+                        "method": method, "params": params}).encode(),
+            retry_ok=not method.startswith("broadcast_"))
         if "error" in resp:
             raise _err(resp["error"])
         return resp["result"]
@@ -42,7 +60,10 @@ class HTTPClient:
             self._id += 1
             reqs.append({"jsonrpc": "2.0", "id": self._id,
                          "method": method, "params": params})
-        resps = await self._post(json.dumps(reqs).encode())
+        resps = await self._post(
+            json.dumps(reqs).encode(),
+            retry_ok=all(not m.startswith("broadcast_")
+                         for m, _ in calls))
         if not isinstance(resps, list):
             # whole-batch failure: the server answered with a single
             # error object (e.g. parse error) instead of an array
@@ -57,28 +78,52 @@ class HTTPClient:
                        else r.get("result"))
         return out
 
-    async def _post(self, body: bytes):
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        try:
-            writer.write(
-                b"POST / HTTP/1.1\r\nHost: rpc\r\n"
-                b"Content-Type: application/json\r\n"
-                b"Content-Length: " + str(len(body)).encode() +
-                b"\r\nConnection: close\r\n\r\n" + body)
-            await writer.drain()
-            status = await reader.readline()
-            if b"200" not in status:
-                raise RPCError(-32000, f"http error: {status.decode()!r}")
-            headers = {}
-            while True:
-                line = await reader.readline()
-                if line in (b"\r\n", b"\n", b""):
-                    break
-                k, _, v = line.decode().partition(":")
-                headers[k.strip().lower()] = v.strip()
-            raw = await reader.readexactly(int(headers["content-length"]))
-        finally:
-            writer.close()
+    async def _post(self, body: bytes, retry_ok: bool = True):
+        async with self._lock:
+            # one retry on a stale reused connection (server idle-closed
+            # the keep-alive socket) — but NEVER for non-idempotent
+            # requests (broadcast_*): a failure after the server accepted
+            # the request would silently double-send the tx.  Failures on
+            # a fresh connection always propagate.
+            for attempt in (0, 1):
+                reused = self._conn is not None
+                if not reused:
+                    self._conn = await asyncio.open_connection(
+                        self.host, self.port)
+                reader, writer = self._conn
+                try:
+                    return await self._roundtrip(reader, writer, body)
+                except (ConnectionError, asyncio.IncompleteReadError,
+                        OSError):
+                    await self.close()
+                    if not (reused and retry_ok) or attempt:
+                        raise
+                except Exception:
+                    # protocol-level failure (bad status, parse error):
+                    # the stream position is unknown — drop the conn
+                    await self.close()
+                    raise
+
+    async def _roundtrip(self, reader, writer, body: bytes):
+        writer.write(
+            b"POST / HTTP/1.1\r\nHost: rpc\r\n"
+            b"Content-Type: application/json\r\n"
+            b"Content-Length: " + str(len(body)).encode() +
+            b"\r\n\r\n" + body)
+        await writer.drain()
+        status = await reader.readline()
+        if not status:
+            raise ConnectionResetError("server closed the connection")
+        if b"200" not in status:
+            raise RPCError(-32000, f"http error: {status.decode()!r}")
+        headers = {}
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.decode().partition(":")
+            headers[k.strip().lower()] = v.strip()
+        raw = await reader.readexactly(int(headers["content-length"]))
         return json.loads(raw)
 
 

@@ -82,7 +82,11 @@ class _ChunkStore:
                 return
             if self._dir is None:
                 self._dir = tempfile.mkdtemp(prefix="statesync-chunks-")
-            tmp = self._path(idx) + ".tmp"
+            # unique tmp per WRITE: duplicate deliveries of the same
+            # chunk spool concurrently, and sharing one tmp path would
+            # interleave their bytes into a torn file
+            self._tmp_seq = getattr(self, "_tmp_seq", 0) + 1
+            tmp = self._path(idx) + f".{self._tmp_seq}.tmp"
         # the chunk file carries its own sender (len-prefixed header), so
         # a reader always sees an ATOMIC (sender, data) pair even while a
         # duplicate delivery from another peer is mid-replace
@@ -118,6 +122,23 @@ class _ChunkStore:
                 except OSError:
                     pass
         return sender
+
+    def pop_if_sender(self, idx: int, sender: str) -> bool:
+        """Atomically remove chunk ``idx`` ONLY if it still came from
+        ``sender`` — the banned-mid-write guard must not delete a fresh
+        replacement a good peer just spooled over it."""
+        import os
+
+        with self._mu:
+            if self._senders.get(idx) != sender:
+                return False
+            self._senders.pop(idx)
+            if self._dir is not None:
+                try:
+                    os.remove(self._path(idx))
+                except OSError:
+                    pass
+        return True
 
     def indices_from(self, sender: str) -> list[int]:
         return [i for i, s in self._senders.items() if s == sender]
@@ -200,8 +221,9 @@ class Syncer:
                 return                   # snapshot switched mid-write
             if peer_id in self._banned:
                 # banned while the write was in flight: the purge already
-                # ran, so the late insert must not resurrect poison
-                store.pop(index)
+                # ran, so the late insert must not resurrect poison (but
+                # only OUR chunk — never a good peer's fresh replacement)
+                store.pop_if_sender(index, peer_id)
                 return
             self._chunk_event.set()
 
