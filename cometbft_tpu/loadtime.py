@@ -84,12 +84,14 @@ async def generate(client, rate: float, duration_s: float,
             if delay > 0:
                 await asyncio.sleep(delay)
 
-    await asyncio.gather(*(worker(c, rate / n) for c in clients[:n]))
-    for c in owned:
-        try:
-            await c.close()
-        except Exception:
-            pass
+    try:
+        await asyncio.gather(*(worker(c, rate / n) for c in clients[:n]))
+    finally:
+        for c in owned:
+            try:
+                await c.close()
+            except Exception:
+                pass
     return {"run_id": run_id, "sent": counters["sent"],
             "errors": counters["errors"], "rate": rate,
             "duration_s": duration_s, "connections": n}

@@ -614,30 +614,56 @@ static void ge_scalarmul(ge& r, const sc& k, const ge& p) {
 
 static void ge_msm(ge& r, const std::vector<ge>& points,
                    const std::vector<sc>& scalars) {
+    // Pippenger with SIGNED digits: each window digit is recoded into
+    // [-2^(c-1), 2^(c-1)] with carries, so a window of width c needs
+    // only 2^(c-1) buckets — for the same bucket-aggregation cost the
+    // window can be one bit wider, cutting window count ~10%.
     size_t n = points.size();
     if (n == 0) { r = GE_ID; return; }
     int c;                               // window width
     if (n < 8) c = 3;
-    else if (n < 32) c = 4;
-    else if (n < 128) c = 5;
-    else if (n < 512) c = 6;
-    else if (n < 1536) c = 7;
-    else if (n < 6144) c = 8;
-    else if (n < 16384) c = 9;
-    else c = 11;
-    int nbuckets = (1 << c) - 1;
-    int nwindows = (253 + c - 1) / c;
+    else if (n < 32) c = 5;
+    else if (n < 128) c = 6;
+    else if (n < 512) c = 7;
+    else if (n < 1536) c = 8;
+    else if (n < 6144) c = 9;
+    else if (n < 16384) c = 10;
+    else c = 12;
+    int nbuckets = 1 << (c - 1);         // digit magnitudes 1..2^(c-1)
+    int nwindows = (254 + c - 1) / c;    // 254: room for the top carry
+    // recode every scalar (LSB window first, carry into the next);
+    // scalars < L < 2^253, so the top window absorbs the final carry
+    std::vector<int16_t> digits(n * nwindows);
+    for (size_t i = 0; i < n; i++) {
+        int carry = 0;
+        for (int w = 0; w < nwindows; w++) {
+            int pos = w * c;
+            int width = (pos + c <= 253) ? c : (pos < 253 ? 253 - pos : 0);
+            int d = (width > 0 ? sc_window(scalars[i], pos, width) : 0)
+                    + carry;
+            if (d > nbuckets && w < nwindows - 1) {
+                d -= (1 << c);
+                carry = 1;
+            } else {
+                carry = 0;
+            }
+            digits[i * nwindows + w] = (int16_t)d;
+        }
+    }
     std::vector<ge> buckets(nbuckets);
     ge acc = GE_ID;
     for (int w = nwindows - 1; w >= 0; w--) {
         for (int i = 0; i < c; i++) ge_double(acc, acc);
         for (int i = 0; i < nbuckets; i++) buckets[i] = GE_ID;
-        int pos = w * c;
-        int width = (pos + c <= 253) ? c : (253 - pos);
         for (size_t i = 0; i < n; i++) {
-            int digit = sc_window(scalars[i], pos, width);
-            if (digit) ge_add(buckets[digit - 1], buckets[digit - 1],
-                              points[i]);
+            int d = digits[i * nwindows + w];
+            if (d > 0) {
+                ge_add(buckets[d - 1], buckets[d - 1], points[i]);
+            } else if (d < 0) {
+                ge npt;
+                ge_neg(npt, points[i]);
+                ge_add(buckets[-d - 1], buckets[-d - 1], npt);
+            }
         }
         // sum_j j*bucket[j] via suffix sums
         ge running = GE_ID, wsum = GE_ID;

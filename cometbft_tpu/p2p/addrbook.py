@@ -153,15 +153,14 @@ class AddrBook:
                 "banned": sorted(self._banned),
             }, f, indent=1)
         os.replace(tmp, self.path)
-        self._dirty = False
         self._last_save = time.time()
 
-    def _save_debounced(self) -> None:
-        """Hot-path persistence (every handshake calls mark_good): a
-        multi-MB JSON dump per event would block the p2p loop, so writes
-        are throttled; the book is a cache — losing the last few seconds
-        on crash is fine (PexReactor.stop() flushes via save())."""
-        self._dirty = True
+    def save_debounced(self) -> None:
+        """Hot-path persistence (every handshake/PEX response mutates
+        the book): a multi-MB JSON dump per event would block the p2p
+        loop, so writes are throttled to one per SAVE_INTERVAL_S; the
+        book is a cache — losing the last few seconds on crash is fine
+        (PexReactor.stop() flushes via save())."""
         if time.time() - getattr(self, "_last_save", 0.0) >= \
                 self.SAVE_INTERVAL_S:
             self.save()
@@ -223,7 +222,7 @@ class AddrBook:
         else:
             ok = self._place(e, "new")
         if ok and persist:
-            self._save_debounced()
+            self.save_debounced()
         return ok
 
     def _drop(self, node_id: str) -> None:
@@ -245,7 +244,7 @@ class AddrBook:
             self._drop(node_id)
             if not self._place(e, "old"):
                 self._place(e, "new")      # old bucket full: stay new
-        self._save_debounced()
+        self.save_debounced()
 
     def mark_attempt(self, node_id: str) -> None:
         e = self._get(node_id)
@@ -268,7 +267,7 @@ class AddrBook:
         """Ban and forget (addrbook MarkBad)."""
         self._banned.add(node_id)
         self._drop(node_id)
-        self._save_debounced()
+        self.save_debounced()
 
     # ------------------------------------------------------------ selection
 

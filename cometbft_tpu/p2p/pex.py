@@ -166,7 +166,7 @@ class PexReactor(Reactor):
                     changed |= self.book.add(nid, addr, persist=False,
                                              source=source)
             if changed:
-                self.book.save()     # one write per response, not per addr
+                self.book.save_debounced()   # throttled full-book dump
 
     # ------------------------------------------------------- ensure peers
 

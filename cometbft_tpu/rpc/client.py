@@ -47,6 +47,13 @@ class HTTPClient:
             json.dumps({"jsonrpc": "2.0", "id": self._id,
                         "method": method, "params": params}).encode(),
             retry_ok=not method.startswith("broadcast_"))
+        if isinstance(resp, dict) and resp.get("id") not in (None,
+                                                             self._id):
+            # a desynced keep-alive stream answered with a stale
+            # response: poison the connection and fail loudly
+            await self.close()
+            raise RPCError(-32000,
+                           f"response id {resp.get('id')} != {self._id}")
         if "error" in resp:
             raise _err(resp["error"])
         return resp["result"]
@@ -98,9 +105,12 @@ class HTTPClient:
                     await self.close()
                     if not (reused and retry_ok) or attempt:
                         raise
-                except Exception:
-                    # protocol-level failure (bad status, parse error):
-                    # the stream position is unknown — drop the conn
+                except BaseException:
+                    # protocol failure OR cancellation (a timed-out
+                    # wait_for cancels us mid-read): the stream position
+                    # is unknown, so the connection must never be reused
+                    # — a stale half-read response would answer the NEXT
+                    # request
                     await self.close()
                     raise
 
