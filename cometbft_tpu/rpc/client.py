@@ -43,17 +43,18 @@ class HTTPClient:
 
     async def call(self, method: str, **params):
         self._id += 1
+        rid = self._id          # NOT self._id at response time: another
+        #   task sharing this client may bump the counter while we await
         resp = await self._post(
-            json.dumps({"jsonrpc": "2.0", "id": self._id,
+            json.dumps({"jsonrpc": "2.0", "id": rid,
                         "method": method, "params": params}).encode(),
             retry_ok=not method.startswith("broadcast_"))
-        if isinstance(resp, dict) and resp.get("id") not in (None,
-                                                             self._id):
+        if isinstance(resp, dict) and resp.get("id") not in (None, rid):
             # a desynced keep-alive stream answered with a stale
             # response: poison the connection and fail loudly
             await self.close()
             raise RPCError(-32000,
-                           f"response id {resp.get('id')} != {self._id}")
+                           f"response id {resp.get('id')} != {rid}")
         if "error" in resp:
             raise _err(resp["error"])
         return resp["result"]
@@ -78,6 +79,11 @@ class HTTPClient:
                 raise _err(resps["error"])
             raise RPCError(-32700, f"malformed batch response: {resps!r}")
         by_id = {r.get("id"): r for r in resps if isinstance(r, dict)}
+        if resps and not any(req["id"] in by_id for req in reqs):
+            # none of OUR ids came back: a desynced stream answered with
+            # a stale batch — fail loudly like call() does
+            await self.close()
+            raise RPCError(-32000, "batch response ids match no request")
         out = []
         for req in reqs:
             r = by_id.get(req["id"], {})

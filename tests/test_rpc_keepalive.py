@@ -1,0 +1,148 @@
+"""Keep-alive HTTPClient: connection reuse, idempotency-gated retry,
+and cancellation safety (a timed-out call must never desync the stream
+so that a stale response answers the next request)."""
+
+import asyncio
+import json
+import re
+
+import pytest
+
+from cometbft_tpu.rpc.client import HTTPClient
+
+pytestmark = pytest.mark.timeout(60)
+
+
+class EchoServer:
+    """Minimal keep-alive JSON-RPC echo server with per-method hooks."""
+
+    def __init__(self):
+        self.connections = 0
+        self.requests = 0
+        self.server = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(self._handle,
+                                                 "127.0.0.1", 0)
+        return self.server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader, writer):
+        self.connections += 1
+        try:
+            while True:
+                headers = b""
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    headers += line
+                    if line in (b"\r\n", b"\n"):
+                        break
+                n = int(re.search(rb"Content-Length: (\d+)",
+                                  headers).group(1))
+                req = json.loads(await reader.readexactly(n))
+                self.requests += 1
+                if req["method"] == "slow":
+                    await asyncio.sleep(1.0)
+                if req["method"] == "hangup":
+                    writer.close()
+                    return
+                body = json.dumps({
+                    "jsonrpc": "2.0", "id": req["id"],
+                    "result": {"method": req["method"],
+                               "req_no": self.requests}}).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\nContent-Length: "
+                    + str(len(body)).encode()
+                    + b"\r\nConnection: keep-alive\r\n\r\n" + body)
+                await writer.drain()
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    def stop(self):
+        if self.server is not None:
+            self.server.close()
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_connection_reuse_and_stale_retry():
+    async def main():
+        srv = EchoServer()
+        port = await srv.start()
+        cli = HTTPClient("127.0.0.1", port)
+        for i in range(5):
+            r = await cli.call("ping")
+            assert r["method"] == "ping"
+        assert srv.connections == 1, "keep-alive did not reuse"
+
+        # server hangs up; the next IDEMPOTENT call silently reconnects
+        with pytest.raises(Exception):
+            await cli.call("hangup")
+        r = await cli.call("ping")
+        assert r["method"] == "ping"
+        assert srv.connections >= 2
+        await cli.close()
+        srv.stop()
+        return True
+
+    assert run(main())
+
+
+def test_broadcast_never_retries():
+    """The retry decision is idempotency-gated: broadcast_* requests set
+    retry_ok=False (a stale-connection resend could double-send a tx the
+    server already accepted); read-only methods allow the retry."""
+
+    async def main():
+        cli = HTTPClient("127.0.0.1", 1)
+        seen = []
+
+        async def fake_post(body, retry_ok=True):
+            seen.append(retry_ok)
+            req = json.loads(body)
+            if isinstance(req, list):
+                return [{"jsonrpc": "2.0", "id": r["id"], "result": {}}
+                        for r in req]
+            return {"jsonrpc": "2.0", "id": req["id"], "result": {}}
+
+        cli._post = fake_post
+        await cli.call("status")
+        await cli.call("broadcast_tx_async", tx="00")
+        await cli.call("broadcast_tx_commit", tx="00")
+        await cli.call_batch([("status", {}), ("block", {"height": 1})])
+        await cli.call_batch([("status", {}),
+                              ("broadcast_tx_sync", {"tx": "00"})])
+        assert seen == [True, False, False, True, False]
+        return True
+
+    assert run(main())
+
+
+def test_cancellation_does_not_desync():
+    """wait_for cancelling a call mid-response must drop the connection;
+    the next call gets ITS OWN response, never the stale one."""
+
+    async def main():
+        srv = EchoServer()
+        port = await srv.start()
+        cli = HTTPClient("127.0.0.1", port)
+        r = await cli.call("warm")
+        assert r["method"] == "warm"
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(cli.call("slow"), 0.2)
+        r = await cli.call("fast")
+        assert r["method"] == "fast"
+        await cli.close()
+        srv.stop()
+        return True
+
+    assert run(main())
