@@ -79,11 +79,23 @@ class HTTPClient:
                 raise _err(resps["error"])
             raise RPCError(-32700, f"malformed batch response: {resps!r}")
         by_id = {r.get("id"): r for r in resps if isinstance(r, dict)}
-        if resps and not any(req["id"] in by_id for req in reqs):
-            # none of OUR ids came back: a desynced stream answered with
-            # a stale batch — fail loudly like call() does
+        matched = any(req["id"] in by_id for req in reqs)
+        stale_ids = [r["id"] for r in resps if isinstance(r, dict)
+                     and r.get("id") is not None
+                     and not any(req["id"] == r["id"] for req in reqs)]
+        if resps and not matched and stale_ids:
+            # responses carry ids that belong to NO request: a desynced
+            # stream answered with a stale batch — fail loudly
             await self.close()
-            raise RPCError(-32000, "batch response ids match no request")
+            raise RPCError(-32000,
+                           f"batch response ids {stale_ids[:3]} match "
+                           f"no request")
+        if not matched and len(reqs) == 1 and len(resps) == 1 and \
+                isinstance(resps[0], dict) and "error" in resps[0]:
+            # JSON-RPC answers an unprocessable entry with id null: for
+            # a single-element batch that error is unambiguous — surface
+            # it rather than a silent None slot
+            return [_err(resps[0]["error"])]
         out = []
         for req in reqs:
             r = by_id.get(req["id"], {})

@@ -140,6 +140,15 @@ class Commit:
         self.__dict__["_dense_cols"] = cols
         return cols
 
+    def dense_addresses(self) -> list:
+        """Cached per-lane validator addresses (the trusting path looks
+        commit sigs up BY ADDRESS in a possibly different valset)."""
+        addrs = self.__dict__.get("_dense_addrs")
+        if addrs is None:
+            addrs = [cs.validator_address for cs in self.signatures]
+            self.__dict__["_dense_addrs"] = addrs
+        return addrs
+
     def sign_bytes_templates(self, chain_id: str):
         """(pre_commit, pre_nil, post) body fragments for the native
         sign-bytes builder: everything except the timestamp field, for

@@ -79,11 +79,18 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     count_all=False allows early exit once the tally clears the threshold
     (remaining signatures are NOT verified — VerifyCommitLight semantics).
     """
-    if not lookup_by_address and _dense_verify(
-            chain_id, vals, commit, voting_power_needed,
-            count_all=count_all, verify_nil_sigs=verify_nil_sigs,
-            backend=backend or _DEFAULT_BACKEND):
-        return
+    if not lookup_by_address:
+        if _dense_verify(chain_id, vals, commit, voting_power_needed,
+                         count_all=count_all,
+                         verify_nil_sigs=verify_nil_sigs,
+                         backend=backend or _DEFAULT_BACKEND):
+            return
+    elif not verify_nil_sigs:
+        if _dense_verify_trusting(chain_id, vals, commit,
+                                  voting_power_needed,
+                                  count_all=count_all,
+                                  backend=backend or _DEFAULT_BACKEND):
+            return
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
     lanes: list[int] = []          # commit-sig indices added to the batch
     tally = 0
@@ -178,6 +185,73 @@ def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
         ok, oks = res
         if not ok:
             raise ErrInvalidSignature(int(scope[np.nonzero(~oks)[0][0]]))
+    if tally <= needed:
+        raise ErrNotEnoughVotingPower(
+            f"tallied {tally} <= needed {needed}")
+    return True
+
+
+def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
+                           commit: Commit, needed: int, *,
+                           count_all: bool, backend: str) -> bool:
+    """Dense core of VerifyCommitLightTrusting: commit sigs resolve BY
+    ADDRESS into a (possibly different) trusted set.  Lane selection
+    stays a (cheap) Python loop — dict lookups, duplicate detection and
+    the early exit are inherently sequential — but sign-bytes building
+    and signature verification go through the same native dense
+    machinery as the index-aligned paths.  Returns True when fully
+    handled; False -> caller runs the object loop."""
+    import numpy as np
+
+    from ..crypto import _native_ed25519 as nat
+    from .commit import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
+
+    dense = vals.dense()
+    cols = commit.dense_columns()
+    if dense is None or cols is None or not nat.available():
+        return False
+    pubs, powers = dense
+    flags, ts, sigmat = cols
+    addrs = commit.dense_addresses()
+    aidx = vals.address_index()
+    seen: set[bytes] = set()
+    scope: list[int] = []            # commit-sig lanes to verify
+    rows: list[int] = []             # their rows in the trusted set
+    tally = 0
+    for i, addr in enumerate(addrs):
+        fl = int(flags[i])
+        if fl == BLOCK_ID_FLAG_ABSENT:
+            continue
+        row = aidx.get(addr)
+        if row is None:
+            continue
+        if addr in seen:
+            raise ErrInvalidCommit(
+                f"duplicate validator {addr.hex()} in commit")
+        seen.add(addr)
+        if fl != BLOCK_ID_FLAG_COMMIT:
+            continue
+        scope.append(i)
+        rows.append(row)
+        tally += int(powers[row])
+        if not count_all and tally > needed:
+            break
+    if scope:
+        scope_arr = np.asarray(scope)
+        built = _dense_build_rows(chain_id, commit, ts, flags, scope_arr)
+        if built is None:
+            return False
+        msgs, lens = built
+        rows_arr = np.asarray(rows)
+        res = cryptobatch.verify_dense(
+            backend, np.ascontiguousarray(pubs[rows_arr]),
+            np.ascontiguousarray(sigmat[scope_arr]), msgs, lens,
+            valset_pubs=pubs, scope=rows_arr)
+        if res is None:
+            return False
+        ok, oks = res
+        if not ok:
+            raise ErrInvalidSignature(scope[int(np.nonzero(~oks)[0][0])])
     if tally <= needed:
         raise ErrNotEnoughVotingPower(
             f"tallied {tally} <= needed {needed}")

@@ -140,6 +140,15 @@ class ValidatorSet:
         self.__dict__["_dense"] = d
         return d
 
+    def address_index(self) -> dict:
+        """Cached address -> row map for the dense trusting path (same
+        invalidation discipline as :meth:`dense`)."""
+        m = self.__dict__.get("_addr_idx")
+        if m is None:
+            m = {v.address: i for i, v in enumerate(self.validators)}
+            self.__dict__["_addr_idx"] = m
+        return m
+
     def has_address(self, addr: bytes) -> bool:
         return self.get_by_address(addr)[0] >= 0
 
@@ -265,6 +274,7 @@ class ValidatorSet:
         self.validators = sorted(cur.values(), key=lambda v: v.address)
         self._total = None
         self.__dict__.pop("_dense", None)     # membership/powers changed
+        self.__dict__.pop("_addr_idx", None)
         self.total_voting_power()
         self._rescale_priorities(
             PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())

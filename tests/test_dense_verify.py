@@ -178,3 +178,72 @@ def test_dense_columns_rejects_out_of_range_ints():
     outcome = outcomes(V.VerifyCommit, "light-chain", lb.validators,
                        c2.block_id, lb.height, c2, backend="cpu")
     assert outcome[0] is not None  # rejects, but through the loop
+
+
+def trusting_paths(monkeypatch, vals, commit, **kw):
+    def once():
+        try:
+            V.VerifyCommitLightTrusting("light-chain", vals, commit, **kw)
+            return None, None
+        except V.CommitVerificationError as e:
+            return type(e), getattr(e, "idx", None)
+
+    fast = once()
+    monkeypatch.setattr(V, "_dense_verify_trusting", lambda *a, **k: False)
+    slow = once()
+    monkeypatch.undo()
+    return fast, slow
+
+
+def test_trusting_parity_same_set(monkeypatch, chain):
+    fast, slow = trusting_paths(monkeypatch, chain.validators,
+                                chain.commit, backend="cpu")
+    assert fast == slow == (None, None)
+
+
+def test_trusting_parity_subset_overlap(monkeypatch, chain):
+    """Trusted set is a STRICT SUBSET of the signing set (the skipping-
+    verification scenario): only overlapping validators count."""
+    from cometbft_tpu.types.validator_set import ValidatorSet
+
+    sub = ValidatorSet([v.copy() for v in chain.validators.validators[:20]])
+    fast, slow = trusting_paths(monkeypatch, sub, chain.commit,
+                                backend="cpu")
+    assert fast == slow
+    # 20 of 40 equal-power validators sign; default trust level 1/3 of
+    # the SUB-set total is cleared
+    assert fast == (None, None)
+
+
+def test_trusting_parity_duplicate_address(monkeypatch, chain):
+    c = copy.deepcopy(chain.commit)
+    c.signatures[5].validator_address = c.signatures[4].validator_address
+    c.signatures[5].timestamp_ns = c.signatures[4].timestamp_ns
+    c.signatures[5].signature = c.signatures[4].signature
+    fast, slow = trusting_paths(monkeypatch, chain.validators, c,
+                                backend="cpu")
+    assert fast == slow and fast[0] is V.ErrInvalidCommit
+
+
+def test_trusting_parity_bad_signature(monkeypatch, chain):
+    import fractions
+
+    c = copy.deepcopy(chain.commit)
+    c.signatures[3].signature = bytes(64)
+    # trust level 1 => every overlapping commit sig must verify
+    fast, slow = trusting_paths(monkeypatch, chain.validators, c,
+                                backend="cpu",
+                                trust_level=fractions.Fraction(1, 1),
+                                count_all=True)
+    assert fast == slow
+    assert fast[0] in (V.ErrInvalidSignature, V.ErrNotEnoughVotingPower)
+    if fast[0] is V.ErrInvalidSignature:
+        assert fast[1] == 3
+
+
+def test_trusting_early_exit_skips_trailing_bad_sig(monkeypatch, chain):
+    c = copy.deepcopy(chain.commit)
+    c.signatures[-1].signature = bytes(64)
+    fast, slow = trusting_paths(monkeypatch, chain.validators, c,
+                                backend="cpu")
+    assert fast == slow == (None, None)   # 1/3 cleared long before

@@ -20,6 +20,7 @@ class EchoServer:
         self.connections = 0
         self.requests = 0
         self.server = None
+        self._tasks: set = set()
 
     async def start(self):
         self.server = await asyncio.start_server(self._handle,
@@ -28,6 +29,7 @@ class EchoServer:
 
     async def _handle(self, reader, writer):
         self.connections += 1
+        self._tasks.add(asyncio.current_task())
         try:
             while True:
                 headers = b""
@@ -56,14 +58,20 @@ class EchoServer:
                     + str(len(body)).encode()
                     + b"\r\nConnection: keep-alive\r\n\r\n" + body)
                 await writer.drain()
-        except (ConnectionError, asyncio.IncompleteReadError):
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.CancelledError):
             pass
         finally:
+            self._tasks.discard(asyncio.current_task())
             writer.close()
 
-    def stop(self):
+    async def stop(self):
         if self.server is not None:
             self.server.close()
+        for t in list(self._tasks):
+            t.cancel()
+        await asyncio.sleep(0)         # let cancellations unwind before
+        #   the loop closes (no 'Event loop is closed' unraisables)
 
 
 def run(coro):
@@ -91,7 +99,7 @@ def test_connection_reuse_and_stale_retry():
         assert r["method"] == "ping"
         assert srv.connections >= 2
         await cli.close()
-        srv.stop()
+        await srv.stop()
         return True
 
     assert run(main())
@@ -142,7 +150,7 @@ def test_cancellation_does_not_desync():
         r = await cli.call("fast")
         assert r["method"] == "fast"
         await cli.close()
-        srv.stop()
+        await srv.stop()
         return True
 
     assert run(main())
