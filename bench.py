@@ -318,12 +318,23 @@ def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
                     raise RuntimeError(
                         "bench node RPC never came up (see node.log)")
                 conns = int(os.environ.get("BENCH_NODE_CONNS", "8"))
+                batch = int(os.environ.get("BENCH_NODE_BATCH", "4"))
                 note(f"driving {rate:.0f} tx/s for {duration_s:.0f}s "
-                     f"({tx_size}B txs, {conns} connections)")
+                     f"({tx_size}B txs, {conns} connections, "
+                     f"batch {batch})")
                 gen = await loadtime.generate(cli, rate, duration_s,
                                               tx_size=tx_size,
-                                              connections=conns)
-                await asyncio.sleep(2.0)       # let the tail commit
+                                              connections=conns,
+                                              batch=batch)
+                # let the backlog commit: a saturating drive leaves a
+                # mempool tail, and counting only the mid-drive window
+                # would understate committed throughput
+                for _ in range(60):
+                    un = await cli.call("num_unconfirmed_txs")
+                    if int(un.get("n_txs", 0)) == 0:
+                        break
+                    await asyncio.sleep(0.5)
+                await asyncio.sleep(1.0)
                 rep = await loadtime.report(cli, run_id=gen["run_id"])
                 return gen, rep
 

@@ -45,14 +45,16 @@ def parse_load_tx(tx: bytes) -> tuple[str, int, int] | None:
 async def generate(client, rate: float, duration_s: float,
                    tx_size: int = 256, run_id: str | None = None,
                    broadcast: str = "broadcast_tx_async",
-                   connections: int = 1) -> dict:
+                   connections: int = 1, batch: int = 1) -> dict:
     """Drive ``rate`` tx/s at a node for ``duration_s`` through the RPC
     client (loadtime's generator loop, minus the UUID machinery).
 
     ``connections`` runs that many concurrent sender loops splitting the
     rate (loadtime's `-c` flag): one serial HTTP round-trip per tx caps
     a single loop at ~600 tx/s, which under-drives a saturation
-    measurement."""
+    measurement.  ``batch`` > 1 sends that many txs per JSON-RPC batch
+    request (one HTTP round-trip), for saturation drives where even the
+    fan-out can't keep up."""
     run_id = run_id or format(int(time.time()) & 0xFFFFFF, "x")
     counters = {"sent": 0, "errors": 0}
     seq = iter(range(1 << 62))
@@ -68,17 +70,27 @@ async def generate(client, rate: float, duration_s: float,
     else:
         clients *= n
 
+    b = max(1, int(batch))
+
     async def worker(cli, worker_rate: float):
-        interval = 1.0 / worker_rate
+        interval = b / worker_rate
         t_end = time.monotonic() + duration_s
         next_at = time.monotonic()
         while time.monotonic() < t_end:
-            tx = make_load_tx(run_id, next(seq), tx_size)
+            txs = [make_load_tx(run_id, next(seq), tx_size)
+                   for _ in range(b)]
             try:
-                await cli.call(broadcast, tx=tx.hex())
-                counters["sent"] += 1
+                if b == 1:
+                    await cli.call(broadcast, tx=txs[0].hex())
+                    counters["sent"] += 1
+                else:
+                    outs = await cli.call_batch(
+                        [(broadcast, {"tx": t.hex()}) for t in txs])
+                    bad = sum(1 for o in outs if isinstance(o, Exception))
+                    counters["sent"] += len(txs) - bad
+                    counters["errors"] += bad
             except Exception:
-                counters["errors"] += 1
+                counters["errors"] += b
             next_at += interval
             delay = next_at - time.monotonic()
             if delay > 0:
