@@ -143,7 +143,20 @@ class Secp256k1PrivKey(PrivKey):
         return SECP256K1_KEY_TYPE
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._sk.sign(msg, ec.ECDSA(hashes.SHA256()))
+        """RFC 6979 deterministic ECDSA over SHA-256(msg), low-S
+        normalized — byte-for-byte a function of (key, msg), like the
+        reference's dcrec SignCompact (secp256k1.go:121-125).  Nonce
+        derivation and the scalar ladder run in OpenSSL's constant-time
+        code; pinned to the published RFC 6979 secp256k1 vectors in
+        tests/test_secp256k1.py."""
+        try:
+            der = self._sk.sign(
+                msg, ec.ECDSA(hashes.SHA256(), deterministic_signing=True))
+        except Exception as exc:  # UnsupportedAlgorithm on OpenSSL < 3.2
+            raise RuntimeError(
+                "deterministic ECDSA (RFC 6979) needs an OpenSSL 3.2+ "
+                "backend; this cryptography build does not support it"
+            ) from exc
         r, s = decode_dss_signature(der)
         if s > _HALF_N:
             s = _N - s              # low-S normalization

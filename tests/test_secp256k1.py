@@ -27,7 +27,38 @@ def test_sign_verify_roundtrip():
     assert len(sig) == 64
     assert pk.verify_signature(b"a message", sig)
     assert not pk.verify_signature(b"another message", sig)
-    assert not pk.verify_signature(b"a message", sig[:-1] + b"\x00")
+    # r3 flake root cause: the old tamper `sig[:-1] + b"\x00"` was an
+    # IDENTITY transform whenever sig[-1] was already 0x00 (p = 1/256
+    # per run with random nonces) — the "tampered" sig verified because
+    # it was the untampered sig.  XOR guarantees a real change.
+    assert not pk.verify_signature(b"a message",
+                                   sig[:-1] + bytes([sig[-1] ^ 1]))
+
+
+def test_sign_is_rfc6979_deterministic():
+    """Reference parity: dcrec's SignCompact derives k per RFC 6979
+    (secp256k1.go:121-125), so signatures are a pure function of
+    (key, msg) — and every test failure is replayable.  Vectors are the
+    widely-published community RFC6979/secp256k1/SHA-256 set."""
+    sk = Secp256k1PrivKey((1).to_bytes(32, "big"))
+    sig = sk.sign(b"Satoshi Nakamoto")
+    assert sig == sk.sign(b"Satoshi Nakamoto")
+    assert sig.hex() == (
+        "934b1ea10a4b3c1757e2b0c017d0b6143ce3c9a7e6a4a49860d7a6ab210ee3d8"
+        "2442ce9d2b916064108014783e923ec36b49743e2ffa1c4496f01a512aafd9e5")
+    sig2 = sk.sign(b"All those moments will be lost in time, like tears "
+                   b"in rain. Time to die...")
+    assert sig2.hex() == (
+        "8600dbd41e348fe5c9465ab92d23e3db8b98b873beecd930736488696438cb6b"
+        "547fe64427496db33bf66019dacbf0039c04199abb0122918601db38a72cfc21")
+    # n-1 secret exercises the high end of the key range
+    sk2 = Secp256k1PrivKey((_N - 1).to_bytes(32, "big"))
+    sig3 = sk2.sign(b"Satoshi Nakamoto")
+    assert sig3.hex() == (
+        "fd567d121db66e382991534ada77a6bd3106f0a1098c231e47993447cd6af2d0"
+        "6b39cd0eb1bc8603e159ef5c20a5c8ad685a45b06ce9bebed3f153d10d93bed5")
+    for s_, m in ((sk, b"Satoshi Nakamoto"), (sk2, b"Satoshi Nakamoto")):
+        assert s_.pub_key().verify_signature(m, s_.sign(m))
 
 
 def test_low_s_enforced_and_malleable_rejected():
@@ -164,6 +195,15 @@ def test_bls_validator_backend_guard(monkeypatch):
     doc.validate_and_complete()              # explicit opt-in unblocks
 
 
+def test_differential_fuzz_smoke():
+    """In-process slice of the differential fuzzer (same process as the
+    full suite → exercises the cross-library state the r3 flake was
+    suspected of; the standalone harness runs millions of triples)."""
+    from fuzz_secp256k1 import fuzz
+
+    assert fuzz(n_triples=60, seed=7) >= 60 * 6
+
+
 def test_native_secp256k1_matches_openssl_oracle():
     """native/secp256k1.cpp differential: valid, tampered, malleable
     (high-s), boundary r/s, and malformed-pubkey cases must all agree
@@ -176,12 +216,7 @@ def test_native_secp256k1_matches_openssl_oracle():
     lib = s._native_lib()
     assert lib is not None, "native secp256k1 must build on this image"
 
-    def oracle(pub, m, sig):
-        """The full python path with native disabled (OpenSSL oracle)."""
-        import unittest.mock as mock
-
-        with mock.patch.object(s, "_native_lib", lambda: None):
-            return pub.verify_signature(m, sig)
+    from fuzz_secp256k1 import _oracle as oracle
 
     random.seed(5)
     for i in range(25):
