@@ -15,6 +15,8 @@ is BASELINE configs[4] and the flagship throughput win of the port."""
 from __future__ import annotations
 
 import asyncio
+
+from ..libs import aio
 import time
 
 import msgpack
@@ -148,8 +150,7 @@ class BlocksyncReactor(Reactor):
             return
         peer = self.switch.peers.get(peer_id)
         if peer is not None:
-            asyncio.ensure_future(
-                self.switch.stop_peer_for_error(peer, reason))
+            aio.spawn(self.switch.stop_peer_for_error(peer, reason))
 
     # ------------------------------------------------------- status gossip
 

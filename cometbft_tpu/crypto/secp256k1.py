@@ -149,10 +149,12 @@ class Secp256k1PrivKey(PrivKey):
         derivation and the scalar ladder run in OpenSSL's constant-time
         code; pinned to the published RFC 6979 secp256k1 vectors in
         tests/test_secp256k1.py."""
+        from cryptography.exceptions import UnsupportedAlgorithm
+
         try:
             der = self._sk.sign(
                 msg, ec.ECDSA(hashes.SHA256(), deterministic_signing=True))
-        except Exception as exc:  # UnsupportedAlgorithm on OpenSSL < 3.2
+        except UnsupportedAlgorithm as exc:  # OpenSSL < 3.2
             raise RuntimeError(
                 "deterministic ECDSA (RFC 6979) needs an OpenSSL 3.2+ "
                 "backend; this cryptography build does not support it"

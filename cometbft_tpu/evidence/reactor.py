@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import msgpack
 
+from ..libs import aio
+
 from ..types import codec
 from ..types.evidence import EvidenceError
 from ..p2p.reactor import ChannelDescriptor, Reactor
@@ -44,9 +46,7 @@ class EvidenceReactor(Reactor):
             # invalid gossiped evidence: drop the peer (reactor.go Receive
             # punishes the sender)
             if self.switch is not None:
-                import asyncio
-
-                asyncio.ensure_future(self.switch.stop_peer_for_error(
+                aio.spawn(self.switch.stop_peer_for_error(
                     peer, "invalid evidence"))
 
     def _msg(self, ev) -> bytes:

@@ -1,0 +1,26 @@
+"""Keep-alive task spawning.
+
+The asyncio event loop holds only WEAK references to tasks (documented
+in the asyncio API reference), so a bare ``asyncio.ensure_future(coro)``
+whose return value is dropped can be garbage-collected mid-flight and
+silently never complete.  Every fire-and-forget spawn in this package
+goes through :func:`spawn`, which parks a strong reference until the
+task finishes (ADVICE r3)."""
+
+from __future__ import annotations
+
+import asyncio
+
+_BG: set[asyncio.Task] = set()       # module-level default keep-alive set
+
+
+def spawn(coro, store: set | None = None) -> asyncio.Task:
+    """``ensure_future`` with a strong reference held until done.
+
+    ``store`` lets an owner track (and cancel on stop) its own tasks;
+    without one the module-level set keeps the task alive."""
+    t = asyncio.ensure_future(coro)
+    s = _BG if store is None else store
+    s.add(t)
+    t.add_done_callback(s.discard)
+    return t

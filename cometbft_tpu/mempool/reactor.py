@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs import aio
+
 import msgpack
 
 from ..p2p.reactor import ChannelDescriptor, Reactor
@@ -53,7 +55,7 @@ class MempoolReactor(Reactor):
         d = msgpack.unpackb(msg, raw=False)
         for tx in d.get("txs", []):
             self._senders.setdefault(TxKey(tx), set()).add(peer.id)
-            asyncio.ensure_future(self._check_tx(tx))
+            aio.spawn(self._check_tx(tx))
 
     async def _check_tx(self, tx: bytes) -> None:
         try:

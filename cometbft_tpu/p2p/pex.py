@@ -17,6 +17,8 @@ not to hold connections.
 from __future__ import annotations
 
 import asyncio
+
+from ..libs import aio
 import random
 
 import msgpack
@@ -48,6 +50,9 @@ class PexReactor(Reactor):
         self._task: asyncio.Task | None = None
         self._dialing: set[str] = set()
         self._requested: set[str] = set()    # peers we asked for addrs
+        # strong refs: the loop only weakly references tasks, so hangup
+        # timers and dial attempts could be GC'd mid-flight otherwise
+        self._bg_tasks: set[asyncio.Task] = set()
 
     def get_channels(self):
         return [ChannelDescriptor(PEX_CHANNEL, priority=1,
@@ -61,7 +66,12 @@ class PexReactor(Reactor):
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+        for t in self._bg_tasks:
+            t.cancel()
         self.book.save()
+
+    def _spawn(self, coro) -> None:
+        aio.spawn(coro, self._bg_tasks)
 
     def add_peer(self, peer) -> None:
         if peer.outbound:
@@ -122,7 +132,7 @@ class PexReactor(Reactor):
                         peer.id) is peer:
                 await self.switch.stop_peer_gracefully(peer)
 
-        asyncio.ensure_future(hangup())
+        self._spawn(hangup())
 
     def remove_peer(self, peer, reason) -> None:
         # a disconnect revokes any outstanding address-request
@@ -197,7 +207,7 @@ class PexReactor(Reactor):
                                         | {self.own_id},
                                         n=self.max_outbound - outbound):
             self._dialing.add(nid)
-            asyncio.ensure_future(self._dial(nid, addr))
+            self._spawn(self._dial(nid, addr))
 
     # ------------------------------------------------------------ crawling
 
@@ -221,7 +231,7 @@ class PexReactor(Reactor):
         exclude = set(sw.peers) | self._dialing | {self.own_id}
         for nid, addr in self.book.pick(exclude, n=4):
             self._dialing.add(nid)
-            asyncio.ensure_future(self._dial(nid, addr))
+            self._spawn(self._dial(nid, addr))
 
     async def _dial(self, nid: str, addr: str) -> None:
         try:

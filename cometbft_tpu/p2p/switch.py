@@ -9,6 +9,8 @@ with exponential backoff after errors (switch.go reconnectToPeer)."""
 from __future__ import annotations
 
 import asyncio
+
+from ..libs import aio
 import random
 
 from .conn import MConnection
@@ -112,8 +114,7 @@ class Switch:
 
         def on_error(exc: Exception) -> None:
             if peer_box:
-                asyncio.ensure_future(
-                    self.stop_peer_for_error(peer_box[0], exc))
+                aio.spawn(self.stop_peer_for_error(peer_box[0], exc))
 
         mconn = MConnection(conn, self._descriptors, on_receive, on_error,
                             ping_interval=self.ping_interval,

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs import aio
+
 from ..mempool.clist_mempool import TxRejectedError
 from ..types import events as ev
 from ..types.evidence import EvidenceError
@@ -369,7 +371,7 @@ async def broadcast_tx_async(env: Environment, tx=None) -> dict:
         except TxRejectedError:
             pass                 # async mode: rejection is not reported
 
-    asyncio.ensure_future(_fire_and_forget())
+    aio.spawn(_fire_and_forget())
     from ..mempool.mempool import TxKey
 
     return {"hash": TxKey(raw).hex(), "code": 0}

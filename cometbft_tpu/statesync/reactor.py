@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs import aio
+
 import msgpack
 
 from ..abci.types import Snapshot
@@ -51,14 +53,14 @@ class StatesyncReactor(Reactor):
         tag = d.get("@")
         if channel_id == SNAPSHOT_CHANNEL:
             if tag == "sreq":
-                asyncio.ensure_future(self._serve_snapshots(peer))
+                aio.spawn(self._serve_snapshots(peer))
             elif tag == "sres" and self.syncer is not None:
                 self.syncer.add_snapshot(peer.id, Snapshot(
                     height=d["h"], format=d["f"], chunks=d["c"],
                     hash=d["hash"], metadata=d.get("m", b"")))
         elif channel_id == CHUNK_CHANNEL:
             if tag == "creq":
-                asyncio.ensure_future(self._serve_chunk(peer, d))
+                aio.spawn(self._serve_chunk(peer, d))
             elif tag == "cres" and self.syncer is not None:
                 self.syncer.add_chunk(peer.id, d["h"], d["f"], d["i"],
                                       d["chunk"], d.get("sh", b""))

@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs import aio
+
 from ..abci import types as abci
 from ..libs import log as tmlog
 from .stateprovider import StateProvider
@@ -170,6 +172,9 @@ class Syncer:
         self._banned: set[str] = set()   # app-rejected senders
         self._chunk_event = asyncio.Event()
         self._current = None
+        # the event loop holds only weak refs to tasks; spool writes must
+        # stay strongly referenced until done or they can be GC'd mid-write
+        self._spool_tasks: set[asyncio.Task] = set()
 
     # ------------------------------------------------ reactor callbacks
 
@@ -227,7 +232,7 @@ class Syncer:
                 return
             self._chunk_event.set()
 
-        asyncio.ensure_future(_spool())
+        aio.spawn(_spool(), self._spool_tasks)
 
     def remove_peer(self, peer_id: str) -> None:
         for pending in self._snapshots.values():

@@ -114,17 +114,26 @@ class Commit:
 
         sigs = self.signatures
         n = len(sigs)
+        # peer-supplied ints can exceed uint8/int64 (the codec does not
+        # bound them); the loop path handles such commits, so out-of-range
+        # values mean "dense not applicable".  Flags load as int64 first —
+        # Python ints beyond int64 raise OverflowError on EVERY numpy
+        # major, whereas a direct uint8 conversion silently WRAPS on
+        # numpy 1.x (flag 258 -> 2 == COMMIT), which would make dense
+        # nodes tally lanes the loop path rejects — a validity divergence
+        # between nodes on different numpy majors.  The uint8 range check
+        # is then vectorized before the narrowing cast.
         try:
-            # peer-supplied ints can exceed uint8/int64 (the codec does
-            # not bound them); the loop path handles such commits, so
-            # out-of-range values mean "dense not applicable", not a
-            # crash a malicious block could use to kill blocksync
-            flags = np.fromiter((cs.block_id_flag for cs in sigs),
-                                np.uint8, n)
+            flags64 = np.fromiter((cs.block_id_flag for cs in sigs),
+                                  np.int64, n)
             ts = np.fromiter((cs.timestamp_ns for cs in sigs), np.int64, n)
         except (OverflowError, ValueError, TypeError):
             self.__dict__["_dense_cols"] = None
             return None
+        if n and not ((flags64 >= 0) & (flags64 <= 0xFF)).all():
+            self.__dict__["_dense_cols"] = None
+            return None
+        flags = flags64.astype(np.uint8)
         buf = bytearray(n * 64)
         cols = None
         for i, cs in enumerate(sigs):

@@ -99,6 +99,11 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     for idx, cs in enumerate(commit.signatures):
         if cs.is_absent():
             continue
+        if not cs.is_commit() and not verify_nil_sigs:
+            # ignoreSig runs BEFORE lookup/dup bookkeeping
+            # (validation.go:243-266): a NIL sig then a COMMIT sig from
+            # the same address is legal on the trusting path
+            continue
         if lookup_by_address:
             vi, val = vals.get_by_address(cs.validator_address)
             if vi < 0:
@@ -109,8 +114,6 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             seen.add(cs.validator_address)
         else:
             val = vals.get_by_index(idx)
-        if not cs.is_commit() and not verify_nil_sigs:
-            continue
         bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
                cs.signature)
         lanes.append(idx)
@@ -220,7 +223,11 @@ def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
     tally = 0
     for i, addr in enumerate(addrs):
         fl = int(flags[i])
-        if fl == BLOCK_ID_FLAG_ABSENT:
+        # non-commit sigs are ignored BEFORE the lookup/dup bookkeeping,
+        # matching the reference's ignoreSig ordering in
+        # verifyCommitBatch (validation.go:243-266) — a NIL sig followed
+        # by a COMMIT sig from the same address is legal there
+        if fl != BLOCK_ID_FLAG_COMMIT:
             continue
         row = aidx.get(addr)
         if row is None:
@@ -229,8 +236,6 @@ def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
             raise ErrInvalidCommit(
                 f"duplicate validator {addr.hex()} in commit")
         seen.add(addr)
-        if fl != BLOCK_ID_FLAG_COMMIT:
-            continue
         scope.append(i)
         rows.append(row)
         tally += int(powers[row])

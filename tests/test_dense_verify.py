@@ -171,6 +171,12 @@ def test_dense_columns_rejects_out_of_range_ints():
     c = copy.deepcopy(lb.commit)
     c.signatures[1].block_id_flag = 300          # > uint8
     assert c.dense_columns() is None
+    # the numpy-1.x wrap hazard: 258 would silently become 2 (== COMMIT)
+    # under a dtype conversion; the explicit Python bound check must
+    # reject it on every numpy major (ADVICE r3)
+    c258 = copy.deepcopy(lb.commit)
+    c258.signatures[1].block_id_flag = 258
+    assert c258.dense_columns() is None
     c2 = copy.deepcopy(lb.commit)
     c2.signatures[2].timestamp_ns = 2**64        # > int64
     assert c2.dense_columns() is None
@@ -223,6 +229,24 @@ def test_trusting_parity_duplicate_address(monkeypatch, chain):
     fast, slow = trusting_paths(monkeypatch, chain.validators, c,
                                 backend="cpu")
     assert fast == slow and fast[0] is V.ErrInvalidCommit
+
+
+def test_trusting_nil_then_commit_same_address_accepted(monkeypatch, chain):
+    """Reference ordering (validation.go:243-266): ignoreSig skips
+    non-commit sigs BEFORE the seen-set/dup bookkeeping, so a NIL sig
+    followed by a COMMIT sig from the same address is legal — on both
+    the dense and loop trusting paths (ADVICE r3)."""
+    from cometbft_tpu.types.commit import BLOCK_ID_FLAG_NIL
+
+    c = copy.deepcopy(chain.commit)
+    # lane 4 becomes a NIL vote carrying the same address as lane 5's
+    # commit vote; only lane 5 should count, and nothing should raise
+    c.signatures[4].validator_address = c.signatures[5].validator_address
+    c.signatures[4].block_id_flag = BLOCK_ID_FLAG_NIL
+    c.signatures[4].signature = bytes(64)       # NIL sigs aren't verified
+    fast, slow = trusting_paths(monkeypatch, chain.validators, c,
+                                backend="cpu")
+    assert fast == slow == (None, None)
 
 
 def test_trusting_parity_bad_signature(monkeypatch, chain):
