@@ -594,6 +594,58 @@ static bool ge_decompress_zip215(ge& r, const u8 s[32]) {
     return true;
 }
 
+// Affine Niels form of a Z=1 point: (Y+X, Y-X, 2d*T).  Mixed addition
+// against it costs 7 fe_mul instead of unified ge_add's 9 — the Z2
+// multiply disappears (Z2 == 1) and the 2d*T2 product is precomputed.
+// Every MSM input is freshly decompressed (Z == 1 by construction), so
+// Pippenger's bucket accumulation — the dominant cost at commit sizes —
+// rides this form.
+struct geNiels { fe ypx, ymx, t2d; };
+
+static inline void ge_to_niels(geNiels& r, const ge& p) {
+    fe_add(r.ypx, p.Y, p.X);
+    fe_sub(r.ymx, p.Y, p.X);
+    fe_mul(r.t2d, p.T, FE_2D);
+}
+
+static void ge_madd(ge& r, const ge& p, const geNiels& q) {
+    fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X);
+    fe_mul(a, t, q.ymx);                // A = (Y1-X1)(Y2-X2)
+    fe_add(t, p.Y, p.X);
+    fe_mul(b, t, q.ypx);                // B = (Y1+X1)(Y2+X2)
+    fe_mul(c, p.T, q.t2d);              // C = 2d T1 T2
+    fe_add(d, p.Z, p.Z);                // D = 2 Z1 (Z2 == 1)
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.T, e, h);
+    fe_mul(r.Z, f, g);
+}
+
+// p - q for a Niels q: negation swaps (ypx, ymx) and flips t2d's sign,
+// which folds into swapped uses and C's sign in F/G
+static void ge_msub(ge& r, const ge& p, const geNiels& q) {
+    fe a, b, c, d, e, f, g, h, t;
+    fe_sub(t, p.Y, p.X);
+    fe_mul(a, t, q.ypx);
+    fe_add(t, p.Y, p.X);
+    fe_mul(b, t, q.ymx);
+    fe_mul(c, p.T, q.t2d);
+    fe_add(d, p.Z, p.Z);
+    fe_sub(e, b, a);
+    fe_add(f, d, c);                    // F = D + C (C negated)
+    fe_sub(g, d, c);                    // G = D - C
+    fe_add(h, b, a);
+    fe_mul(r.X, e, f);
+    fe_mul(r.Y, g, h);
+    fe_mul(r.T, e, h);
+    fe_mul(r.Z, f, g);
+}
+
 // fixed-window (4-bit) scalar multiplication for the single-verify path
 static void ge_scalarmul(ge& r, const sc& k, const ge& p) {
     ge tab[16];
@@ -650,6 +702,18 @@ static void ge_msm(ge& r, const std::vector<ge>& points,
             digits[i * nwindows + w] = (int16_t)d;
         }
     }
+    // bucket adds dominate (n per window vs 2*nbuckets suffix adds);
+    // inputs are decompressed points with Z == 1, so they ride the 7-mul
+    // Niels mixed add.  The rare general caller (Z != 1) keeps unified
+    // adds.
+    bool all_affine = true;
+    for (size_t i = 0; i < n && all_affine; i++)
+        all_affine = memcmp(&points[i].Z, &FE_ONE, sizeof(fe)) == 0;
+    std::vector<geNiels> pre;
+    if (all_affine) {
+        pre.resize(n);
+        for (size_t i = 0; i < n; i++) ge_to_niels(pre[i], points[i]);
+    }
     std::vector<ge> buckets(nbuckets);
     ge acc = GE_ID;
     for (int w = nwindows - 1; w >= 0; w--) {
@@ -657,9 +721,15 @@ static void ge_msm(ge& r, const std::vector<ge>& points,
         for (int i = 0; i < nbuckets; i++) buckets[i] = GE_ID;
         for (size_t i = 0; i < n; i++) {
             int d = digits[i * nwindows + w];
+            if (d == 0) continue;
+            if (all_affine) {
+                if (d > 0) ge_madd(buckets[d - 1], buckets[d - 1], pre[i]);
+                else ge_msub(buckets[-d - 1], buckets[-d - 1], pre[i]);
+                continue;
+            }
             if (d > 0) {
                 ge_add(buckets[d - 1], buckets[d - 1], points[i]);
-            } else if (d < 0) {
+            } else {
                 ge npt;
                 ge_neg(npt, points[i]);
                 ge_add(buckets[-d - 1], buckets[-d - 1], npt);
@@ -778,6 +848,11 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
     scalars.reserve(2 * n + 1);
     sc s_total = {{0, 0, 0, 0}};
     u64 msg_off = 0;
+    // z_i: 128 independent bits each, four lanes per SHA-512(seed ||
+    // blockidx) call (the 64-byte digest yields 4x16 bytes) — the
+    // values only need to be unpredictable per batch, and one hash per
+    // four lanes quarters the derivation cost
+    u8 zblock[64];
     for (u64 i = 0; i < n; i++) {
         const u8* pub = pubs + 32 * i;
         const u8* sig = sigs + 64 * i;
@@ -790,18 +865,20 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
         const u8* msg = msg_stride ? msgs + i * msg_stride : msgs + msg_off;
         hash_ram(h, sig, pub, msg, msg_lens[i]);
         msg_off += msg_lens[i];
-        // z_i: 128 bits from SHA-512(seed || i), forced odd (nonzero)
-        Sha512 zc;
-        zc.init();
-        zc.update(seed32, 32);
-        u8 ib[8];
-        for (int j = 0; j < 8; j++) ib[j] = (u8)(i >> (8 * j));
-        zc.update(ib, 8);
-        u8 zout[64];
-        zc.final(zout);
+        if (i % 4 == 0) {
+            Sha512 zc;
+            zc.init();
+            zc.update(seed32, 32);
+            u64 blk = i / 4;
+            u8 ib[8];
+            for (int j = 0; j < 8; j++) ib[j] = (u8)(blk >> (8 * j));
+            zc.update(ib, 8);
+            zc.final(zblock);
+        }
+        const u8* zb = zblock + 16 * (i % 4);
         sc z = {{0, 0, 0, 0}};
-        for (int j = 0; j < 8; j++) z.v[0] |= (u64)zout[j] << (8 * j);
-        for (int j = 0; j < 8; j++) z.v[1] |= (u64)zout[8 + j] << (8 * j);
+        for (int j = 0; j < 8; j++) z.v[0] |= (u64)zb[j] << (8 * j);
+        for (int j = 0; j < 8; j++) z.v[1] |= (u64)zb[8 + j] << (8 * j);
         z.v[0] |= 1;
         // s_total += z*s ; points += { -R with z, -A with z*h }
         sc zs, zh;
