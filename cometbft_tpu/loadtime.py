@@ -115,7 +115,7 @@ async def report(client, run_id: str | None = None,
     committed load txs (loadtime/report's ``Report`` statistics)."""
     st = await client.call("status")
     tip = st["sync_info"]["latest_block_height"]
-    latencies_ns: list[int] = []
+    tx_send: list[tuple[int, int]] = []      # (height, send_ts_ns)
     first_h = last_h = None
     block_time: dict[int, int] = {}
     for h in range(max(1, min_height), tip + 1):
@@ -131,11 +131,23 @@ async def report(client, run_id: str | None = None,
             rid, _seq, t_send = parsed
             if run_id is not None and rid != run_id:
                 continue
-            latencies_ns.append(hdr["ts"] - t_send)
+            tx_send.append((h, t_send))
             first_h = h if first_h is None else first_h
             last_h = h
-    if not latencies_ns:
+    if not tx_send:
         return {"txs": 0}
+    # Latency target: when PBTS is off, block h's own header time is the
+    # MEDIAN PRECOMMIT TIME OF HEIGHT h-1 (BFT time, sm/validation.py
+    # median_time) — about one interval before h was even proposed, so
+    # "header.ts - send" goes negative for promptly-included txs (the
+    # reference's loadtime/report subtracts its own block time too, but
+    # it measures PBTS chains where that IS the proposal time).  The next
+    # block's timestamp is height h's commit-time proxy under both time
+    # schemes, so latency = ts(h+1) - send; the tip block falls back to
+    # its own ts (txs there are a tail fraction once the run drains).
+    latencies_ns = [
+        (block_time.get(h + 1, block_time[h]) - t_send)
+        for h, t_send in tx_send]
     lat_s = sorted(x / 1e9 for x in latencies_ns)
 
     def pct(p):
