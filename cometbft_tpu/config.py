@@ -103,6 +103,19 @@ class RPCConfig:
     max_open_connections: int = 900
     max_subscription_clients: int = 100
     unsafe: bool = False              # dial_seeds/dial_peers/flush_mempool
+    # CORS (config/config.go:353-364): origins may carry ONE wildcard
+    # each; '*' alone allows every origin
+    cors_allowed_origins: list[str] = field(default_factory=list)
+    cors_allowed_methods: list[str] = field(
+        default_factory=lambda: ["HEAD", "GET", "POST"])
+    cors_allowed_headers: list[str] = field(
+        default_factory=lambda: ["Origin", "Accept", "Content-Type",
+                                 "X-Requested-With", "X-Server-Time"])
+    # HTTPS (config/config.go:428-442): BOTH files present -> TLS server,
+    # else plain HTTP.  Paths may be absolute or relative to the config
+    # directory, like the reference.
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
 
 
 @dataclass

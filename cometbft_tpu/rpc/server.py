@@ -91,11 +91,11 @@ class RPCServer:
         """``routes`` overrides the default route table (the light proxy
         serves verified routes against a light client instead)."""
         self.env = Environment(node)
+        cfg = getattr(node, "config", None)
         if routes is not None:
             self.routes = routes
         else:
             self.routes = dict(ROUTES)
-            cfg = getattr(node, "config", None)
             if cfg is not None and getattr(cfg.rpc, "unsafe", False):
                 from .core import UNSAFE_ROUTES
 
@@ -103,10 +103,81 @@ class RPCServer:
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._ws_counter = 0
+        # CORS + TLS from config (config/config.go:353-364,428-442); a
+        # config-less node (light proxy shim) gets RPCConfig's defaults —
+        # ONE source of truth, and an explicitly configured empty list
+        # stays empty
+        from ..config import RPCConfig
+
+        rpc_cfg = getattr(cfg, "rpc", None)
+        if rpc_cfg is None:
+            rpc_cfg = RPCConfig()
+        self._cors_origins = list(rpc_cfg.cors_allowed_origins)
+        self._cors_methods = list(rpc_cfg.cors_allowed_methods)
+        self._cors_headers = list(rpc_cfg.cors_allowed_headers)
+        self._ssl_ctx = self._build_ssl(cfg)
+        self._openapi_raw: bytes | None = None
+
+    @staticmethod
+    def _build_ssl(cfg):
+        """ssl.SSLContext when BOTH tls_cert_file and tls_key_file are
+        configured (else plain HTTP), resolving relative paths against
+        the config dir like the reference (config.go CertFile())."""
+        import os
+        import ssl
+
+        rpc_cfg = getattr(cfg, "rpc", None)
+        cert = getattr(rpc_cfg, "tls_cert_file", "") or ""
+        key = getattr(rpc_cfg, "tls_key_file", "") or ""
+        if not cert or not key:
+            return None
+        root = getattr(getattr(cfg, "base", None), "root_dir", ".") or "."
+        conf_dir = os.path.join(root, "config")
+        if not os.path.isabs(cert):
+            cert = os.path.join(conf_dir, cert)
+        if not os.path.isabs(key):
+            key = os.path.join(conf_dir, key)
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert, key)
+        return ctx
+
+    def _origin_allowed(self, origin: str) -> str | None:
+        """The Access-Control-Allow-Origin value for ``origin``, or None
+        when CORS is off / the origin isn't allowed.  Each allowed origin
+        may carry ONE ``*`` wildcard (rs/cors semantics the reference
+        wires in rpc/jsonrpc/server)."""
+        if not origin or not self._cors_origins:
+            return None
+        # the matched origin is echoed into a response header: control
+        # characters (a smuggled bare CR especially) must never pass a
+        # wildcard match into the response (header-injection vector)
+        if any(ord(ch) < 0x20 or ord(ch) == 0x7F for ch in origin):
+            return None
+        for allowed in self._cors_origins:
+            if allowed == "*":
+                return "*"
+            if "*" in allowed:
+                head, _, tail = allowed.partition("*")
+                if origin.startswith(head) and origin.endswith(tail) and \
+                        len(origin) >= len(head) + len(tail):
+                    return origin
+            elif allowed == origin:
+                return origin
+        return None
+
+    def _cors_response_headers(self, headers: dict) -> bytes:
+        allow = self._origin_allowed(headers.get("origin", ""))
+        if allow is None:
+            return b""
+        out = f"Access-Control-Allow-Origin: {allow}\r\n"
+        if allow != "*":
+            out += "Vary: Origin\r\n"
+        return out.encode()
 
     async def listen(self, host: str = "127.0.0.1",
                      port: int = 0) -> tuple[str, int]:
-        self._server = await asyncio.start_server(self._serve, host, port)
+        self._server = await asyncio.start_server(self._serve, host, port,
+                                                  ssl=self._ssl_ctx)
         addr = self._server.sockets[0].getsockname()
         return addr[0], addr[1]
 
@@ -119,6 +190,57 @@ class RPCServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+
+    def openapi_spec(self) -> dict:
+        """OpenAPI 3.0 document derived from the LIVE route table by
+        introspection (handler signatures + docstrings), the role of the
+        reference's hand-written ``rpc/openapi/openapi.yaml``."""
+        import inspect
+
+        paths = {}
+        for name in sorted(self.routes):
+            fn = self.routes[name]
+            params = []
+            try:
+                sig = inspect.signature(fn)
+            except (TypeError, ValueError):
+                sig = None
+            if sig is not None:
+                for pname, p in sig.parameters.items():
+                    if pname == "env" or p.kind in (
+                            p.VAR_POSITIONAL, p.VAR_KEYWORD):
+                        continue
+                    params.append({
+                        "name": pname,
+                        "in": "query",
+                        "required": p.default is inspect.Parameter.empty,
+                        "schema": {"type": "string"},
+                    })
+            doc = inspect.getdoc(fn) or ""
+            paths[f"/{name}"] = {"get": {
+                "operationId": name,
+                "summary": doc.splitlines()[0] if doc else name,
+                "description": doc,
+                "parameters": params,
+                "responses": {"200": {
+                    "description": "JSON-RPC 2.0 envelope",
+                    "content": {"application/json": {"schema": {
+                        "type": "object"}}},
+                }},
+            }}
+        return {
+            "openapi": "3.0.0",
+            "info": {
+                "title": "cometbft-tpu RPC",
+                "version": "1.0",
+                "description": (
+                    "JSON-RPC 2.0 over HTTP: every path also accepts "
+                    "POST / with {jsonrpc, id, method, params}, and "
+                    "/websocket carries the same methods plus "
+                    "subscribe/unsubscribe."),
+            },
+            "paths": paths,
+        }
 
     # ------------------------------------------------------------- http
 
@@ -158,25 +280,69 @@ class RPCServer:
                         return
                     body = await reader.readexactly(ln)
 
-                if method == "GET" and urlsplit(target).path == "/metrics":
+                cors = self._cors_response_headers(headers)
+                if method == "OPTIONS":
+                    # CORS preflight: 204 with the allow-* set when the
+                    # origin matches; bare 204 otherwise (rs/cors shape)
+                    pre = b""
+                    if cors:
+                        pre = cors + (
+                            "Access-Control-Allow-Methods: "
+                            f"{', '.join(self._cors_methods)}\r\n"
+                            "Access-Control-Allow-Headers: "
+                            f"{', '.join(self._cors_headers)}\r\n"
+                            "Access-Control-Max-Age: 600\r\n").encode()
+                    writer.write(
+                        b"HTTP/1.1 204 No Content\r\n" + pre +
+                        b"Content-Length: 0\r\n"
+                        b"Connection: keep-alive\r\n\r\n")
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        return
+                    continue
+                path = urlsplit(target).path
+                if method in ("GET", "HEAD") and path == "/metrics":
                     # Prometheus text exposition (the reference serves this
                     # on the instrumentation port; here it rides the RPC
-                    # listener)
+                    # listener).  HEAD gets GET's headers, no body
+                    # (RFC 9110 9.3.2).
                     from ..libs import metrics as _metrics
 
                     text = _metrics.DEFAULT.collect().encode()
                     writer.write(
                         b"HTTP/1.1 200 OK\r\n"
                         b"Content-Type: text/plain; version=0.0.4\r\n"
+                        + cors +
                         b"Content-Length: " + str(len(text)).encode() +
-                        b"\r\nConnection: keep-alive\r\n\r\n" + text)
+                        b"\r\nConnection: keep-alive\r\n\r\n" +
+                        (b"" if method == "HEAD" else text))
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        return
+                    continue
+                if method in ("GET", "HEAD") and path == "/openapi":
+                    # machine-readable route table (the reference ships
+                    # rpc/openapi/openapi.yaml; here the spec is derived
+                    # from the live table so it can never go stale);
+                    # routes are fixed after __init__ so the serialized
+                    # document is computed once
+                    if self._openapi_raw is None:
+                        self._openapi_raw = json.dumps(
+                            self.openapi_spec()).encode()
+                    text = self._openapi_raw
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: application/json\r\n" + cors +
+                        b"Content-Length: " + str(len(text)).encode() +
+                        b"\r\nConnection: keep-alive\r\n\r\n" +
+                        (b"" if method == "HEAD" else text))
                     await writer.drain()
                     if headers.get("connection", "").lower() == "close":
                         return
                     continue
                 if method == "POST":
                     resp = await self._handle_jsonrpc_body(body)
-                elif method == "GET":
+                elif method in ("GET", "HEAD"):
                     resp = await self._handle_uri(target)
                 else:
                     resp = _rpc_error(None, -32600,
@@ -184,9 +350,10 @@ class RPCServer:
                 raw = json.dumps(resp).encode()
                 writer.write(
                     b"HTTP/1.1 200 OK\r\n"
-                    b"Content-Type: application/json\r\n"
+                    b"Content-Type: application/json\r\n" + cors +
                     b"Content-Length: " + str(len(raw)).encode() +
-                    b"\r\nConnection: keep-alive\r\n\r\n" + raw)
+                    b"\r\nConnection: keep-alive\r\n\r\n" +
+                    (b"" if method == "HEAD" else raw))
                 await writer.drain()
                 if headers.get("connection", "").lower() == "close":
                     return

@@ -1,0 +1,208 @@
+"""RPC TLS, CORS, and the generated OpenAPI document (reference:
+``config/config.go:353-364,428-442`` wiring in ``rpc/jsonrpc/server``;
+``rpc/openapi/openapi.yaml``)."""
+
+import asyncio
+import datetime
+import json
+import ssl
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.config import test_consensus_config as _tcc
+from cometbft_tpu.node import Node
+from cometbft_tpu.p2p import NodeKey
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _self_signed(tmp_path):
+    """Self-signed localhost cert via the cryptography package."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=1))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName("localhost"),
+                 x509.IPAddress(__import__("ipaddress")
+                                .ip_address("127.0.0.1"))]), critical=False)
+            .sign(key, hashes.SHA256()))
+    cert_path = tmp_path / "rpc.crt"
+    key_path = tmp_path / "rpc.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(key.private_bytes(
+        serialization.Encoding.PEM, serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()))
+    return str(cert_path), str(key_path)
+
+
+async def _node(cfg: Config) -> Node:
+    pv = MockPV.from_secret(b"tlsnode")
+    doc = GenesisDoc(chain_id="tls-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    node = await Node.create(doc, KVStoreApplication(), priv_validator=pv,
+                             config=cfg,
+                             node_key=NodeKey.from_secret(b"tlsk"),
+                             name="tls0")
+    await node.start()
+    return node
+
+
+def _cfg() -> Config:
+    cfg = Config(consensus=_tcc())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    return cfg
+
+
+async def _raw_http(host, port, req: bytes, ssl_ctx=None) -> bytes:
+    reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
+    writer.write(req)
+    await writer.drain()
+    data = await asyncio.wait_for(reader.read(1 << 20), 10)
+    writer.close()
+    return data
+
+
+def test_tls_round_trip(tmp_path):
+    """Both tls files configured -> the RPC listener speaks HTTPS; a
+    TLS client round-trips a status call, a plaintext client fails."""
+    cert, key = _self_signed(tmp_path)
+
+    async def main():
+        cfg = _cfg()
+        cfg.rpc.tls_cert_file = cert      # absolute paths
+        cfg.rpc.tls_key_file = key
+        node = await _node(cfg)
+        try:
+            host, port = node.rpc_addr
+            cli = ssl.create_default_context()
+            cli.check_hostname = False
+            cli.verify_mode = ssl.CERT_NONE
+            raw = await _raw_http(
+                host, port,
+                b"GET /status HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n", ssl_ctx=cli)
+            body = raw.split(b"\r\n\r\n", 1)[1]
+            assert json.loads(body)["result"]["node_info"][
+                "network"] == "tls-net"
+            # plaintext against the TLS port must NOT yield an HTTP reply
+            try:
+                raw2 = await _raw_http(
+                    host, port,
+                    b"GET /status HTTP/1.1\r\nHost: x\r\n"
+                    b"Connection: close\r\n\r\n")
+                assert not raw2.startswith(b"HTTP/1.1 200")
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                pass
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_cors_preflight_and_simple_request():
+    async def main():
+        cfg = _cfg()
+        cfg.rpc.cors_allowed_origins = ["https://app.example.com",
+                                        "https://*.trusted.dev"]
+        node = await _node(cfg)
+        try:
+            host, port = node.rpc_addr
+            # preflight from an allowed origin
+            raw = await _raw_http(
+                host, port,
+                b"OPTIONS /status HTTP/1.1\r\nHost: x\r\n"
+                b"Origin: https://app.example.com\r\n"
+                b"Access-Control-Request-Method: POST\r\n"
+                b"Connection: close\r\n\r\n")
+            head = raw.split(b"\r\n\r\n", 1)[0].decode()
+            assert "204" in head.splitlines()[0]
+            assert "Access-Control-Allow-Origin: https://app.example.com" \
+                in head
+            assert "Access-Control-Allow-Methods:" in head
+            # wildcard origin matches one subdomain level (rs/cors rule:
+            # one * per origin)
+            raw = await _raw_http(
+                host, port,
+                b"GET /status HTTP/1.1\r\nHost: x\r\n"
+                b"Origin: https://ci.trusted.dev\r\n"
+                b"Connection: close\r\n\r\n")
+            head = raw.split(b"\r\n\r\n", 1)[0].decode()
+            assert "Access-Control-Allow-Origin: https://ci.trusted.dev" \
+                in head
+            # a disallowed origin gets NO CORS headers (browser blocks)
+            raw = await _raw_http(
+                host, port,
+                b"GET /status HTTP/1.1\r\nHost: x\r\n"
+                b"Origin: https://evil.example.net\r\n"
+                b"Connection: close\r\n\r\n")
+            head = raw.split(b"\r\n\r\n", 1)[0].decode()
+            assert "Access-Control-Allow-Origin" not in head
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_cors_off_by_default():
+    async def main():
+        node = await _node(_cfg())
+        try:
+            host, port = node.rpc_addr
+            raw = await _raw_http(
+                host, port,
+                b"GET /status HTTP/1.1\r\nHost: x\r\n"
+                b"Origin: https://anything.example\r\n"
+                b"Connection: close\r\n\r\n")
+            assert b"Access-Control-Allow-Origin" not in raw
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_openapi_spec_served_and_complete():
+    async def main():
+        node = await _node(_cfg())
+        try:
+            host, port = node.rpc_addr
+            raw = await _raw_http(
+                host, port,
+                b"GET /openapi HTTP/1.1\r\nHost: x\r\n"
+                b"Connection: close\r\n\r\n")
+            spec = json.loads(raw.split(b"\r\n\r\n", 1)[1])
+            assert spec["openapi"].startswith("3.")
+            # every live route is documented; spot-check parameters
+            for route in ("status", "block", "tx", "validators",
+                          "broadcast_tx_commit", "abci_query"):
+                assert f"/{route}" in spec["paths"], route
+            names = [p["name"] for p in
+                     spec["paths"]["/block"]["get"]["parameters"]]
+            assert "height" in names
+        finally:
+            await node.stop()
+
+    run(main())
