@@ -12,13 +12,12 @@ Scope and honesty notes:
   chains — slower, but correct by definition).
 - Point (de)serialization follows the zcash/blst compressed format
   (48-byte G1 / 96-byte G2, flag bits, lexicographic y-sign).
-- Hash-to-curve uses RFC 9380's Shallue–van de Woestijne map with
-  expand_message_xmd(SHA-256) and the standard ciphersuite DST.  The
-  standard BLS12-381 G2 suite instead mandates SSWU over an isogenous
-  curve; SVDW is equally uniform and deterministic but produces
-  DIFFERENT points, so signatures interop only with this module
-  (install py_ecc/blspy for standard-suite compatibility — the backend
-  seam prefers them automatically).
+- Hash-to-curve is the STANDARD G2 suite,
+  BLS12381G2_XMD:SHA-256_SSWU_RO (RFC 9380 §8.8.2): simple SWU on the
+  isogenous curve E', the 3-isogeny of App. E.3, h_eff cofactor
+  clearing — pinned byte-exactly to the RFC's QUUX test vectors in
+  tests/test_bls12381.py, so signatures interoperate with blst-class
+  implementations.
 - Performance: a verify costs two pairings, seconds in CPython.  This
   is a functional fallback, not a production signer.
 
@@ -580,82 +579,118 @@ def _hash_to_field_fq2(msg: bytes, count: int, dst: bytes):
     return out
 
 
-def _g2_curve_rhs(x):
-    return f2_add(f2_mul(f2_sqr(x), x), B2)
+# -------------------------- standard-suite SSWU + 3-isogeny (RFC 9380)
+# BLS12381G2_XMD:SHA-256_SSWU_RO: simple SWU on the isogenous curve
+# E': y^2 = x^3 + A'x + B' over Fq2 (§8.8.2), then the 3-isogeny to E
+# (App. E.3), then h_eff cofactor clearing.  This REPLACES the previous
+# SVDW map: SVDW was uniform but self-interop only; SSWU makes
+# hash_to_g2 byte-compatible with blst and every other standard-suite
+# implementation (pinned by the RFC's own QUUX test vectors in
+# tests/test_bls12381.py).
+
+_SSWU_A = (0, 240)                          # A' = 240 I
+_SSWU_B = (1012, 1012)                      # B' = 1012 (1 + I)
+_SSWU_Z = (P - 2, P - 1)                    # Z  = -(2 + I)
+
+# 3-isogeny coefficients (RFC 9380 App. E.3), Horner order low->high
+_K = 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6
+_ISO3_XNUM = [
+    (_K, _K),
+    (0, 0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D),
+    (0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1, 0),
+]
+_ISO3_XDEN = [
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63),
+    (0xC, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F),
+    (1, 0),                                  # monic x^2 term
+]
+_ISO3_YNUM = [
+    (0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+     0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706),
+    (0, 0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE),
+    (0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+     0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F),
+    (0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10, 0),
+]
+_ISO3_YDEN = [
+    (0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+     0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB),
+    (0, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3),
+    (0x12, 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99),
+    (1, 0),                                  # monic x^3 term
+]
+
+# h_eff for the G2 suite (RFC 9380 §8.8.2) — NOT the plain cofactor h2:
+# the standard suite's vectors and every interop implementation clear
+# with this value (h_eff ≡ c * h2 with c coprime to r, so both land in
+# G2, but on DIFFERENT points of it)
+H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
 
 
-# SVDW constants for E2 (computed once; Z chosen per RFC 9380 App. H:
-# the smallest |z| making g(Z) != 0, (3Z^2+4A)... we search at import).
-
-def _find_svdw_z():
-    for cand in range(1, 50):
-        for z in ((cand, 0), (P - cand, 0), (0, cand), (cand, cand)):
-            gz = _g2_curve_rhs(z)
-            if f2_is_zero(gz):
-                continue
-            h = f2_scalar(f2_sqr(z), 3)          # 3Z^2 (A = 0)
-            if f2_is_zero(h):
-                continue
-            neg_gh = f2_neg(f2_mul(gz, h))
-            # need sqrt(-g(Z) * (3Z^2)) to exist
-            if f2_legendre(neg_gh) != 1:
-                continue
-            # and g(Z)/... conditions reduce to these for A=0
-            return z, gz, f2_sqrt(neg_gh)
-    raise RuntimeError("no SVDW Z found")
+def _sgn0_fq2(x) -> int:
+    """RFC 9380 §4.1 sgn0 for m = 2."""
+    return (x[0] & 1) | ((x[0] == 0) & (x[1] & 1))
 
 
-_Z, _GZ, _SQRT_NEG_GH = _find_svdw_z()
-_C1 = _GZ
-_C2 = f2_neg(f2_scalar(_Z, pow(2, -1, P)))
-_C3 = _SQRT_NEG_GH if not _fq2_larger(_SQRT_NEG_GH) \
-    else f2_neg(_SQRT_NEG_GH)
-_C4 = f2_mul(f2_scalar(_GZ, 4), f2_inv(f2_scalar(f2_sqr(_Z), 3)))
-_C4 = f2_neg(_C4)
+def _horner(coeffs, x):
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = f2_add(f2_mul(acc, x), c)
+    return acc
 
 
-def _map_to_curve_svdw(u):
-    """RFC 9380 §6.6.1 straight-line SVDW for E2 (A=0, B=4(1+u))."""
-    tv1 = f2_mul(f2_sqr(u), _C1)
-    tv2 = f2_add(F2_ONE, tv1)
-    tv1 = f2_sub(F2_ONE, tv1)
-    tv3 = f2_mul(tv1, tv2)
-    tv3 = f2_inv(tv3)
-    tv4 = f2_mul(f2_mul(u, tv1), f2_mul(tv3, _C3))
-    x1 = f2_sub(_C2, tv4)
-    x2 = f2_add(_C2, tv4)
-    t2t3 = f2_mul(f2_sqr(tv2), tv3)
-    x3 = f2_add(_Z, f2_mul(_C4, f2_sqr(t2t3)))
-    for x in (x1, x2, x3):
-        gx = _g2_curve_rhs(x)
-        y = f2_sqrt(gx)
-        if y is not None:
-            # sign of y matches sign of u (sgn0-style: use lexicographic)
-            if _fq2_larger(y) != _fq2_larger(u):
-                y = f2_neg(y)
-            return (x, y)
-    raise RuntimeError("SVDW: no x candidate on curve (unreachable)")
+def _map_to_curve_sswu(u):
+    """Simple SWU on E' (RFC 9380 §6.6.2), returning an E' point."""
+    A, B, Z = _SSWU_A, _SSWU_B, _SSWU_Z
+    u2 = f2_sqr(u)
+    zu2 = f2_mul(Z, u2)
+    tv = f2_add(f2_sqr(zu2), zu2)            # Z^2 u^4 + Z u^2
+    if f2_is_zero(tv):
+        # exceptional case: x1 = B / (Z A)
+        x1 = f2_mul(B, f2_inv(f2_mul(Z, A)))
+    else:
+        x1 = f2_mul(f2_mul(f2_neg(B), f2_inv(A)),
+                    f2_add(F2_ONE, f2_inv(tv)))
+    gx1 = f2_add(f2_add(f2_mul(f2_sqr(x1), x1), f2_mul(A, x1)), B)
+    y1 = f2_sqrt(gx1)
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = f2_mul(zu2, x1)
+        gx2 = f2_add(f2_add(f2_mul(f2_sqr(x2), x2), f2_mul(A, x2)), B)
+        y2 = f2_sqrt(gx2)
+        if y2 is None:                       # impossible by SWU theory
+            raise RuntimeError("SSWU: neither candidate square")
+        x, y = x2, y2
+    if _sgn0_fq2(u) != _sgn0_fq2(y):
+        y = f2_neg(y)
+    return (x, y)
 
 
-# G2 cofactor from the BLS12 family polynomial
-# h2(x) = (x^8 - 4x^7 + 5x^6 - 4x^4 + 6x^3 - 4x^2 - 4x + 13)/9
-# (tests verify (h2*r)*P = O for mapped curve points, i.e. h2*r is the
-# twist group order and r divides it exactly once).
-H2 = (X ** 8 - 4 * X ** 7 + 5 * X ** 6 - 4 * X ** 4 + 6 * X ** 3
-      - 4 * X ** 2 - 4 * X + 13) // 9
-assert H2 % R != 0
-
-
-def _clear_cofactor_g2(pt):
-    """Multiply by the G2 cofactor h2."""
-    return g2_mul(pt, H2)
+def _iso3_map(pt):
+    """The 3-isogeny E' -> E (App. E.3 rational maps)."""
+    x, y = pt
+    xn = _horner(_ISO3_XNUM, x)
+    xd = _horner(_ISO3_XDEN, x)
+    yn = _horner(_ISO3_YNUM, x)
+    yd = _horner(_ISO3_YDEN, x)
+    if f2_is_zero(xd) or f2_is_zero(yd):
+        return None                          # exceptional: infinity
+    X = f2_mul(xn, f2_inv(xd))
+    Y = f2_mul(y, f2_mul(yn, f2_inv(yd)))
+    return (X, Y)
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST):
+    """BLS12381G2_XMD:SHA-256_SSWU_RO hash_to_curve (RFC 9380 §8.8.2):
+    standard-suite, byte-compatible with blst (QUUX vectors pinned in
+    tests/test_bls12381.py)."""
     u0, u1 = _hash_to_field_fq2(msg, 2, dst)
-    q0 = _map_to_curve_svdw(u0)
-    q1 = _map_to_curve_svdw(u1)
-    return _clear_cofactor_g2(g2_add(q0, q1))
+    q0 = _iso3_map(_map_to_curve_sswu(u0))
+    q1 = _iso3_map(_map_to_curve_sswu(u1))
+    return g2_mul(g2_add(q0, q1), H_EFF)
 
 
 # ------------------------------------------------------------ signatures

@@ -87,11 +87,12 @@ class _BlspyBackend:
 class _PurePyBackend:
     """The bundled pure-Python implementation (``_bls12381_py``):
     dependency-free and always available, so BLS keys WORK out of the
-    box where the reference's default build only errors.  Slow (seconds
-    per verify — two pairings in CPython) and, because its hash-to-curve
-    uses RFC 9380's SVDW map rather than the standard G2 suite's
-    SSWU+isogeny, self-interop only; the seam prefers a standard-suite
-    host library when one is importable."""
+    box where the reference's default build only errors.  Since r4 its
+    hash-to-curve is the STANDARD G2 suite (RFC 9380 SSWU + 3-isogeny +
+    h_eff, pinned to the RFC's own QUUX vectors), so signatures are
+    byte-interoperable with blst/py_ecc/blspy.  Still slow (seconds per
+    verify — two pairings in CPython); the seam prefers a native host
+    library when one is importable."""
 
     def __init__(self):
         from . import _bls12381_py as impl
@@ -132,12 +133,13 @@ def _backend():
 _BACKEND = _backend()                # resolved once at import
 ENABLED = _BACKEND is not None
 
-# The IETF ciphersuite each backend implements.  py_ecc / blspy speak the
-# standard G2Basic suite; the bundled fallback's SVDW hash-to-curve is a
-# distinct (self-interop-only) suite — mixing the two across a validator
-# set is a consensus-split hazard, so nodes must agree on the suite.
+# The IETF ciphersuite each backend implements.  Every backend —
+# including the bundled pure-Python fallback since its r4 SSWU
+# conversion — speaks the standard G2Basic suite, so there is no
+# consensus-split hazard left; the guard machinery below stays as a
+# safety net should a future backend deviate.
 STANDARD_CIPHERSUITE = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"
-_PUREPY_CIPHERSUITE = "PUREPY_BLS12381G2_XMD:SHA-256_SVDW_RO_NUL_"
+_PUREPY_CIPHERSUITE = STANDARD_CIPHERSUITE
 
 
 def backend_ciphersuite() -> str:
@@ -194,10 +196,10 @@ def _warn_purepy_signing() -> None:
     import sys
 
     print("WARNING: signing with a bls12_381 key on the bundled "
-          "pure-Python backend — variable-time scalar multiplication "
-          "leaks key bits through timing, and the hash-to-curve suite is "
-          "non-standard (self-interop only). Install py_ecc or blspy for "
-          "production validators.", file=sys.stderr)
+          "pure-Python backend — signatures are standard-suite "
+          "(RFC 9380 SSWU) and interoperable, but the variable-time "
+          "scalar multiplication leaks key bits through timing. Install "
+          "py_ecc or blspy for production validators.", file=sys.stderr)
 
 
 class Bls12381PubKey(PubKey):

@@ -166,12 +166,15 @@ class RPCServer:
         return None
 
     def _cors_response_headers(self, headers: dict) -> bytes:
-        allow = self._origin_allowed(headers.get("origin", ""))
-        if allow is None:
+        if not self._cors_origins:
             return b""
-        out = f"Access-Control-Allow-Origin: {allow}\r\n"
-        if allow != "*":
-            out += "Vary: Origin\r\n"
+        allow = self._origin_allowed(headers.get("origin", ""))
+        # Vary: Origin goes on EVERY response once CORS is on (match or
+        # not) — a shared cache must never serve an Origin-less cached
+        # response to a browser on an allowed origin (rs/cors behavior)
+        out = "Vary: Origin\r\n"
+        if allow is not None:
+            out += f"Access-Control-Allow-Origin: {allow}\r\n"
         return out.encode()
 
     async def listen(self, host: str = "127.0.0.1",

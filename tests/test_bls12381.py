@@ -83,9 +83,41 @@ def test_hash_to_g2_deterministic_and_in_subgroup():
     # a mapped-but-uncleared point is NOT in the subgroup (cofactor > 1
     # actually does something)
     u = b._hash_to_field_fq2(b"x", 1, b"test")[0]
-    raw_pt = b._map_to_curve_svdw(u)
+    raw_pt = b._iso3_map(b._map_to_curve_sswu(u))
     assert b.g2_is_on_curve(raw_pt)
     assert not b.g2_in_subgroup(raw_pt)
+
+
+def test_sswu_matches_rfc9380_vectors():
+    """The standard-suite claim, pinned byte-exactly: RFC 9380 §G.2
+    BLS12381G2_XMD:SHA-256_SSWU_RO_ vectors (QUUX DST).  Any deviation
+    in SSWU, the 3-isogeny constants, hash_to_field, or h_eff clearing
+    fails this — passing means blst-class interop."""
+    DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    vecs = {
+        b"": ((0x0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d9b8d4ac44c1038e9dcdd5393faf5c41fb78a,
+               0x05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba13dff5bf5dd71b72418717047f5b0f37da03d),
+              (0x0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee75ec076daf2d4bc358c4b190c0c98064fdd92,
+               0x12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6)),
+        b"abc": ((0x02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbec7780ccc7954725f4168aff2787776e6,
+               0x139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4ca3a230ed250fbe3a2acf73a41177fd8),
+              (0x1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244aeb197642555a0645fb87bf7466b2ba48,
+               0x00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e1ce70dd94a733534f106d4cec0eddd16)),
+        b"abcdef0123456789": ((0x121982811d2491fde9ba7ed31ef9ca474f0e1501297f68c298e9f4c0028add35aea8bb83d53c08cfc007c1e005723cd0,
+               0x190d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169fb3968288b3fafb265f9ebd380512a71c3f2c),
+              (0x05571a0f8d3c08d094576981f4a3b8eda0a8e771fcdcc8ecceaf1356a6acf17574518acb506e435b639353c2e14827c8,
+               0x0bb5e7572275c567462d91807de765611490205a941a5a6af3b1691bfe596c31225d3aabdf15faff860cb4ef17c7c3be)),
+    }
+    for msg, want in vecs.items():
+        assert b.hash_to_g2(msg, DST) == want, msg
+
+
+def test_backend_is_standard_suite():
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    assert keys.is_standard_backend()
+    assert keys.backend_ciphersuite() == keys.STANDARD_CIPHERSUITE
+    assert keys.check_validator_backend() is None
 
 
 def test_signature_scheme_through_key_seam():

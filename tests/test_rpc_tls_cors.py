@@ -81,7 +81,7 @@ async def _raw_http(host, port, req: bytes, ssl_ctx=None) -> bytes:
     reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
     writer.write(req)
     await writer.drain()
-    data = await asyncio.wait_for(reader.read(1 << 20), 10)
+    data = await asyncio.wait_for(reader.read(-1), 10)  # to EOF
     writer.close()
     return data
 
