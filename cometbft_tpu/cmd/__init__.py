@@ -617,17 +617,14 @@ def cmd_debug_wal(args) -> int:
     return 0
 
 
-def cmd_debug_dump(args) -> int:
-    """commands/debug: capture a post-mortem bundle — node introspection
-    over RPC when the node is up, plus config and WAL/data listings."""
-    import tarfile
-    import time as _time
-
-    out_dir = args.output_dir or f"debug-dump-{int(_time.time())}"
+def _debug_collect(rpc: str, home: str, out_dir: str) -> None:
+    """Shared capture core for ``debug dump``/``debug kill``: node
+    introspection over RPC when the node is up, plus config and WAL/data
+    listings from the home directory."""
     os.makedirs(out_dir, exist_ok=True)
 
     async def fetch_rpc():
-        client = _rpc_client(args.rpc)
+        client = _rpc_client(rpc)
         for route in ("status", "net_info", "consensus_state",
                       "dump_consensus_state", "num_unconfirmed_txs"):
             try:
@@ -640,7 +637,6 @@ def cmd_debug_dump(args) -> int:
 
     asyncio.run(fetch_rpc())
 
-    home = args.home
     if os.path.isdir(home):
         cfgp = _cfg_path(home)
         if os.path.exists(cfgp):
@@ -661,9 +657,106 @@ def cmd_debug_dump(args) -> int:
         if wal_file and os.path.isfile(wal_file):
             shutil.copy(wal_file, os.path.join(out_dir, "wal_tail.bin"))
 
-    tar_path = out_dir.rstrip("/") + ".tar.gz"
+
+def _debug_tar(out_dir: str, tar_path: str | None = None) -> str:
+    import tarfile
+
+    tar_path = tar_path or out_dir.rstrip("/") + ".tar.gz"
     with tarfile.open(tar_path, "w:gz") as tar:
-        tar.add(out_dir, arcname=os.path.basename(out_dir))
+        tar.add(out_dir, arcname=os.path.basename(
+            out_dir.rstrip("/")) or "debug")
+    return tar_path
+
+
+def cmd_debug_dump(args) -> int:
+    """commands/debug: capture a post-mortem bundle — node introspection
+    over RPC when the node is up, plus config and WAL/data listings."""
+    import time as _time
+
+    out_dir = args.output_dir or f"debug-dump-{int(_time.time())}"
+    _debug_collect(args.rpc, args.home, out_dir)
+    print(f"Debug bundle written to {_debug_tar(out_dir)}")
+    return 0
+
+
+def cmd_debug_kill(args) -> int:
+    """commands/debug/kill.go: aggregate a RUNNING node's state — RPC
+    dumps, config, WAL tail, /proc process state — trigger its in-process
+    stack dumps (SIGUSR1 thread stacks + SIGUSR2 asyncio tasks, the
+    goroutine-dump analogue, written to the node's stderr), terminate it,
+    and package everything into one archive."""
+    import signal as _signal
+    import tempfile
+    import time as _time
+
+    pid = args.pid
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        print(f"no such process: {pid}", file=sys.stderr)
+        return 1
+    except PermissionError:
+        print(f"not permitted to signal pid {pid}", file=sys.stderr)
+        return 1
+
+    out_dir = tempfile.mkdtemp(prefix="cometbft-debug-kill-")
+    # 1. live node state over RPC + home files (while it still answers)
+    _debug_collect(args.rpc, args.home, out_dir)
+
+    # 2. kernel-side process state — capturable from OUTSIDE the process
+    proc_info = []
+    for name in ("cmdline", "status", "wchan", "io", "limits"):
+        try:
+            with open(f"/proc/{pid}/{name}", "rb") as f:
+                data = f.read().replace(b"\x00", b" ")
+            proc_info.append(f"--- /proc/{pid}/{name}\n"
+                             + data.decode(errors="replace"))
+        except OSError as e:
+            proc_info.append(f"--- /proc/{pid}/{name}: {e!r}")
+    try:
+        tids = os.listdir(f"/proc/{pid}/task")
+        proc_info.append(f"--- threads: {len(tids)}")
+        fds = os.listdir(f"/proc/{pid}/fd")
+        proc_info.append(f"--- open fds: {len(fds)}")
+    except OSError:
+        pass
+    with open(os.path.join(out_dir, "proc_state.txt"), "w") as f:
+        f.write("\n".join(proc_info))
+
+    # 3. ask the node to dump its own stacks to ITS stderr/log, then
+    #    stop it (SIGTERM is the graceful path; SIGKILL after a grace
+    #    period so a wedged node still dies, like kill.go's guarantee)
+    for sig in (_signal.SIGUSR1, _signal.SIGUSR2):
+        try:
+            os.kill(pid, sig)
+        except OSError:
+            pass
+    _time.sleep(1.0)         # give the handlers a beat to write
+    try:
+        os.kill(pid, _signal.SIGTERM)
+    except OSError:
+        pass
+    deadline = _time.monotonic() + 10.0
+    killed = False
+    while _time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            killed = True
+            break
+        _time.sleep(0.2)
+    if not killed:
+        try:
+            os.kill(pid, _signal.SIGKILL)
+        except OSError:
+            pass
+    with open(os.path.join(out_dir, "kill.txt"), "w") as f:
+        f.write(f"pid {pid} terminated "
+                f"({'SIGTERM' if killed else 'SIGKILL after timeout'}); "
+                "stack dumps (SIGUSR1/2) went to the node's own stderr "
+                "log\n")
+
+    tar_path = _debug_tar(out_dir, args.output_file)
     print(f"Debug bundle written to {tar_path}")
     return 0
 
@@ -930,6 +1023,14 @@ def build_parser() -> argparse.ArgumentParser:
     dp = dsub.add_parser("wal", help="dump consensus WAL records as "
                          "JSON lines (scripts/wal2json)")
     dp.set_defaults(fn=cmd_debug_wal)
+    dp = dsub.add_parser("kill", help="capture a RUNNING node's state "
+                         "by pid, terminate it, tarball everything "
+                         "(commands/debug/kill.go)")
+    dp.add_argument("pid", type=int)
+    dp.add_argument("output_file", nargs="?", default=None,
+                    help="archive path (default <tmp>.tar.gz)")
+    dp.add_argument("--rpc", default="127.0.0.1:26657")
+    dp.set_defaults(fn=cmd_debug_kill)
     return p
 
 

@@ -129,6 +129,70 @@ def test_reindex_and_compact_and_debug_dump(tmp_path):
     assert any("status.err" in n for n in names)  # RPC was down
 
 
+def test_debug_kill_captures_and_terminates(tmp_path):
+    """commands/debug/kill.go parity: 'debug kill <pid>' aggregates the
+    LIVE node's RPC state + home files + /proc state, triggers its
+    SIGUSR1/2 stack dumps, terminates it, and writes one tarball."""
+    import urllib.request
+
+    from cometbft_tpu.config import Config
+
+    home = _prep_home(tmp_path, 28970)
+    cfg = Config.load(f"{home}/config/config.toml")
+    rpc = cfg.rpc.laddr.removeprefix("tcp://")
+    port = int(rpc.rsplit(":", 1)[1])
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    log_path = str(tmp_path / "node.log")
+    with open(log_path, "wb") as lf:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "--home", home,
+             "start"], stdout=lf, stderr=subprocess.STDOUT, env=env,
+            cwd=REPO)
+    try:
+        deadline = time.monotonic() + 90
+        while True:
+            assert proc.poll() is None, "node died during warm-up"
+            try:
+                st = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=2).read())
+                if st["result"]["sync_info"]["latest_block_height"] >= 2:
+                    break
+            except Exception:
+                pass
+            assert time.monotonic() < deadline, "node never reached height"
+            time.sleep(0.3)
+
+        out = str(tmp_path / "kill-bundle.tar.gz")
+        res = _run_cli("debug", "kill", str(proc.pid), out,
+                       "--rpc", rpc, home=home)
+        assert res.returncode == 0, res.stdout + res.stderr
+        # the node is gone
+        assert proc.wait(timeout=15) is not None
+        # the bundle carries live RPC state, config, and process state
+        with tarfile.open(out) as tar:
+            names = tar.getnames()
+
+            def read(suffix):
+                name = next(n for n in names if n.endswith(suffix))
+                return tar.extractfile(name).read()
+
+            st = json.loads(read("status.json"))
+            assert st["node_info"]["network"] == "tools-chain"
+            assert json.loads(read("dump_consensus_state.json"))
+            assert b"[p2p]" in read("config.toml") or \
+                b"laddr" in read("config.toml")
+            proc_state = read("proc_state.txt").decode()
+            assert "cmdline" in proc_state and "threads:" in proc_state
+            assert b"terminated" in read("kill.txt")
+        # the SIGUSR1/2 dumps landed in the node's own log
+        log = open(log_path, "rb").read().decode(errors="replace")
+        assert "asyncio tasks ===" in log       # SIGUSR2 task dump
+        assert "Current thread" in log or "Thread 0x" in log  # SIGUSR1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
 def test_offline_tooling_refuses_running_node(tmp_path):
     """A live node holds the data-dir flock; compact-db/reindex-event on
     the same home must refuse instead of corrupting the open LogDB."""
