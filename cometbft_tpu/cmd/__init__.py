@@ -736,12 +736,20 @@ def cmd_debug_kill(args) -> int:
         os.kill(pid, _signal.SIGTERM)
     except OSError:
         pass
+    def _gone() -> bool:
+        # os.kill(pid, 0) stays happy on a ZOMBIE (exited but unreaped
+        # under a supervisor), which would burn the whole grace period
+        # and misreport SIGKILL — read the state from /proc instead
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                return f.read().rsplit(")", 1)[1].split()[0] == "Z"
+        except OSError:
+            return True
+
     deadline = _time.monotonic() + 10.0
     killed = False
     while _time.monotonic() < deadline:
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
+        if _gone():
             killed = True
             break
         _time.sleep(0.2)
@@ -757,6 +765,10 @@ def cmd_debug_kill(args) -> int:
                 "log\n")
 
     tar_path = _debug_tar(out_dir, args.output_file)
+    # the staging dir duplicates config/WAL/state uncompressed in /tmp:
+    # never leave it behind (debug dump's out_dir is user-chosen and
+    # visible; this one is not)
+    shutil.rmtree(out_dir, ignore_errors=True)
     print(f"Debug bundle written to {tar_path}")
     return 0
 

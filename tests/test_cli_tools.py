@@ -166,8 +166,8 @@ def test_debug_kill_captures_and_terminates(tmp_path):
         res = _run_cli("debug", "kill", str(proc.pid), out,
                        "--rpc", rpc, home=home)
         assert res.returncode == 0, res.stdout + res.stderr
-        # the node is gone
-        assert proc.wait(timeout=15) is not None
+        # the node is gone (TimeoutExpired here = kill failed)
+        proc.wait(timeout=15)
         # the bundle carries live RPC state, config, and process state
         with tarfile.open(out) as tar:
             names = tar.getnames()
