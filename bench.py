@@ -607,7 +607,11 @@ def _run_attempt(backend: str, nsig: int, timeout_s: float) -> dict | None:
 
 def main() -> None:
     nsig_tpu = int(os.environ.get("BENCH_NSIG", "10240"))
-    nsig_cpu = int(os.environ.get("BENCH_NSIG_CPU", "1024"))
+    # the headline shape is a 10k-validator EXTENDED commit (2 sigs/val,
+    # chunked at the 16384-lane cap): production CPU batches are huge,
+    # so a small default would UNDERstate the per-sig rate the node
+    # actually sees (Pippenger's per-point cost falls with batch size)
+    nsig_cpu = int(os.environ.get("BENCH_NSIG_CPU", "8192"))
     t_tpu = float(os.environ.get("BENCH_TPU_TIMEOUT", "480"))
     t_cpu = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 

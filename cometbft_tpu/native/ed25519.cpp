@@ -162,7 +162,9 @@ static const fe FE_SQRTM1 = {{0x61b274a0ea0b0ULL, 0xd5a5fc8f189dULL,
 
 static inline void fe_carry(fe& r) {
     // two passes: after the first, every limb < 2^51 except possibly a
-    // tiny spill into the next; the second settles it
+    // tiny spill into the next; the second settles it.  For ARBITRARY
+    // limb magnitudes (frombytes, fold residue) — the add/sub hot path
+    // uses the single-pass variant below.
     for (int pass = 0; pass < 2; pass++) {
         u64 c = r.v[4] >> 51;
         r.v[4] &= MASK51;
@@ -175,9 +177,34 @@ static inline void fe_carry(fe& r) {
     }
 }
 
+static inline void fe_carry1(fe& r) {
+    // ONE pass suffices on the add/sub hot path: the weakly-reduced
+    // form (limb < 2^51 + 2^7) is closed under add/sub/mul:
+    //   - mul/sq outputs: the final fold "o0 += 19*c" can leave a tail
+    //     carry into o1 of up to ~95 < 2^7 (c <= 5*2^51-ish from the
+    //     u128 accumulation), every other limb < 2^51 — weakly reduced;
+    //   - add of two such values: limbs < 2^52 + 2^8, so each pass-1
+    //     carry is <= 2 and the 19*carry fold into limb 0 stays < 2^7
+    //     — weakly reduced again;
+    //   - sub's 2p bias per limb (2^52 - 2) strictly exceeds any weakly
+    //     reduced subtrahend limb, so no underflow;
+    //   - mul/sq accumulate 5 products of < 2^52 * 19*2^52 < 2^111
+    //     each in u128 — no overflow — and reduce on exit;
+    //   - fe_tobytes (hence iszero/isodd) re-runs the full two-pass
+    //     carry before canonicalizing, so no consumer reads weak limbs.
+    u64 c = r.v[4] >> 51;
+    r.v[4] &= MASK51;
+    r.v[0] += 19 * c;
+    for (int i = 0; i < 4; i++) {
+        c = r.v[i] >> 51;
+        r.v[i] &= MASK51;
+        r.v[i + 1] += c;
+    }
+}
+
 static inline void fe_add(fe& r, const fe& a, const fe& b) {
     for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
-    fe_carry(r);
+    fe_carry1(r);
 }
 
 // 2p in radix 2^51 (bias so a-b can't underflow for reduced a, b)
@@ -187,7 +214,7 @@ static const u64 TWOPX = 0xFFFFFFFFFFFFEULL;
 static inline void fe_sub(fe& r, const fe& a, const fe& b) {
     r.v[0] = a.v[0] + TWOP0 - b.v[0];
     for (int i = 1; i < 5; i++) r.v[i] = a.v[i] + TWOPX - b.v[i];
-    fe_carry(r);
+    fe_carry1(r);
 }
 
 static inline void fe_neg(fe& r, const fe& a) { fe_sub(r, FE_ZERO, a); }
