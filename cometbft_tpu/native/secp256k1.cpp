@@ -266,41 +266,74 @@ static bool fe_isodd(const fe& a) {
     return b[31] & 1;
 }
 
-// generic pow over a big-endian 32-byte exponent (fixed public exponents)
-static void fe_pow(fe& r, const fe& a, const u8 exp[32]) {
-    fe acc;
-    bool started = false;
-    for (int byte = 0; byte < 32; byte++) {
-        for (int bit = 7; bit >= 0; bit--) {
-            if (started) fe_sq(acc, acc);
-            if ((exp[byte] >> bit) & 1) {
-                if (started) fe_mul(acc, acc, a);
-                else { acc = a; started = true; }
-            }
-        }
+static inline void fe_sqn(fe& r, const fe& a, int n) {
+    fe_sq(r, a);
+    for (int i = 1; i < n; i++) fe_sq(r, r);
+}
+
+// x^(2^223 - 1): the shared prefix of both fixed exponents —
+// p - 2      = (2^223 - 1)*2^33 + 0xFFFFFC2D   (33-bit tail)   and
+// (p + 1)/4  = (2^223 - 1)*2^31 + 0x3FFFFF0C   (31-bit tail)
+// (both identities follow from p = 2^256 - 2^32 - 977: the tails are
+// 2^33 - 2^32 - 979 and 2^31 - 2^30 - 244).  The 2^k-1 ladder costs
+// ~222 sq + 12 mul vs the generic bit-scan's ~250 mul.
+static void fe_chain223(fe& r, const fe& x) {
+    fe x2, x4, x8, x16, x32, x64, x128, t;
+    fe_sq(t, x);
+    fe_mul(x2, t, x);                    // 2^2 - 1
+    fe_sqn(t, x2, 2);
+    fe_mul(x4, t, x2);                   // 2^4 - 1
+    fe_sqn(t, x4, 4);
+    fe_mul(x8, t, x4);                   // 2^8 - 1
+    fe_sqn(t, x8, 8);
+    fe_mul(x16, t, x8);                  // 2^16 - 1
+    fe_sqn(t, x16, 16);
+    fe_mul(x32, t, x16);                 // 2^32 - 1
+    fe_sqn(t, x32, 32);
+    fe_mul(x64, t, x32);                 // 2^64 - 1
+    fe_sqn(t, x64, 64);
+    fe_mul(x128, t, x64);                // 2^128 - 1
+    fe_sqn(t, x128, 64);
+    fe_mul(t, t, x64);                   // 2^192 - 1
+    fe_sqn(t, t, 16);
+    fe_mul(t, t, x16);                   // 2^208 - 1
+    fe_sqn(t, t, 8);
+    fe_mul(t, t, x8);                    // 2^216 - 1
+    fe_sqn(t, t, 4);
+    fe_mul(t, t, x4);                    // 2^220 - 1
+    fe_sqn(t, t, 2);
+    fe_mul(t, t, x2);                    // 2^222 - 1
+    fe_sq(t, t);
+    fe_mul(r, t, x);                     // 2^223 - 1
+}
+
+// square-and-multiply over a short tail (the low 33/31 bits of the
+// fixed exponents after the shared 2^223-1 prefix)
+static void fe_pow_tail(fe& r, const fe& prefix, const fe& x,
+                        u64 tail, int bits) {
+    // u64 tail: bits can be 33, and (u32 >> 32) is undefined behavior
+    // (x86 shifts count mod 32 — exactly the bug this signature avoids)
+    fe acc = prefix;
+    for (int i = bits - 1; i >= 0; i--) {
+        fe_sq(acc, acc);
+        if ((tail >> i) & 1) fe_mul(acc, acc, x);
     }
     r = acc;
 }
 
 static void fe_invert(fe& r, const fe& a) {
-    // p - 2, big-endian
-    static const u8 e[32] = {
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xfe, 0xff, 0xff, 0xfc, 0x2d};
-    fe_pow(r, a, e);
+    // a^(p-2) = a^((2^223-1)*2^33 + 0xFFFFFC2D)
+    fe pre;
+    fe_chain223(pre, a);
+    fe_pow_tail(r, pre, a, 0xFFFFFC2Du, 33);
 }
 
 static bool fe_sqrt(fe& r, const fe& a) {
-    // p == 3 (mod 4): candidate = a^((p+1)/4); verify square
-    static const u8 e[32] = {
-        0x3f, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff, 0xff,
-        0xff, 0xff, 0xff, 0xff, 0xbf, 0xff, 0xff, 0x0c};
-    fe cand, chk;
-    fe_pow(cand, a, e);
+    // p == 3 (mod 4): candidate = a^((p+1)/4) =
+    // a^((2^223-1)*2^31 + 0x3FFFFF0C); verify square
+    fe pre, cand, chk;
+    fe_chain223(pre, a);
+    fe_pow_tail(cand, pre, a, 0x3FFFFF0Cu, 31);
     fe_sq(chk, cand);
     if (!fe_equal(chk, a)) return false;
     r = cand;
@@ -501,6 +534,190 @@ static inline int sc_window(const sc& a, int pos, int width) {
     return (int)(w & ((1ULL << width) - 1));
 }
 
+// ------------------------------------------------- GLV endomorphism split
+// secp256k1 has the cube-root endomorphism psi(x, y) = (beta*x, y) with
+// psi(P) = [lambda]P, so k*P = k1*P + k2*psi(P) with |k1|, |k2| ~ 2^128
+// — the joint ladder then needs HALF the doublings.  Every constant is
+// VERIFIED at library init (beta^2+beta+1 = 0 mod p, lambda^2+lambda+1
+// = 0 mod n, the lattice relations, and psi(G) == lambda*G against the
+// plain ladder), and every per-call decomposition is re-verified
+// algebraically (k1 + lambda*k2 == k mod n, magnitudes < 2^130); any
+// mismatch falls back to the plain 2-table ladder, so a wrong constant
+// can only cost speed, never correctness.
+
+static const u8 GLV_BETA_BYTES[32] = {
+    0x7a, 0xe9, 0x6a, 0x2b, 0x65, 0x7c, 0x07, 0x10,
+    0x6e, 0x64, 0x47, 0x9e, 0xac, 0x34, 0x34, 0xe9,
+    0x9c, 0xf0, 0x49, 0x75, 0x12, 0xf5, 0x89, 0x95,
+    0xc1, 0x39, 0x6c, 0x28, 0x71, 0x95, 0x01, 0xee};
+static const u8 GLV_LAMBDA_BYTES[32] = {
+    0x53, 0x63, 0xad, 0x4c, 0xc0, 0x5c, 0x30, 0xe0,
+    0xa5, 0x26, 0x1c, 0x02, 0x88, 0x12, 0x64, 0x5a,
+    0x12, 0x2e, 0x22, 0xea, 0x20, 0x81, 0x66, 0x78,
+    0xdf, 0x02, 0x96, 0x7c, 0x1b, 0x23, 0xbd, 0x72};
+// lattice basis (a1 + b1*lambda == 0, a2 + b2*lambda == 0 mod n), with
+// b1 stored negated: b1 = -B1N, b2 = a1
+static const u64 GLV_A1[2] = {0xe86c90e49284eb15ULL, 0x3086d221a7d46bcdULL};
+static const u64 GLV_B1N[2] = {0x6f547fa90abfe4c3ULL, 0xe4437ed6010e8828ULL};
+static const u64 GLV_A2[3] = {0x57c1108d9d44cfd8ULL, 0x14ca50f7a8e2f3f6ULL,
+                              0x1ULL};
+
+static fe GLV_BETA;
+static sc GLV_LAMBDA;
+static u64 GLV_G1[4], GLV_G2[4];     // round(2^384 * b2 / n), ... * (-b1)
+static bool GLV_OK = false;
+
+// 512-bit / 256-bit long division (init-only; bitwise, trivially right)
+static void u512_divmod_n(const u64 num[8], u64 quot[8]) {
+    u64 rem[4] = {0, 0, 0, 0};
+    for (int i = 0; i < 8; i++) quot[i] = 0;
+    for (int bit = 511; bit >= 0; bit--) {
+        // rem = rem*2 + bit_i  (rem < n < 2^256 so the shift can't drop)
+        u64 carry = 0;
+        for (int i = 0; i < 4; i++) {
+            u64 nx = (rem[i] << 1) | carry;
+            carry = rem[i] >> 63;
+            rem[i] = nx;
+        }
+        rem[0] |= (num[bit >> 6] >> (bit & 63)) & 1;
+        if (carry || sc_geq(rem, SC_N)) {
+            u64 borrow = 0;
+            for (int i = 0; i < 4; i++) {
+                u64 bi = SC_N[i] + borrow;
+                borrow = (bi < borrow) ? 1 : (rem[i] < bi ? 1 : 0);
+                rem[i] = rem[i] - bi;
+            }
+        } else {
+            continue;
+        }
+        quot[bit >> 6] |= 1ULL << (bit & 63);
+    }
+}
+
+// (a[na] * b[nb]) into out[na+nb] (schoolbook, u128 carries)
+static void limb_mul(const u64* a, int na, const u64* b, int nb, u64* out) {
+    for (int i = 0; i < na + nb; i++) out[i] = 0;
+    for (int i = 0; i < na; i++) {
+        u64 carry = 0;
+        for (int j = 0; j < nb; j++) {
+            u128 t = (u128)a[i] * b[j] + out[i + j] + carry;
+            out[i + j] = (u64)t;
+            carry = (u64)(t >> 64);
+        }
+        out[i + nb] += carry;
+    }
+}
+
+// c = (k * g + 2^383) >> 384 — the rounded GLV quotient.  c fits 2
+// limbs because c ~ m*k/n with m <= max(|b1|, b2) < 2^128 and k < n,
+// so c < 2^128 (g itself is ~2^253 for b2 and ~2^255.8 for -b1)
+static void glv_round_mul(const sc& k, const u64 g[4], u64 c[2]) {
+    u64 prod[8];
+    limb_mul(k.v, 4, g, 4, prod);
+    // add the rounding bit at position 383
+    u128 t = (u128)prod[5] + (1ULL << 63);
+    prod[5] = (u64)t;
+    u64 carry = (u64)(t >> 64);
+    for (int i = 6; i < 8 && carry; i++) {
+        t = (u128)prod[i] + carry;
+        prod[i] = (u64)t;
+        carry = (u64)(t >> 64);
+    }
+    c[0] = prod[6];
+    c[1] = prod[7];
+}
+
+// signed small scalar: magnitude (3 limbs, < 2^130) + sign
+struct glv_half { u64 mag[3]; bool neg; };
+
+// d (mod n, canonical) -> small signed form; false if |d| >= 2^130
+static bool glv_small(const u64 d[4], glv_half& out) {
+    if ((d[3] | (d[2] >> 2)) == 0) {            // d < 2^130
+        out.mag[0] = d[0]; out.mag[1] = d[1]; out.mag[2] = d[2];
+        out.neg = false;
+        return true;
+    }
+    u64 nd[4];
+    u256_sub(nd, SC_N, d);                      // n - d
+    if ((nd[3] | (nd[2] >> 2)) == 0) {
+        out.mag[0] = nd[0]; out.mag[1] = nd[1]; out.mag[2] = nd[2];
+        out.neg = true;
+        return true;
+    }
+    return false;
+}
+
+static inline int glv_window(const glv_half& h, int pos) {
+    // pos is always a multiple of 4 (the ladder steps whole windows),
+    // so a 4-bit window can never straddle a 64-bit limb boundary
+    return (int)((h.mag[pos >> 6] >> (pos & 63)) & 0xF);
+}
+
+// k -> k1 + lambda*k2 (mod n), both halves small; false -> caller uses
+// the plain ladder.  Includes the full algebraic re-verification.
+static bool glv_decompose(const sc& k, glv_half& k1, glv_half& k2) {
+    u64 c1[2], c2[2];
+    glv_round_mul(k, GLV_G1, c1);
+    glv_round_mul(k, GLV_G2, c2);
+    // s = c1*a1 + c2*a2  (< 2^255 < n: no reduction needed)
+    u64 s1[4], s2[5], s[5] = {0};
+    limb_mul(c1, 2, GLV_A1, 2, s1);
+    limb_mul(c2, 2, GLV_A2, 3, s2);
+    u64 carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)s1[i] + s2[i] + carry;
+        s[i] = (u64)t;
+        carry = (u64)(t >> 64);
+    }
+    if (carry + s2[4] != 0) return false;       // defensive: can't happen
+    // d1 = (k - s) mod n
+    u64 d1[4];
+    if (sc_geq(k.v, s)) {
+        u256_sub(d1, k.v, s);
+    } else {
+        u64 t[4];
+        u256_sub(t, s, k.v);
+        u256_sub(d1, SC_N, t);
+    }
+    if (!glv_small(d1, k1)) return false;
+    // d2 = (c1*b1n - c2*b2) mod n   (k2 = -(c1*b1 + c2*b2) = c1*b1n - c2*b2)
+    u64 t1[4], t2[4];
+    limb_mul(c1, 2, GLV_B1N, 2, t1);
+    limb_mul(c2, 2, GLV_A1, 2, t2);             // b2 == a1
+    u64 d2[4];
+    if (sc_geq(t1, t2)) {
+        u256_sub(d2, t1, t2);
+        if (sc_geq(d2, SC_N)) u256_sub(d2, d2, SC_N);
+    } else {
+        u64 t[4];
+        u256_sub(t, t2, t1);
+        if (sc_geq(t, SC_N)) u256_sub(t, t, SC_N);
+        u256_sub(d2, SC_N, t);
+    }
+    if (!glv_small(d2, k2)) return false;
+    // re-verify: k1 + lambda*k2 == k (mod n)
+    sc m2 = {{k2.mag[0], k2.mag[1], k2.mag[2], 0}};
+    sc lk2;
+    sc_mul(lk2, GLV_LAMBDA, m2);
+    u64 acc[4] = {k1.mag[0], k1.mag[1], k1.mag[2], 0};
+    if (k1.neg) {
+        u64 t[4];
+        u256_sub(t, SC_N, acc);
+        for (int i = 0; i < 4; i++) acc[i] = t[i];
+    }
+    u64 l[4] = {lk2.v[0], lk2.v[1], lk2.v[2], lk2.v[3]};
+    if (k2.neg) {
+        u64 t[4];
+        u256_sub(t, SC_N, l);
+        for (int i = 0; i < 4; i++) l[i] = t[i];
+    }
+    u64 sum[4];
+    u64 cadd = u256_add(sum, acc, l);
+    if (cadd || sc_geq(sum, SC_N)) u256_sub(sum, sum, SC_N);
+    return sum[0] == k.v[0] && sum[1] == k.v[1] &&
+           sum[2] == k.v[2] && sum[3] == k.v[3];
+}
+
 // ---------------------------------------------------- points (Jacobian, a=0)
 
 struct ge { fe X, Y, Z; bool inf; };
@@ -509,9 +726,10 @@ static const ge GE_INF = {{{0}}, {{0}}, {{0}}, true};
 
 static void ge_double(ge& r, const ge& p) {
     if (p.inf) { r = p; return; }
-    // y = 0 cannot happen on y^2 = x^3 + 7 (would need x^3 = -7, and
-    // such points have y=0 only if on curve; handle defensively)
-    if (fe_iszero(p.Y)) { r = GE_INF; return; }
+    // no y == 0 check: a y = 0 point would have order 2, and
+    // secp256k1's group order n is an odd prime (cofactor 1) — no
+    // 2-torsion exists, so on-curve inputs can never hit it (and every
+    // ladder input is decompression-validated on-curve)
     fe A, B, Cc, D, X3, Y3, Z3, t;
     fe_sq(A, p.X);                       // A = X^2
     fe_sq(B, p.Y);                       // B = Y^2
@@ -597,18 +815,195 @@ static bool ge_decompress(ge& r, const u8 pub[33]) {
     return true;
 }
 
+// mixed addition r = p + q with AFFINE q (madd-2007-bl shape): 8 fe_mul
+// + 3 fe_sq vs general ge_add's 12 + 4 — the ladder's table entries are
+// pre-normalized to affine exactly so every window add is mixed
+struct geaff { fe x, y; bool inf; };
+
+static void ge_madd(ge& r, const ge& p, const geaff& q) {
+    if (q.inf) { r = p; return; }
+    if (p.inf) {
+        r.X = q.x; r.Y = q.y;
+        r.Z = {{1, 0, 0, 0, 0}};
+        r.inf = false;
+        return;
+    }
+    fe Z1Z1, U2, S2, H, Rr, t;
+    fe_sq(Z1Z1, p.Z);
+    fe_mul(U2, q.x, Z1Z1);
+    fe_mul(S2, q.y, p.Z);
+    fe_mul(S2, S2, Z1Z1);
+    fe_sub(H, U2, p.X);
+    fe_sub(Rr, S2, p.Y);
+    if (fe_iszero(H)) {
+        if (fe_iszero(Rr)) { ge_double(r, p); return; }
+        r = GE_INF;
+        return;
+    }
+    fe HH, HHH, V, X3, Y3, Z3;
+    fe_sq(HH, H);
+    fe_mul(HHH, HH, H);
+    fe_mul(V, p.X, HH);
+    fe_sq(X3, Rr);
+    fe_sub(X3, X3, HHH);
+    fe_sub(X3, X3, V);
+    fe_sub(X3, X3, V);
+    fe_sub(t, V, X3);
+    fe_mul(Y3, Rr, t);
+    fe_mul(t, p.Y, HHH);
+    fe_sub(Y3, Y3, t);
+    fe_mul(Z3, p.Z, H);
+    r.X = X3; r.Y = Y3; r.Z = Z3; r.inf = false;
+}
+
+// batch-normalize Jacobian points to affine: ONE field inversion for the
+// whole table (Montgomery trick), then x = X/Z^2, y = Y/Z^3 per entry
+static void ge_batch_to_affine(geaff* out, const ge* in, int n) {
+    // n <= 16 at every call site (window tables); stack storage keeps
+    // the per-verification hot path allocation-free
+    fe partial[16];
+    fe acc = {{1, 0, 0, 0, 0}};
+    for (int i = 0; i < n; i++) {
+        partial[i] = acc;
+        if (!in[i].inf) fe_mul(acc, acc, in[i].Z);
+    }
+    fe inv;
+    fe_invert(inv, acc);
+    for (int i = n - 1; i >= 0; i--) {
+        out[i].inf = in[i].inf;
+        if (in[i].inf) continue;
+        fe zi, zi2;
+        fe_mul(zi, inv, partial[i]);         // 1 / Z_i
+        fe_mul(inv, inv, in[i].Z);           // drop Z_i from the running inv
+        fe_sq(zi2, zi);
+        fe_mul(out[i].x, in[i].X, zi2);
+        fe_mul(out[i].y, in[i].Y, zi2);
+        fe_mul(out[i].y, out[i].y, zi);
+    }
+}
+
 // ------------------------------------------------------------- verification
 
-// 4-bit base-point window, built once at library load (dlopen runs
-// initializers single-threaded, so no init race across ctypes calls)
-static ge G_TAB[16];
+// 4-bit base-point window in AFFINE form, built once at library load
+// (dlopen runs initializers single-threaded, so no init race across
+// ctypes calls); affine entries make every ladder add a mixed add.
+// PSI_G_TAB is the endomorphism image (beta*x, y) of each entry —
+// psi(i*G) = i*psi(G), so it needs only one field mul per entry.
+static geaff G_TAB[16];
+static geaff PSI_G_TAB[16];
+
+static void ge_scalarmul_plain(ge& r, const sc& k, const ge& p) {
+    // simple 4-bit ladder (init-time psi(G) check only)
+    ge tab[16];
+    tab[0] = GE_INF;
+    tab[1] = p;
+    for (int i = 2; i < 16; i++) ge_add(tab[i], tab[i - 1], p);
+    ge acc = GE_INF;
+    for (int w = 63; w >= 0; w--) {
+        for (int i = 0; i < 4; i++) ge_double(acc, acc);
+        int d = sc_window(k, 4 * w, 4);
+        if (d) ge_add(acc, acc, tab[d]);
+    }
+    r = acc;
+}
+
+static void psi_table(geaff* out, const geaff* in) {
+    for (int i = 0; i < 16; i++) {
+        out[i].inf = in[i].inf;
+        if (in[i].inf) continue;
+        fe_mul(out[i].x, GLV_BETA, in[i].x);
+        out[i].y = in[i].y;
+    }
+}
+
+static bool glv_init() {
+    fe_frombytes(GLV_BETA, GLV_BETA_BYTES);
+    for (int i = 0; i < 4; i++) {
+        GLV_LAMBDA.v[i] = 0;
+        for (int j = 0; j < 8; j++)
+            GLV_LAMBDA.v[i] = (GLV_LAMBDA.v[i] << 8)
+                | GLV_LAMBDA_BYTES[(3 - i) * 8 + j];
+    }
+    // beta^2 + beta + 1 == 0 (mod p)
+    fe t, one = {{1, 0, 0, 0, 0}};
+    fe_sq(t, GLV_BETA);
+    fe_add(t, t, GLV_BETA);
+    fe_add(t, t, one);
+    if (!fe_iszero(t)) return false;
+    // lambda^2 + lambda + 1 == 0 (mod n)
+    sc lt;
+    sc_mul(lt, GLV_LAMBDA, GLV_LAMBDA);
+    u64 acc[4];
+    u64 c = u256_add(acc, lt.v, GLV_LAMBDA.v);
+    if (c || sc_geq(acc, SC_N)) u256_sub(acc, acc, SC_N);
+    u64 onev[4] = {1, 0, 0, 0};
+    c = u256_add(acc, acc, onev);
+    if (c || sc_geq(acc, SC_N)) u256_sub(acc, acc, SC_N);
+    if ((acc[0] | acc[1] | acc[2] | acc[3]) != 0) return false;
+    // lattice relations: a1 == b1n * lambda, a2 == n - (a1 * lambda)
+    // (a1 + b1*lambda == 0 with b1 = -b1n;  a2 + b2*lambda == 0, b2 = a1)
+    sc b1n = {{GLV_B1N[0], GLV_B1N[1], 0, 0}};
+    sc a1 = {{GLV_A1[0], GLV_A1[1], 0, 0}};
+    sc chk;
+    sc_mul(chk, b1n, GLV_LAMBDA);
+    if (chk.v[0] != GLV_A1[0] || chk.v[1] != GLV_A1[1] ||
+        chk.v[2] | chk.v[3]) return false;
+    sc_mul(chk, a1, GLV_LAMBDA);
+    u64 na2[4];
+    u256_sub(na2, SC_N, chk.v);                  // -a1*lambda mod n
+    if (na2[0] != GLV_A2[0] || na2[1] != GLV_A2[1] ||
+        na2[2] != GLV_A2[2] || na2[3]) return false;
+    // rounded quotients: G1 = round(2^384*b2/n) with b2 == a1, and
+    // G2 = round(2^384*b1n/n) — computed as ((m << 384) + n/2) / n
+    for (int which = 0; which < 2; which++) {
+        const u64* m = which == 0 ? GLV_A1 : GLV_B1N;
+        u64 nm[8] = {0};
+        nm[6] = m[0];
+        nm[7] = m[1];
+        // += floor(n/2): 4-limb value (n odd -> n>>1)
+        u64 half[4] = {(SC_N[0] >> 1) | (SC_N[1] << 63),
+                       (SC_N[1] >> 1) | (SC_N[2] << 63),
+                       (SC_N[2] >> 1) | (SC_N[3] << 63),
+                       SC_N[3] >> 1};
+        u64 carry = 0;
+        for (int i = 0; i < 8; i++) {
+            u128 tt = (u128)nm[i] + (i < 4 ? half[i] : 0) + carry;
+            nm[i] = (u64)tt;
+            carry = (u64)(tt >> 64);
+        }
+        u64 q[8];
+        u512_divmod_n(nm, q);
+        if (q[4] | q[5] | q[6] | q[7]) return false;     // g must fit 4 limbs
+        for (int i = 0; i < 4; i++)
+            (which == 0 ? GLV_G1 : GLV_G2)[i] = q[i];
+    }
+    // psi(G) == lambda * G — the one check the per-call verification
+    // cannot cover (it would pass equally for lambda^2)
+    ge lg;
+    ge jg;
+    jg.X = GX; jg.Y = GY; jg.Z = {{1, 0, 0, 0, 0}}; jg.inf = false;
+    ge_scalarmul_plain(lg, GLV_LAMBDA, jg);
+    fe zi, zi2, lx;
+    fe_invert(zi, lg.Z);
+    fe_sq(zi2, zi);
+    fe_mul(lx, lg.X, zi2);
+    fe px;
+    fe_mul(px, GLV_BETA, GX);
+    if (!fe_equal(lx, px)) return false;
+    return true;
+}
+
 static const bool _gtab_ready = []() {
-    G_TAB[0] = GE_INF;
-    G_TAB[1].X = GX;
-    G_TAB[1].Y = GY;
-    G_TAB[1].Z = {{1, 0, 0, 0, 0}};
-    G_TAB[1].inf = false;
-    for (int i = 2; i < 16; i++) ge_add(G_TAB[i], G_TAB[i - 1], G_TAB[1]);
+    ge jac[16];
+    jac[0] = GE_INF;
+    jac[1].X = GX;
+    jac[1].Y = GY;
+    jac[1].Z = {{1, 0, 0, 0, 0}};
+    jac[1].inf = false;
+    for (int i = 2; i < 16; i++) ge_add(jac[i], jac[i - 1], jac[1]);
+    ge_batch_to_affine(G_TAB, jac, 16);
+    GLV_OK = glv_init();
+    if (GLV_OK) psi_table(PSI_G_TAB, G_TAB);
     return true;
 }();
 
@@ -639,38 +1034,80 @@ int secp256k1_verify(const u8* pub, const u8* sig, const u8* msg,
     sc_mul(u1, e, w);
     sc_mul(u2, r_s, w);
 
-    // Shamir joint ladder: 4-bit windows over u1 (static G table) and
-    // u2 (per-verify Q table)
-    ge qt[16];
-    qt[0] = GE_INF;
-    qt[1] = Q;
-    for (int i = 2; i < 16; i++) ge_add(qt[i], qt[i - 1], Q);
+    // Shamir joint ladder over affine tables (every window add is a
+    // mixed add, 8M+3S vs the general 12M+4S).  With a VERIFIED GLV
+    // split the four ~130-bit halves share 33 window positions (132
+    // doublings); otherwise the plain 2-table 64-window ladder runs.
+    ge qtj[16];
+    qtj[0] = GE_INF;
+    qtj[1] = Q;
+    for (int i = 2; i < 16; i++) ge_add(qtj[i], qtj[i - 1], Q);
+    geaff qt[16];
+    ge_batch_to_affine(qt, qtj, 16);
 
     ge acc = GE_INF;
-    for (int wdx = 63; wdx >= 0; wdx--) {
-        for (int k = 0; k < 4; k++) ge_double(acc, acc);
-        int d1 = sc_window(u1, 4 * wdx, 4);
-        if (d1) ge_add(acc, acc, G_TAB[d1]);
-        int d2 = sc_window(u2, 4 * wdx, 4);
-        if (d2) ge_add(acc, acc, qt[d2]);
+    glv_half h1a, h1b, h2a, h2b;
+    if (GLV_OK && glv_decompose(u1, h1a, h1b)
+        && glv_decompose(u2, h2a, h2b)) {
+        geaff psi_qt[16];
+        psi_table(psi_qt, qt);
+        const geaff* tabs[4] = {G_TAB, PSI_G_TAB, qt, psi_qt};
+        const glv_half* halves[4] = {&h1a, &h1b, &h2a, &h2b};
+        for (int wdx = 32; wdx >= 0; wdx--) {
+            for (int k = 0; k < 4; k++) ge_double(acc, acc);
+            for (int t = 0; t < 4; t++) {
+                int d = glv_window(*halves[t], 4 * wdx);
+                if (!d) continue;
+                geaff e = tabs[t][d];
+                if (halves[t]->neg) {
+                    fe zero = {{0, 0, 0, 0, 0}};
+                    fe_sub(e.y, zero, e.y);
+                }
+                ge_madd(acc, acc, e);
+            }
+        }
+    } else {
+        for (int wdx = 63; wdx >= 0; wdx--) {
+            for (int k = 0; k < 4; k++) ge_double(acc, acc);
+            int d1 = sc_window(u1, 4 * wdx, 4);
+            if (d1) ge_madd(acc, acc, G_TAB[d1]);
+            int d2 = sc_window(u2, 4 * wdx, 4);
+            if (d2) ge_madd(acc, acc, qt[d2]);
+        }
     }
     if (acc.inf) return 0;
 
-    // R.x mod n == r  (affine x = X / Z^2)
-    fe zinv, zinv2, xa;
-    fe_invert(zinv, acc.Z);
-    fe_sq(zinv2, zinv);
-    fe_mul(xa, acc.X, zinv2);
-    u8 xb[32];
-    fe_tobytes(xb, xa);
-    sc xs;
-    u64 xw[8] = {0};
-    for (int i = 0; i < 4; i++)
-        for (int j = 0; j < 8; j++)
-            xw[i] = (xw[i] << 8) | xb[(3 - i) * 8 + j];
-    sc_reduce512(xs, xw);
-    return (xs.v[0] == r_s.v[0] && xs.v[1] == r_s.v[1]
-            && xs.v[2] == r_s.v[2] && xs.v[3] == r_s.v[3]) ? 1 : 0;
+    // R.x mod n == r, checked PROJECTIVELY (no field inversion):
+    // x = X/Z^2 == r (mod n) iff X == c*Z^2 (mod p) for c in {r, r+n}
+    // — x < p and r < n, so x ≡ r (mod n) only via x == r or x == r+n,
+    // the latter possible only when r < p - n (~2^128.3)
+    fe z2, cand, rx;
+    fe_sq(z2, acc.Z);
+    fe_frombytes(rx, sig);                  // r as a field element (r < n < p)
+    fe_mul(cand, rx, z2);
+    if (fe_equal(cand, acc.X)) return 1;
+    // second candidate r + n (as a 256-bit integer; fits iff no carry)
+    u64 rn[4];
+    if (u256_add(rn, r_s.v, SC_N) == 0) {
+        // only meaningful when r + n < p; if r + n >= p the candidate
+        // wraps and cannot equal x (x < p) -- fe_frombytes would reduce
+        // mod p and produce a WRONG acceptance, so check the bound:
+        // p - n fits in 129 bits, so r + n < p iff rn < p, tested via
+        // canonical bytes round-trip
+        u8 rb[32];
+        for (int i = 0; i < 4; i++)
+            for (int j = 0; j < 8; j++)
+                rb[8 * i + j] = (u8)(rn[3 - i] >> (56 - 8 * j));
+        fe rnf;
+        fe_frombytes(rnf, rb);
+        u8 chkb[32];
+        fe_tobytes(chkb, rnf);
+        if (memcmp(chkb, rb, 32) == 0) {    // rn < p: candidate valid
+            fe_mul(cand, rnf, z2);
+            if (fe_equal(cand, acc.X)) return 1;
+        }
+    }
+    return 0;
 }
 
 }  // extern "C"
