@@ -116,6 +116,12 @@ class RPCConfig:
     # directory, like the reference.
     tls_cert_file: str = ""
     tls_key_file: str = ""
+    # shed broadcast_tx_* with a retryable error when the event loop's
+    # scheduling lag exceeds this (seconds; 0 disables) — a sustained tx
+    # flood otherwise starves consensus into round churn (libs/loopwatch
+    # measures the lag; the watchdog must be enabled via
+    # instrumentation.loop_stall_threshold_s)
+    overload_shed_lag_s: float = 2.0
 
 
 @dataclass

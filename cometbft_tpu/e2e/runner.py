@@ -418,6 +418,9 @@ class Runner:
         padded = b"%0*d" % (len(str(power)) + self._valset_seq, power)
         tx = b"val:" + base64.b64encode(pk) + b"!" + padded
         self.log(f"[e2e] validator_update {name} -> power {power}")
+        # ``call`` retries RPCError (incl. the RETRYABLE overload shed,
+        # -32099) for its whole timeout window, so a loaded run resends
+        # this control-plane tx instead of aborting
         res = await call(port, "broadcast_tx_sync", tx=tx.hex())
         if res.get("code", 0) != 0:
             raise RunnerError(f"valset tx for {name} rejected: {res}")

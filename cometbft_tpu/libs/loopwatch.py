@@ -37,6 +37,12 @@ class LoopWatchdog:
         self.name = name
         self.stalls = 0
         self.worst_stall_s = 0.0
+        # the MOST RECENT beat's scheduling lag: call_soon_threadsafe
+        # lands behind everything already queued, so this doubles as a
+        # backlog signal — RPC admission control sheds broadcast load
+        # when it climbs (the flood that starves consensus into round
+        # churn announces itself here first)
+        self.last_lag_s = 0.0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._gauge = _metrics.gauge(
@@ -72,6 +78,7 @@ class LoopWatchdog:
             lag = time.monotonic() - sent
             if self._stop.is_set():
                 return              # shutdown lag is not a loop stall
+            self.last_lag_s = lag
             if lag >= self.stall_threshold_s:
                 self.stalls += 1
                 self.worst_stall_s = max(self.worst_stall_s, lag)

@@ -384,6 +384,15 @@ class Node:
                     self.config.instrumentation.loop_stall_threshold_s),
                 name=self.name)
             self.loop_watchdog.start()
+        elif getattr(self.config.rpc, "overload_shed_lag_s", 0) > 0:
+            # shedding reads the watchdog's lag — with the watchdog off
+            # the knob is dead, which an operator should hear about once
+            from ..libs import log as _tmlog
+
+            _tmlog.logger("node", node=self.name).warn(
+                "rpc.overload_shed_lag_s is set but the loop watchdog is "
+                "disabled (instrumentation.loop_stall_threshold_s = 0): "
+                "overload shedding is inactive")
         from ..crypto import batch as cryptobatch
 
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)

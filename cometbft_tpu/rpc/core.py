@@ -362,7 +362,41 @@ def _tx_bytes(tx) -> bytes:
     return bytes(tx)
 
 
+import functools as _functools
+
+
+@_functools.cache
+def _shed_counter():
+    from ..libs import metrics as _m
+
+    return _m.counter("rpc_overload_shed_total",
+                      "tx submissions rejected under loop overload")
+
+
+def _check_overload(env: Environment) -> None:
+    """Admission control for tx submission: when the event loop's
+    scheduling lag exceeds the configured shed threshold, reject with a
+    retryable error INSTEAD of queueing more CheckTx work — a sustained
+    broadcast flood otherwise starves consensus timers into round churn
+    and the node stalls entirely (observed on the one-core testnet
+    bench; the reference sheds via 503s when its mempool/WS buffers
+    fill).  0 disables."""
+    node = env.node
+    cfg = getattr(node, "config", None)
+    thresh = getattr(getattr(cfg, "rpc", None), "overload_shed_lag_s", 0.0)
+    wd = getattr(node, "loop_watchdog", None)
+    if not thresh or wd is None:
+        return
+    lag = wd.last_lag_s
+    if lag > thresh:
+        _shed_counter().inc()
+        raise RPCError(-32099,
+                       "server overloaded (event-loop lag "
+                       f"{lag:.2f}s > {thresh:.2f}s); retry later")
+
+
 async def broadcast_tx_async(env: Environment, tx=None) -> dict:
+    _check_overload(env)
     raw = _tx_bytes(tx)
 
     async def _fire_and_forget():
@@ -379,6 +413,7 @@ async def broadcast_tx_async(env: Environment, tx=None) -> dict:
 
 async def broadcast_tx_sync(env: Environment, tx=None) -> dict:
     """CheckTx ran, result returned (rpc/core/mempool.go)."""
+    _check_overload(env)
     raw = _tx_bytes(tx)
     from ..mempool.mempool import TxKey
 
@@ -393,6 +428,7 @@ async def broadcast_tx_commit(env: Environment, tx=None,
                               timeout_s: float = 30.0) -> dict:
     """Submit and wait for the tx to land in a block (rpc/core/mempool.go
     BroadcastTxCommit; the reference subscribes to EventTx)."""
+    _check_overload(env)
     raw = _tx_bytes(tx)
     from ..mempool.mempool import TxKey
 

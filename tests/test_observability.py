@@ -76,6 +76,47 @@ def test_device_abandonment_flips_health_metrics(monkeypatch):
     assert gauge.value() == 0
 
 
+def test_overload_shed_rejects_broadcast_under_loop_lag():
+    """Flood admission control: when the loop watchdog reports lag above
+    rpc.overload_shed_lag_s, broadcast_tx_* reject with a retryable
+    RPCError instead of queueing more CheckTx work (the one-core testnet
+    stall scenario); normal lag admits."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.rpc import core as rpc_core
+
+    class FakeWatchdog:
+        last_lag_s = 0.0
+
+    class FakeMempool:
+        async def check_tx(self, raw):
+            return None
+
+    class FakeNode:
+        config = Config()
+        loop_watchdog = FakeWatchdog()
+        mempool = FakeMempool()
+
+    node = FakeNode()
+    node.config.rpc.overload_shed_lag_s = 2.0
+    env = rpc_core.Environment(node)
+
+    node.loop_watchdog.last_lag_s = 0.05
+    res = run(rpc_core.broadcast_tx_sync(env, tx=b"ok".hex()))
+    assert res["code"] == 0
+
+    node.loop_watchdog.last_lag_s = 5.0
+    with pytest.raises(rpc_core.RPCError) as ei:
+        run(rpc_core.broadcast_tx_sync(env, tx=b"ok".hex()))
+    assert "overloaded" in str(ei.value)
+    with pytest.raises(rpc_core.RPCError):
+        run(rpc_core.broadcast_tx_async(env, tx=b"ok".hex()))
+
+    # 0 disables shedding entirely
+    node.config.rpc.overload_shed_lag_s = 0.0
+    res = run(rpc_core.broadcast_tx_sync(env, tx=b"ok".hex()))
+    assert res["code"] == 0
+
+
 def test_structured_logger_levels_and_format():
     buf = io.StringIO()
     tmlog.set_sink(buf)
