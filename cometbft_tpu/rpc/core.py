@@ -362,15 +362,10 @@ def _tx_bytes(tx) -> bytes:
     return bytes(tx)
 
 
-import functools as _functools
+from ..libs.metrics import counter as _counter
 
-
-@_functools.cache
-def _shed_counter():
-    from ..libs import metrics as _m
-
-    return _m.counter("rpc_overload_shed_total",
-                      "tx submissions rejected under loop overload")
+_shed_total = _counter("rpc_overload_shed_total",
+                       "tx submissions rejected under loop overload")
 
 
 def _check_overload(env: Environment) -> None:
@@ -389,7 +384,7 @@ def _check_overload(env: Environment) -> None:
         return
     lag = wd.last_lag_s
     if lag > thresh:
-        _shed_counter().inc()
+        _shed_total.inc()
         raise RPCError(-32099,
                        "server overloaded (event-loop lag "
                        f"{lag:.2f}s > {thresh:.2f}s); retry later")
