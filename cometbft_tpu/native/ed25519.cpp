@@ -787,6 +787,146 @@ static void hash_ram(sc& h, const u8 rbytes[32], const u8 pub[32],
     sc_from_bytes64(h, out);
 }
 
+#if defined(__AVX2__)
+#include <immintrin.h>
+
+// Four independent SHA-512 streams over EQUAL-LENGTH inputs in the
+// 64-bit lanes of one ymm register — the batch-verify hash_ram calls
+// are embarrassingly lane-parallel, and dense VerifyCommit rows all
+// share one length, so quads are the common case.  Verified against
+// the scalar implementation lane-for-lane (and transitively against
+// hashlib by the kernel tests).
+
+static inline __m256i mm_rotr64(__m256i x, int n) {
+    return _mm256_or_si256(_mm256_srli_epi64(x, n),
+                           _mm256_slli_epi64(x, 64 - n));
+}
+
+static void sha512_x4(const u8* m[4], u64 len, u8 out[4][64]) {
+    const __m256i iv[8] = {
+        _mm256_set1_epi64x((long long)0x6a09e667f3bcc908ULL),
+        _mm256_set1_epi64x((long long)0xbb67ae8584caa73bULL),
+        _mm256_set1_epi64x((long long)0x3c6ef372fe94f82bULL),
+        _mm256_set1_epi64x((long long)0xa54ff53a5f1d36f1ULL),
+        _mm256_set1_epi64x((long long)0x510e527fade682d1ULL),
+        _mm256_set1_epi64x((long long)0x9b05688c2b3e6c1fULL),
+        _mm256_set1_epi64x((long long)0x1f83d9abfb41bd6bULL),
+        _mm256_set1_epi64x((long long)0x5be0cd19137e2179ULL)};
+    __m256i h[8];
+    for (int i = 0; i < 8; i++) h[i] = iv[i];
+
+    // identical lengths -> identical padding layout for all four lanes
+    u64 tail_len = len % 128;
+    u64 full = len - tail_len;
+    u64 pad_total = (tail_len + 17 <= 128) ? 128 : 256;
+    u8 tail[4][256];
+    for (int l = 0; l < 4; l++) {
+        memcpy(tail[l], m[l] + full, tail_len);
+        tail[l][tail_len] = 0x80;
+        memset(tail[l] + tail_len + 1, 0, pad_total - tail_len - 1 - 16);
+        u64 bits_hi = len >> 61, bits_lo = len << 3;
+        for (int i = 0; i < 8; i++) {
+            tail[l][pad_total - 16 + i] = (u8)(bits_hi >> (56 - 8 * i));
+            tail[l][pad_total - 8 + i] = (u8)(bits_lo >> (56 - 8 * i));
+        }
+    }
+
+    u64 total_blocks = (full + pad_total) / 128;
+    for (u64 blk = 0; blk < total_blocks; blk++) {
+        const u8* p[4];
+        for (int l = 0; l < 4; l++)
+            p[l] = (blk * 128 < full) ? m[l] + blk * 128
+                                      : tail[l] + (blk * 128 - full);
+        __m256i w[80];
+        for (int i = 0; i < 16; i++) {
+            u64 w0, w1, w2, w3;
+            memcpy(&w0, p[0] + 8 * i, 8);
+            memcpy(&w1, p[1] + 8 * i, 8);
+            memcpy(&w2, p[2] + 8 * i, 8);
+            memcpy(&w3, p[3] + 8 * i, 8);
+            w[i] = _mm256_set_epi64x(
+                (long long)__builtin_bswap64(w3),
+                (long long)__builtin_bswap64(w2),
+                (long long)__builtin_bswap64(w1),
+                (long long)__builtin_bswap64(w0));
+        }
+        for (int i = 16; i < 80; i++) {
+            __m256i s0 = _mm256_xor_si256(
+                _mm256_xor_si256(mm_rotr64(w[i - 15], 1),
+                                 mm_rotr64(w[i - 15], 8)),
+                _mm256_srli_epi64(w[i - 15], 7));
+            __m256i s1 = _mm256_xor_si256(
+                _mm256_xor_si256(mm_rotr64(w[i - 2], 19),
+                                 mm_rotr64(w[i - 2], 61)),
+                _mm256_srli_epi64(w[i - 2], 6));
+            w[i] = _mm256_add_epi64(
+                _mm256_add_epi64(w[i - 16], s0),
+                _mm256_add_epi64(w[i - 7], s1));
+        }
+        __m256i a = h[0], b = h[1], c = h[2], d = h[3];
+        __m256i e = h[4], f = h[5], g = h[6], hh = h[7];
+        for (int i = 0; i < 80; i++) {
+            __m256i S1 = _mm256_xor_si256(
+                _mm256_xor_si256(mm_rotr64(e, 14), mm_rotr64(e, 18)),
+                mm_rotr64(e, 41));
+            __m256i ch = _mm256_xor_si256(
+                _mm256_and_si256(e, f),
+                _mm256_andnot_si256(e, g));
+            __m256i t1 = _mm256_add_epi64(
+                _mm256_add_epi64(_mm256_add_epi64(hh, S1), ch),
+                _mm256_add_epi64(
+                    _mm256_set1_epi64x((long long)SHA_K[i]), w[i]));
+            __m256i S0 = _mm256_xor_si256(
+                _mm256_xor_si256(mm_rotr64(a, 28), mm_rotr64(a, 34)),
+                mm_rotr64(a, 39));
+            __m256i maj = _mm256_xor_si256(
+                _mm256_xor_si256(_mm256_and_si256(a, b),
+                                 _mm256_and_si256(a, c)),
+                _mm256_and_si256(b, c));
+            __m256i t2 = _mm256_add_epi64(S0, maj);
+            hh = g; g = f; f = e; e = _mm256_add_epi64(d, t1);
+            d = c; c = b; b = a; a = _mm256_add_epi64(t1, t2);
+        }
+        h[0] = _mm256_add_epi64(h[0], a);
+        h[1] = _mm256_add_epi64(h[1], b);
+        h[2] = _mm256_add_epi64(h[2], c);
+        h[3] = _mm256_add_epi64(h[3], d);
+        h[4] = _mm256_add_epi64(h[4], e);
+        h[5] = _mm256_add_epi64(h[5], f);
+        h[6] = _mm256_add_epi64(h[6], g);
+        h[7] = _mm256_add_epi64(h[7], hh);
+    }
+    for (int i = 0; i < 8; i++) {
+        u64 lanes[4];
+        _mm256_storeu_si256((__m256i*)lanes, h[i]);
+        for (int l = 0; l < 4; l++) {
+            u64 be = __builtin_bswap64(lanes[l]);
+            memcpy(out[l] + 8 * i, &be, 8);
+        }
+    }
+}
+
+// hash_ram for four lanes sharing one message length: assembles the
+// R||A||M buffers and runs the 4-way compressor
+static void hash_ram_x4(sc h[4], const u8* rb[4], const u8* pb[4],
+                        const u8* msgs[4], u64 msg_len) {
+    static thread_local std::vector<u8> buf;
+    u64 total = 64 + msg_len;
+    if (buf.size() < 4 * total) buf.resize(4 * total);
+    const u8* ptrs[4];
+    for (int l = 0; l < 4; l++) {
+        u8* b = buf.data() + l * total;
+        memcpy(b, rb[l], 32);
+        memcpy(b + 32, pb[l], 32);
+        memcpy(b + 64, msgs[l], msg_len);
+        ptrs[l] = b;
+    }
+    u8 out[4][64];
+    sha512_x4(ptrs, total, out);
+    for (int l = 0; l < 4; l++) sc_from_bytes64(h[l], out[l]);
+}
+#endif  // __AVX2__
+
 // Decompressed-pubkey cache: validator sets are ~static across heights,
 // so the SAME A points decompress every commit; R points are unique per
 // signature and never cached.  Open-addressed, bounded, guarded by a
@@ -874,7 +1014,52 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
     points.reserve(2 * n + 1);
     scalars.reserve(2 * n + 1);
     sc s_total = {{0, 0, 0, 0}};
-    u64 msg_off = 0;
+    // cheap structural checks FIRST (canonical s, decompressible A):
+    // a bad lane must fail before the whole batch is hashed, not after
+    // (the A results warm the cache for the main loop; R decompression
+    // stays in the main loop — its cost is symmetric with the hash)
+    for (u64 i = 0; i < n; i++) {
+        sc s;
+        if (!sc_from_bytes32_checked(s, sigs + 64 * i + 32)) return 0;
+        ge A;
+        if (!a_decompress_cached(A, pubs + 32 * i)) return 0;
+    }
+    // hash phase: h_i = SHA-512(R_i || A_i || M_i) mod L, four lanes
+    // per AVX2 pass when consecutive lanes share a message length
+    // (dense VerifyCommit rows always do); scalar for the remainder
+    std::vector<sc> hs(n);
+    {
+        std::vector<u64> offs;
+        if (!msg_stride) {               // packed mode only: stride mode
+            offs.resize(n);              // never reads the prefix sums
+            u64 off = 0;
+            for (u64 i = 0; i < n; i++) { offs[i] = off; off += msg_lens[i]; }
+        }
+        auto mptr = [&](u64 i) {
+            return msg_stride ? msgs + i * msg_stride : msgs + offs[i];
+        };
+        u64 i = 0;
+        while (i < n) {
+#if defined(__AVX2__)
+            if (i + 4 <= n && msg_lens[i] == msg_lens[i + 1]
+                && msg_lens[i] == msg_lens[i + 2]
+                && msg_lens[i] == msg_lens[i + 3]) {
+                const u8 *rb[4], *pb[4], *mp[4];
+                for (int l = 0; l < 4; l++) {
+                    rb[l] = sigs + 64 * (i + l);
+                    pb[l] = pubs + 32 * (i + l);
+                    mp[l] = mptr(i + l);
+                }
+                hash_ram_x4(&hs[i], rb, pb, mp, msg_lens[i]);
+                i += 4;
+                continue;
+            }
+#endif
+            hash_ram(hs[i], sigs + 64 * i, pubs + 32 * i, mptr(i),
+                     msg_lens[i]);
+            i++;
+        }
+    }
     // z_i: 128 independent bits each, four lanes per SHA-512(seed ||
     // blockidx) call (the 64-byte digest yields 4x16 bytes) — the
     // values only need to be unpredictable per batch, and one hash per
@@ -888,10 +1073,7 @@ int ed25519_batch_verify(const u8* pubs, const u8* sigs, const u8* msgs,
         ge A, R;
         if (!a_decompress_cached(A, pub)) return 0;
         if (!ge_decompress_zip215(R, sig)) return 0;
-        sc h;
-        const u8* msg = msg_stride ? msgs + i * msg_stride : msgs + msg_off;
-        hash_ram(h, sig, pub, msg, msg_lens[i]);
-        msg_off += msg_lens[i];
+        const sc& h = hs[i];
         if (i % 4 == 0) {
             Sha512 zc;
             zc.init();
