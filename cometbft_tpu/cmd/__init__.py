@@ -218,16 +218,14 @@ async def _start_async(args) -> int:
                   file=sys.stderr)
             return 1
 
-        def _hp(s):
-            h, _, p = s.removeprefix("tcp://").rpartition(":")
-            if not p.isdigit():
-                print(f"bad statesync.rpc_servers entry {s!r}: "
-                      f"expected host:port", file=sys.stderr)
-                raise SystemExit(1)
-            return h or "127.0.0.1", int(p)
-
-        providers = [RPCProvider(*_hp(s), f"ss{i}")
-                     for i, s in enumerate(servers)]
+        providers = []
+        for i, srv in enumerate(servers):
+            try:
+                h, pt, tls, _verify = _parse_rpc_addr(srv)
+            except ValueError as e:
+                print(f"statesync.rpc_servers: {e}", file=sys.stderr)
+                raise SystemExit(1) from e
+            providers.append(RPCProvider(h, pt, f"ss{i}", tls=tls))
         light = Client(
             doc.chain_id,
             TrustOptions(cfg.statesync.trust_period,
@@ -422,11 +420,35 @@ def cmd_rollback(args) -> int:
     return 0
 
 
+def _parse_rpc_addr(addr: str) -> tuple[str, int, bool, bool]:
+    """[scheme://]host:port -> (host, port, tls, tls_verify).  Schemes:
+    http / tcp / bare (plaintext), https (TLS, verified — the reference
+    client's default), https+insecure (TLS, accept self-signed).  Raises
+    ValueError naming the ORIGINAL string on a missing port."""
+    orig = addr
+    tls = verify = False
+    if addr.startswith("https+insecure://"):
+        tls, verify = True, False
+        addr = addr.removeprefix("https+insecure://")
+    elif addr.startswith("https://"):
+        tls = verify = True
+        addr = addr.removeprefix("https://")
+    else:
+        addr = addr.removeprefix("http://").removeprefix("tcp://")
+    host, _, port = addr.rpartition(":")
+    if not port.isdigit():
+        raise ValueError(f"bad address {orig!r}: expected "
+                         "[scheme://]host:port")
+    return host or "127.0.0.1", int(port), tls, verify
+
+
 def _rpc_client(addr: str):
+    """addr per _parse_rpc_addr; https verifies certificates, the
+    https+insecure scheme accepts a node's self-signed cert."""
     from ..rpc.client import HTTPClient
 
-    host, _, port = addr.rpartition(":")
-    return HTTPClient(host or "127.0.0.1", int(port))
+    host, port, tls, verify = _parse_rpc_addr(addr)
+    return HTTPClient(host, port, tls=tls, tls_verify=verify)
 
 
 def _lock_data_dir(home: str):
@@ -840,18 +862,20 @@ async def _light_async(args) -> int:
     from ..light.rpc_provider import RPCProvider
     from ..rpc.client import HTTPClient
 
-    def parse_hp(s: str) -> tuple[str, int]:
-        host, _, port = s.removeprefix("tcp://").rpartition(":")
-        if not port.isdigit():
-            print(f"bad address {s!r}: expected host:port",
-                  file=sys.stderr)
-            raise SystemExit(2)
-        return host or "127.0.0.1", int(port)
+    def parse_hp(s: str) -> tuple[str, int, bool]:
+        try:
+            host, port, tls, _verify = _parse_rpc_addr(s)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            raise SystemExit(2) from e
+        return host, port, tls
 
-    phost, pport = parse_hp(args.primary)
-    primary = RPCProvider(phost, pport, "primary")
-    witnesses = [RPCProvider(*parse_hp(w), f"witness{i}")
-                 for i, w in enumerate(args.witness or [])]
+    phost, pport, ptls = parse_hp(args.primary)
+    primary = RPCProvider(phost, pport, "primary", tls=ptls)
+    witnesses = []
+    for i, w in enumerate(args.witness or []):
+        wh, wp, wtls = parse_hp(w)
+        witnesses.append(RPCProvider(wh, wp, f"witness{i}", tls=wtls))
     from fractions import Fraction
 
     from ..light.client import SEQUENTIAL, SKIPPING
@@ -874,7 +898,8 @@ async def _light_async(args) -> int:
         mode=SEQUENTIAL if args.sequential else SKIPPING,
         trust_level=trust_level)
     server, addr = await run_light_proxy(
-        client, HTTPClient(phost, pport), "127.0.0.1", args.port)
+        client, HTTPClient(phost, pport, tls=ptls, tls_verify=False),
+        "127.0.0.1", args.port)
     print(f"Light proxy on {addr[0]}:{addr[1]} "
           f"(primary {args.primary}, {len(witnesses)} witnesses)",
           flush=True)

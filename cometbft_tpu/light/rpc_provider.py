@@ -14,8 +14,12 @@ from .types import LightBlock
 
 
 class RPCProvider(Provider):
-    def __init__(self, host: str, port: int, name: str | None = None):
-        self.client = HTTPClient(host, port)
+    def __init__(self, host: str, port: int, name: str | None = None,
+                 *, tls: bool = False):
+        """``tls=True`` reaches an HTTPS-configured node (self-signed
+        accepted: the light client's trust comes from header hashes and
+        the trusted anchor, not from the TLS channel)."""
+        self.client = HTTPClient(host, port, tls=tls, tls_verify=False)
         self.name = name or f"rpc:{host}:{port}"
 
     def id(self) -> str:

@@ -64,7 +64,7 @@ async def generate(client, rate: float, duration_s: float,
     clients = [client]
     owned: list = []                # only close clients WE created
     if n > 1 and hasattr(client, "host") and hasattr(client, "port"):
-        owned = [type(client)(client.host, client.port)
+        owned = [client.clone()
                  for _ in range(n - 1)]
         clients += owned
     else:

@@ -24,12 +24,33 @@ class HTTPClient:
     Concurrency comes from using one client per task — see
     ``loadtime.generate``'s per-worker clients."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, *, tls: bool = False,
+                 tls_verify: bool = True):
+        """``tls=True`` speaks HTTPS to a server configured with
+        tls_cert_file/tls_key_file (the reference's client accepts
+        https:// addresses); ``tls_verify=False`` accepts self-signed
+        certs (operator tooling against a node's own cert)."""
         self.host = host
         self.port = port
+        self._ssl = None
+        if tls:
+            import ssl as _ssl
+
+            ctx = _ssl.create_default_context()
+            if not tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = _ssl.CERT_NONE
+            self._ssl = ctx
         self._id = 0
         self._conn = None                  # (reader, writer) when alive
         self._lock = asyncio.Lock()        # one in-flight request/conn
+
+    def clone(self) -> "HTTPClient":
+        """A fresh client for the same endpoint WITH the same TLS
+        settings (per-worker fan-out must not silently drop https)."""
+        c = HTTPClient(self.host, self.port)
+        c._ssl = self._ssl
+        return c
 
     async def close(self) -> None:
         if self._conn is not None:
@@ -114,7 +135,7 @@ class HTTPClient:
                 reused = self._conn is not None
                 if not reused:
                     self._conn = await asyncio.open_connection(
-                        self.host, self.port)
+                        self.host, self.port, ssl=self._ssl)
                 reader, writer = self._conn
                 try:
                     return await self._roundtrip(reader, writer, body)

@@ -206,3 +206,30 @@ def test_openapi_spec_served_and_complete():
             await node.stop()
 
     run(main())
+
+
+def test_https_client_round_trip(tmp_path):
+    """The package's own HTTPClient speaks TLS (the reference's rpc
+    client accepts https:// addresses): status + broadcast round-trip
+    against a TLS-configured node."""
+    cert, key = _self_signed(tmp_path)
+
+    async def main():
+        cfg = _cfg()
+        cfg.rpc.tls_cert_file = cert
+        cfg.rpc.tls_key_file = key
+        node = await _node(cfg)
+        try:
+            from cometbft_tpu.rpc.client import HTTPClient
+
+            host, port = node.rpc_addr
+            cli = HTTPClient(host, port, tls=True, tls_verify=False)
+            st = await cli.call("status")
+            assert st["node_info"]["network"] == "tls-net"
+            res = await cli.call("broadcast_tx_sync", tx=b"k=v".hex())
+            assert res["code"] == 0
+            await cli.close()
+        finally:
+            await node.stop()
+
+    run(main())
