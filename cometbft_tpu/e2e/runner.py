@@ -60,7 +60,17 @@ class Runner:
         return self.ports[name] + 1
 
     def setup(self) -> None:
-        """testnet generation per manifest roles (runner/setup.go)."""
+        """testnet generation per manifest roles (runner/setup.go).
+
+        The working dir is WIPED first (runner/cleanup.go runs before
+        every setup): a previous run's chain data under the same --dir
+        otherwise bleeds into this run — a different manifest's genesis
+        against stale blockstores produced stuck-at-0 nodes and replay
+        crashes before this existed."""
+        import shutil as _shutil
+
+        if os.path.isdir(self.base_dir):
+            _shutil.rmtree(self.base_dir, ignore_errors=True)
         from .gen import HomeSpec, generate_homes
 
         powers = self.m.validator_powers()
