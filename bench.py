@@ -615,13 +615,17 @@ def main() -> None:
     t_tpu = float(os.environ.get("BENCH_TPU_TIMEOUT", "480"))
     t_cpu = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
 
+    # BENCH_BACKEND forces a single attempt (chip_wake.sh uses it so a
+    # file named r*-tpu.json really holds the tpu measurement).
+    forced = os.environ.get("BENCH_BACKEND", "").strip().lower()
     platforms = os.environ.get("JAX_PLATFORMS", "")
-    want_tpu = ("cpu" != platforms.strip().lower())
+    want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
     if os.environ.get("BENCH_MODE") == "node":
         # the node child hard-forces CPU (the full-stack throughput
         # measurement has no device leg): skip the accelerator probe
         # and the redundant tpu-labeled attempt
         want_tpu = False
+        forced = "cpu"
 
     if want_tpu:
         # cheap pre-probe: when the accelerator relay is wedged, backend
@@ -653,17 +657,46 @@ def main() -> None:
                   f"the TPU attempt", file=sys.stderr, flush=True)
 
     attempts: list[tuple[str, int, float]] = []
+    errors = []
     if want_tpu:
         attempts.append(("tpu", nsig_tpu, t_tpu))
-    attempts.append(("cpu", nsig_cpu, t_cpu))
+    elif forced == "tpu":
+        # forced-tpu with no live accelerator: record WHY nothing ran
+        # rather than emitting "all backends failed: []"
+        errors.append("tpu (forced, but probe found no live accelerator)")
+    if forced != "tpu":
+        attempts.append(("cpu", nsig_cpu, t_cpu))
 
-    errors = []
+    # Run EVERY attempt and report the one the production dispatcher
+    # would route to (crypto/batch probes both backends and picks by
+    # measured throughput) — the first-success-wins policy would report
+    # the accelerator even on workloads where the native CPU path is
+    # faster, understating what a real node on this box achieves.
+    results: list[dict] = []
     for backend, nsig, timeout_s in attempts:
         result = _run_attempt(backend, nsig, timeout_s)
         if result is not None:
-            print(json.dumps(result), flush=True)
-            return
-        errors.append(backend)
+            results.append(result)
+        else:
+            errors.append(backend)
+    if results:
+        # Compare on the measured value itself — each child computes
+        # vs_baseline against its OWN in-process single-loop run, which
+        # box contention can skew across attempts.  verifycommit is a
+        # latency (lower wins); every other mode is a rate.
+        if os.environ.get("BENCH_MODE") == "verifycommit":
+            best = min(results,
+                       key=lambda r: r.get("value") or float("inf"))
+        else:
+            best = max(results, key=lambda r: r.get("value") or 0)
+        others = [r for r in results if r is not best]
+        if others:
+            best["other_backends"] = {
+                r.get("backend", "?"): {"value": r.get("value"),
+                                        "vs_baseline": r.get("vs_baseline")}
+                for r in others}
+        print(json.dumps(best), flush=True)
+        return
 
     # Every attempt failed: still emit a well-formed result line.
     mode = os.environ.get("BENCH_MODE", "commit")

@@ -153,11 +153,16 @@ async def report(client, run_id: str | None = None,
     def pct(p):
         return lat_s[min(len(lat_s) - 1, int(p * len(lat_s)))]
 
-    # throughput over the window that actually CONTAINS the run's txs,
-    # not the whole scanned chain (a long-lived node would otherwise
-    # dilute the rate toward zero)
-    window_s = (block_time[last_h] - block_time[first_h]) / 1e9 \
-        if last_h is not None and last_h > first_h else 0.0
+    # Throughput window: first SEND to last COMMIT (the commit-time
+    # proxy of the last tx-bearing block).  A block-timestamp span
+    # (ts(last_h) - ts(first_h)) would measure burst rate, not sustained
+    # throughput — when a starved node commits the whole run in two
+    # giant blocks, that span is one block interval and the "throughput"
+    # inflates ~50x.  Sends and header times come from different clocks
+    # (sender wall clock vs BFT median time), so guard the division.
+    send_min_ns = min(t for _, t in tx_send)
+    end_ns = block_time.get(last_h + 1, block_time[last_h])
+    window_s = (end_ns - send_min_ns) / 1e9
     return {
         "txs": len(lat_s),
         "blocks": (last_h - first_h + 1) if first_h else 0,

@@ -22,8 +22,13 @@ LOG=docs/bench/tpu_probe_log.txt
 STAMP=$(date -u +%Y-%m-%dT%H:%M)
 
 # ---- probe (the ONLY safe way: throwaway subprocess, hard timeout) ----
+# Sweep artifacts pin the backend they claim: tpu-tagged files force the
+# tpu attempt.  A FORCE=1 rehearsal on a chipless box instead lets
+# bench.py pick (the cpu attempt), so the rehearsal measures something.
+DEFAULT_BACKEND=tpu
 if [ "${FORCE:-}" = "1" ]; then
     echo "FORCE=1: skipping probe gate (artifacts tagged -$TAG)"
+    DEFAULT_BACKEND=
 elif timeout 60 python -c 'import jax; assert any(d.platform != "cpu" for d in jax.devices())' 2>/dev/null; then
     echo "$STAMP probe: TPU ALIVE" >> "$LOG"
     echo "chip is awake — running the full sweep"
@@ -40,7 +45,8 @@ run_mode () {  # $1 = mode name, rest = env pairs
     case " $MODES " in (*" $mode "*) ;; (*) return 0;; esac
     local out="docs/bench/r${ROUND}-${mode}-${TAG}.json"
     echo "--- BENCH_MODE=$mode -> $out"
-    if env BENCH_MODE="$mode" "$@" timeout 1800 python bench.py \
+    if env BENCH_MODE="$mode" BENCH_BACKEND="${BENCH_BACKEND:-$DEFAULT_BACKEND}" \
+         "$@" timeout 1800 python bench.py \
          > "$out" 2> "/tmp/bench-${mode}.err"; then
         tail -1 "$out"
     else

@@ -27,6 +27,43 @@ def test_load_tx_roundtrip():
     assert KVStoreApplication._parse_tx(tx) is not None
 
 
+def test_report_throughput_window_is_send_to_commit():
+    """Throughput must be sustained (first send -> last commit), not the
+    burst rate over the block-timestamp span: a starved node committing a
+    whole run in two giant blocks would otherwise report ~50x reality."""
+    from cometbft_tpu import loadtime
+
+    S = 1_000_000_000  # ns
+    t0 = 1_700_000_000 * S
+
+    # 100 txs sent over 10s, committed into just two blocks 0.4s apart
+    txs_h1 = [make_load_tx("r", i, size=64, now_ns=t0 + i * S // 10)
+              for i in range(50)]
+    txs_h2 = [make_load_tx("r", 50 + i, size=64,
+                           now_ns=t0 + 5 * S + i * S // 10)
+              for i in range(50)]
+    blocks = {
+        1: (t0 + 11 * S, txs_h1),
+        2: (t0 + int(11.4 * S), txs_h2),
+        3: (t0 + 12 * S, []),      # commit-time proxy for height 2
+    }
+
+    class FakeClient:
+        async def call(self, method, **kw):
+            if method == "status":
+                return {"sync_info": {"latest_block_height": 3}}
+            ts, txs = blocks[kw["height"]]
+            return {"block": {"hdr": {"ts": ts},
+                              "data": {"txs": [t.hex() for t in txs]}}}
+
+    rep = run(loadtime.report(FakeClient()))
+    assert rep["txs"] == 100
+    assert rep["blocks"] == 2
+    # window = ts(h=3) - first send = 12s, NOT ts(2)-ts(1) = 0.4s
+    assert abs(rep["window_s"] - 12.0) < 1e-6
+    assert abs(rep["throughput_tx_s"] - 100 / 12.0) < 0.1
+
+
 def test_load_generate_and_report_against_node():
     """Generate ~2s of load at a single-validator node over RPC, then the
     report recovers per-tx latency from committed blocks."""
