@@ -608,7 +608,7 @@ def _run_attempt(backend: str, nsig: int, timeout_s: float) -> dict | None:
 def main() -> None:
     nsig_tpu = int(os.environ.get("BENCH_NSIG", "10240"))
     # the headline shape is a 10k-validator EXTENDED commit (2 sigs/val,
-    # chunked at the 16384-lane cap): production CPU batches are huge,
+    # chunked at the 4096-lane cap): production CPU batches are huge,
     # so a small default would UNDERstate the per-sig rate the node
     # actually sees (Pippenger's per-point cost falls with batch size)
     nsig_cpu = int(os.environ.get("BENCH_NSIG_CPU", "8192"))
@@ -661,9 +661,10 @@ def main() -> None:
     if want_tpu:
         attempts.append(("tpu", nsig_tpu, t_tpu))
     elif forced == "tpu":
-        # forced-tpu with no live accelerator: record WHY nothing ran
-        # rather than emitting "all backends failed: []"
-        errors.append("tpu (forced, but probe found no live accelerator)")
+        # forced-tpu with no accelerator available: record WHY nothing
+        # ran rather than emitting "all backends failed: []"
+        errors.append("tpu (forced, but no accelerator: probe failed "
+                      "or JAX_PLATFORMS pins cpu)")
     if forced != "tpu":
         attempts.append(("cpu", nsig_cpu, t_cpu))
 

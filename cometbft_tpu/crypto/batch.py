@@ -29,7 +29,15 @@ import numpy as np
 from .keys import ED25519_KEY_TYPE, PubKey, verify_ed25519_zip215
 
 # Batch-size buckets (lanes pad up to the next one; beyond the last, chunks).
-_LANE_BUCKETS = (16, 64, 256, 1024, 4096, 16384)
+# Capped at 4096: measured on TPU v5e, verify throughput peaks at 2048-4096
+# lanes (~30k sigs/s) and HALVES by 10240 — the (B,20,39) mul intermediates
+# outgrow VMEM and the kernel goes HBM-bound (docs/bench/r04-notes.md).
+# Oversized batches chunk at the cap instead of compiling bigger shapes.
+_LANE_BUCKETS = (16, 64, 256, 1024, 2048, 4096)
+# Valset TABLE row padding is bucketed separately: a cached per-valset
+# table must hold every validator (it cannot chunk — the gather indexes
+# into it), so its row dimension keeps growing past the lane cap.
+_TABLE_BUCKETS = _LANE_BUCKETS + (8192, 16384, 32768, 65536)
 # Hash-block buckets (a vote sign-bytes message is ~120 B -> 2 blocks).
 _BLOCK_BUCKETS = (2, 3, 4, 8, 16)
 
@@ -223,7 +231,7 @@ def _valset_tables(pubs_full, devices: tuple):
     if ent is not None and ent[0] is pubs_full:
         return ent[1], ent[2], ent[3]
     n = pubs_full.shape[0]
-    nb = _bucket(n, _LANE_BUCKETS)
+    nb = _bucket(n, _TABLE_BUCKETS)
     if len(devices) > 1:
         nb += (-nb) % len(devices)
     padded = np.zeros((nb, 32), np.int32)
@@ -310,7 +318,7 @@ def bucket_for_lanes(n: int) -> int:
     """The lane bucket a batch of ``n`` signatures compiles into — node
     startup warms the bucket its configured validator-set size actually
     lands in, so a freshly-woken chip doesn't pay the XLA compile on the
-    first real commit (a 10k-validator set needs the 16384-lane shape,
+    first real commit (a 10k-validator set needs the 4096-lane cap shape,
     not the 256/1024 defaults).  Clamped to the largest bucket: the
     dispatch path chunks bigger batches at that cap, so no larger shape
     is ever compiled."""
@@ -320,8 +328,8 @@ def bucket_for_lanes(n: int) -> int:
 def buckets_for_batch(n: int) -> tuple:
     """EVERY lane bucket a batch of ``n`` signatures will dispatch:
     ``device_verify_ed25519`` splits past the largest bucket into
-    cap-sized chunks plus a remainder, so n=20000 runs the 16384 shape
-    AND the remainder's (4096) — warmup must cover both."""
+    cap-sized chunks plus a remainder, so n=10000 runs the 4096 cap
+    shape AND the remainder's bucket — warmup must cover both."""
     cap = _LANE_BUCKETS[-1]
     if n <= cap:
         return (bucket_for_lanes(n),)
@@ -333,13 +341,17 @@ def buckets_for_batch(n: int) -> tuple:
 
 
 def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
-                  device=None) -> int:
+                  device=None, valset_sizes=()) -> int:
     """Pre-compile BOTH verify kernels (plain and cached-table gather —
     the dense VerifyCommit path uses the latter) for the hot bucket
     shapes so the first real commit verification doesn't stall consensus
     for an XLA compile (node startup warmup; shapes beyond these hit the
-    persistent cache or compile on demand).  Returns the number of
-    shapes compiled."""
+    persistent cache or compile on demand).  ``valset_sizes`` warms the
+    cached-gather route at REAL validator-set scale: the per-valset
+    table pads to ``_TABLE_BUCKETS`` (which keeps growing past the lane
+    cap), so a 10k-validator commit needs the (16384-row table,
+    4096-lane chunk) gather shape — not covered by the square
+    lane-bucket warmups below.  Returns the number of shapes compiled."""
     import numpy as np
 
     done = 0
@@ -358,6 +370,23 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                     _device_verify_chunk(pubs, rs, ss, msgs, lens, device)
                     device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
                                                  msgs, lens, device)
+                    done += 1
+                except Exception:
+                    return done
+        for n_vals in valset_sizes:
+            for nb in block_buckets:
+                valset = np.zeros((n_vals, 32), np.uint8)
+                rows = np.zeros((n_vals, 32), np.uint8)
+                msg_len = nb * 128 - 64 - 17
+                msgs = np.zeros((n_vals, msg_len), np.uint8)
+                lens = np.full((n_vals,), msg_len, np.int64)
+                scope = np.zeros((n_vals,), np.int64)
+                try:
+                    # drives the real dispatch: one table build at the
+                    # n_vals TABLE bucket + every chunked gather shape
+                    device_verify_ed25519_cached(valset, scope, rows,
+                                                 rows, rows, msgs, lens,
+                                                 device)
                     done += 1
                 except Exception:
                     return done

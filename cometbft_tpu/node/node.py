@@ -427,15 +427,23 @@ class Node:
                 # size lands in — a large network's first commit must not
                 # pay a cold XLA compile (VERDICT r3 weak 1a)
                 lanes = {256, 1024}
+                vsizes = ()
                 try:
                     st = self.state_store.load()
                     if st is not None:
-                        lanes.update(cryptobatch.buckets_for_batch(
-                            len(st.validators.validators)))
+                        n_vals = len(st.validators.validators)
+                        lanes.update(
+                            cryptobatch.buckets_for_batch(n_vals))
+                        # large sets also need the cached-gather shape
+                        # at the real TABLE bucket (table rows pad past
+                        # the lane cap; chunks don't cover it)
+                        if n_vals > max(lanes):
+                            vsizes = (n_vals,)
                 except Exception:
                     pass
                 cryptobatch.warmup_device(
-                    lane_buckets=tuple(sorted(lanes)))
+                    lane_buckets=tuple(sorted(lanes)),
+                    valset_sizes=vsizes)
 
             asyncio.get_running_loop().run_in_executor(None, _warm)
         if self.syncer is not None:

@@ -43,9 +43,13 @@ fail=0
 run_mode () {  # $1 = mode name, rest = env pairs
     local mode="$1"; shift
     case " $MODES " in (*" $mode "*) ;; (*) return 0;; esac
-    local out="docs/bench/r${ROUND}-${mode}-${TAG}.json"
+    # the node mode has no accelerator leg (bench.py always runs its CPU
+    # full-stack measurement) — never stamp its artifact with a tpu tag
+    local tag="$TAG" backend="${BENCH_BACKEND:-$DEFAULT_BACKEND}"
+    if [ "$mode" = node ]; then tag=cpu; backend=cpu; fi
+    local out="docs/bench/r${ROUND}-${mode}-${tag}.json"
     echo "--- BENCH_MODE=$mode -> $out"
-    if env BENCH_MODE="$mode" BENCH_BACKEND="${BENCH_BACKEND:-$DEFAULT_BACKEND}" \
+    if env BENCH_MODE="$mode" BENCH_BACKEND="$backend" \
          "$@" timeout 1800 python bench.py \
          > "$out" 2> "/tmp/bench-${mode}.err"; then
         tail -1 "$out"
@@ -71,7 +75,7 @@ print("dryrun_multichip: ok")'; then :; else
     echo "dryrun_multichip FAILED"; fail=1
 fi
 
-git add docs/bench/r${ROUND}-*-${TAG}.json "$LOG"
+git add docs/bench/r${ROUND}-*-${TAG}.json docs/bench/r${ROUND}-node-cpu.json "$LOG" 2>/dev/null
 echo "artifacts staged; commit with:"
 echo "  git commit -m 'round ${ROUND#0}: TPU bench artifacts (chip awake)'"
 exit $fail

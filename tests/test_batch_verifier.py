@@ -364,3 +364,32 @@ def test_valset_table_cache_path():
                                           lens[sub], None)
     assert len(B._VALSET_TABLES) == 1      # same entry, no rebuild
     assert not out2[2] and out2.sum() == 5  # lane 5 == sub position 2
+
+
+def test_bucket_policy_caps_lanes_but_grows_tables():
+    """Lane buckets cap at 4096 (TPU v5e measured sweet spot — bigger
+    batches chunk), while valset TABLE rows keep bucketing upward: the
+    cached gather table must hold every validator and cannot chunk."""
+    import cometbft_tpu.crypto.batch as B
+
+    assert B._LANE_BUCKETS[-1] == 4096
+    assert B.bucket_for_lanes(10000) == 4096
+    assert B.buckets_for_batch(9000) == (1024, 4096)
+    # a 10k-validator table pads to 16384 rows, not 10000 exactly —
+    # warmup at valset_sizes=(10000,) compiles the SAME shape the first
+    # real commit will hit
+    assert B._bucket(10000, B._TABLE_BUCKETS) == 16384
+    assert B._bucket(4096, B._TABLE_BUCKETS) == 4096
+
+
+def test_warmup_covers_valset_table_shapes():
+    """warmup_device(valset_sizes=...) drives the cached-gather route at
+    real valset scale: table built at the TABLE bucket, then dropped
+    (warmup matrices are not real valsets)."""
+    import cometbft_tpu.crypto.batch as B
+
+    B._VALSET_TABLES.clear()
+    done = B.warmup_device(lane_buckets=(), block_buckets=(2,),
+                           valset_sizes=(20,))
+    assert done == 1
+    assert not B._VALSET_TABLES          # cleared after warmup
