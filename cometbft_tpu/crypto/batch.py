@@ -355,6 +355,9 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
     import numpy as np
 
     done = 0
+    pre_keys = set(_VALSET_TABLES)   # drop only warmup-created entries:
+    # a REAL commit can populate the cache concurrently (warmup runs in
+    # an executor while the node syncs) and must not lose its tables
     try:
         for lanes in lane_buckets:
             for nb in block_buckets:
@@ -391,7 +394,8 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                 except Exception:
                     return done
     finally:
-        _VALSET_TABLES.clear()    # warmup matrices aren't real valsets
+        for k in [k for k in _VALSET_TABLES if k not in pre_keys]:
+            _VALSET_TABLES.pop(k, None)   # warmup matrices aren't real
     return done
 
 

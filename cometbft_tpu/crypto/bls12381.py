@@ -84,6 +84,59 @@ class _BlspyBackend:
             m.G1Element.from_bytes(pk), msg, m.G2Element.from_bytes(sig)))
 
 
+class _NativeBackend:
+    """The bundled C++ implementation (``native/bls12381.cpp``), built on
+    demand like the other native components — the tpu-native equivalent
+    of the reference's blst binding (``crypto/bls12381/key_bls12381.go``,
+    cgo + supranational/blst behind the ``bls12381`` build tag).  Same
+    standard G2Basic ciphersuite as the pure-Python backend, pinned
+    byte-identical to it (and so to the RFC 9380 QUUX vectors) by
+    ``tests/test_bls12381.py``.  Verification is ~300x the pure-Python
+    speed; signing uses a plain double-and-add ladder, which is NOT
+    constant-time — the signing warning below applies to it too."""
+
+    def __init__(self):
+        import ctypes
+
+        from ..native import lib_path
+
+        lib = ctypes.CDLL(lib_path("bls12381"))
+        lib.bls_verify.restype = ctypes.c_int
+        lib.bls_verify.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_size_t, ctypes.c_char_p]
+        lib.bls_sign.restype = ctypes.c_int
+        lib.bls_sign.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                 ctypes.c_size_t, ctypes.c_char_p]
+        lib.bls_sk_to_pk.restype = ctypes.c_int
+        lib.bls_sk_to_pk.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.bls_selftest.restype = ctypes.c_int
+        if lib.bls_selftest() != 1:
+            raise RuntimeError("native bls12381 selftest failed")
+        self._lib = lib
+        self._ctypes = ctypes
+
+    def key_gen(self, ikm: bytes) -> int:
+        # RFC-style HKDF keygen is pure hashing — not a hot path; reuse
+        # the bundled implementation rather than duplicating HKDF in C++
+        from . import _bls12381_py as impl
+
+        return impl.keygen(ikm)
+
+    def sk_to_pk(self, sk: int) -> bytes:
+        out = self._ctypes.create_string_buffer(PUB_KEY_SIZE)
+        self._lib.bls_sk_to_pk(sk.to_bytes(PRIV_KEY_SIZE, "big"), out)
+        return out.raw
+
+    def sign(self, sk: int, msg: bytes) -> bytes:
+        out = self._ctypes.create_string_buffer(SIGNATURE_LENGTH)
+        self._lib.bls_sign(sk.to_bytes(PRIV_KEY_SIZE, "big"),
+                           msg, len(msg), out)
+        return out.raw
+
+    def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
+        return self._lib.bls_verify(pk, msg, len(msg), sig) == 1
+
+
 class _PurePyBackend:
     """The bundled pure-Python implementation (``_bls12381_py``):
     dependency-free and always available, so BLS keys WORK out of the
@@ -112,21 +165,44 @@ class _PurePyBackend:
         return self._impl.verify(pk, msg, sig)
 
 
+def _try_blspy():
+    import blspy
+
+    return _BlspyBackend(blspy)
+
+
+def _try_pyecc():
+    from py_ecc.bls import G2Basic
+
+    return _PyEccBackend(G2Basic)
+
+
 def _backend():
     """Best available host implementation; never None — the bundled
-    pure-Python fallback closes the gap."""
-    try:
-        from py_ecc.bls import G2Basic
+    pure-Python fallback closes the gap.
 
-        return _PyEccBackend(G2Basic)
-    except Exception:
-        pass
-    try:
-        import blspy
+    Preference order: blspy first (supranational/blst underneath — the
+    reference's own backend, and the only CONSTANT-TIME signer here, so
+    installing it actually fixes what the signing warning flags), then
+    the bundled native C++ build, then py_ecc, then pure Python.
+    ``COMETBFT_TPU_BLS_BACKEND`` (blspy|native|pyecc|purepy) pins one
+    explicitly — the pin never falls through to a different backend."""
+    import os
 
-        return _BlspyBackend(blspy)
-    except Exception:
-        pass
+    forced = os.environ.get("COMETBFT_TPU_BLS_BACKEND", "").strip().lower()
+    if forced:
+        maker = {"blspy": _try_blspy, "native": _NativeBackend,
+                 "pyecc": _try_pyecc, "purepy": _PurePyBackend}.get(forced)
+        if maker is None:
+            raise ValueError(
+                f"COMETBFT_TPU_BLS_BACKEND={forced!r}: expected "
+                "blspy|native|pyecc|purepy")
+        return maker()
+    for maker in (_try_blspy, _NativeBackend, _try_pyecc):
+        try:
+            return maker()
+        except Exception:
+            pass
     return _PurePyBackend()
 
 
@@ -195,11 +271,12 @@ def _warn_purepy_signing() -> None:
     _SIGN_WARNED = True
     import sys
 
-    print("WARNING: signing with a bls12_381 key on the bundled "
-          "pure-Python backend — signatures are standard-suite "
+    print("WARNING: signing with a bls12_381 key on a bundled backend "
+          "(native C++ or pure Python) — signatures are standard-suite "
           "(RFC 9380 SSWU) and interoperable, but the variable-time "
           "scalar multiplication leaks key bits through timing. Install "
-          "py_ecc or blspy for production validators.", file=sys.stderr)
+          "blspy (constant-time blst) for production validators.",
+          file=sys.stderr)
 
 
 class Bls12381PubKey(PubKey):
@@ -257,7 +334,7 @@ class Bls12381PrivKey(PrivKey):
         impl = _BACKEND
         if impl is None:
             raise ErrDisabled()
-        if isinstance(impl, _PurePyBackend):
+        if isinstance(impl, (_PurePyBackend, _NativeBackend)):
             _warn_purepy_signing()
         return impl.sign(int.from_bytes(self._raw, "big"), msg)
 

@@ -1,0 +1,1202 @@
+// Native BLS12-381 minimal-pubkey signatures (BLS_SIG_BLS12381G2_XMD:
+// SHA-256_SSWU_RO_NUL_), the C++ backend behind crypto/bls12381.py.
+//
+// Reference seam: the optional blst-backed build of the reference's
+// crypto/bls12381 key type (key_bls12381.go).  This file is an original
+// implementation, structured after this repo's own pure-Python
+// cometbft_tpu/crypto/_bls12381_py.py (same tower, same RFC 9380
+// SSWU+3-isogeny hash-to-curve, same zcash serialization), rebuilt on a
+// 6x64-bit Montgomery base field:
+//
+//   fp     = GF(p), p 381 bits, CIOS Montgomery multiplication
+//   fp2    = fp[u]/(u^2+1);  fp6 = fp2[v]/(v^3 - (1+u));  fp12 = fp6[w]/(w^2 - v)
+//   G1     = E(fp):  y^2 = x^3 + 4        (pk, 48-byte compressed)
+//   G2     = E'(fp2): y^2 = x^3 + 4(1+u)  (sig, 96-byte compressed, M-twist)
+//   e      = optimal ate pairing, affine Miller loop, factored final exp
+//
+// Shared material is limited to forced constants: the curve parameters,
+// RFC 9380 Appendix E.3 isogeny coefficients, and the suite's h_eff.
+//
+// C ABI (ctypes): bls_sk_to_pk, bls_sign, bls_verify, bls_selftest.
+
+#include <cstdint>
+#include <cstring>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+typedef uint8_t u8;
+
+// ------------------------------------------------------------------ fp
+// little-endian 64-bit limbs, Montgomery form (R = 2^384)
+
+struct fp { u64 l[6]; };
+
+static const fp P = {{0xb9feffffffffaaabull, 0x1eabfffeb153ffffull,
+                      0x6730d2a0f6b0f624ull, 0x64774b84f38512bfull,
+                      0x4b1ba7b6434bacd7ull, 0x1a0111ea397fe69aull}};
+static const u64 N0 = 0x89f3fffcfffcfffdull;          // -p^-1 mod 2^64
+static const fp R2 = {{0xf4df1f341c341746ull, 0x0a76e6a609d104f1ull,
+                       0x8de5476c4c95b6d5ull, 0x67eb88a9939d83c0ull,
+                       0x9a793e85b519952dull, 0x11988fe592cae3aaull}};
+static const fp FP_ONE_M = {{0x760900000002fffdull, 0xebf4000bc40c0002ull,
+                             0x5f48985753c758baull, 0x77ce585370525745ull,
+                             0x5c071a97a256ec6dull, 0x15f65ec3fa80e493ull}};
+static const fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+static inline int fp_cmp(const fp &a, const fp &b) {
+    for (int i = 5; i >= 0; i--) {
+        if (a.l[i] < b.l[i]) return -1;
+        if (a.l[i] > b.l[i]) return 1;
+    }
+    return 0;
+}
+
+static inline bool fp_is_zero(const fp &a) {
+    u64 t = 0;
+    for (int i = 0; i < 6; i++) t |= a.l[i];
+    return t == 0;
+}
+
+static inline void fp_cond_sub_p(fp &a) {
+    if (fp_cmp(a, P) >= 0) {
+        u128 bw = 0;
+        for (int i = 0; i < 6; i++) {
+            u128 t = (u128)a.l[i] - P.l[i] - bw;
+            a.l[i] = (u64)t;
+            bw = (t >> 64) & 1;
+        }
+    }
+}
+
+static inline fp fp_add(const fp &a, const fp &b) {
+    fp r;
+    u128 c = 0;
+    for (int i = 0; i < 6; i++) {
+        c += (u128)a.l[i] + b.l[i];
+        r.l[i] = (u64)c;
+        c >>= 64;
+    }
+    fp_cond_sub_p(r);          // a,b < p so sum < 2p: one subtract settles it
+    return r;
+}
+
+static inline fp fp_sub(const fp &a, const fp &b) {
+    fp r;
+    u128 bw = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 t = (u128)a.l[i] - b.l[i] - bw;
+        r.l[i] = (u64)t;
+        bw = (t >> 64) & 1;
+    }
+    if (bw) {
+        u128 c = 0;
+        for (int i = 0; i < 6; i++) {
+            c += (u128)r.l[i] + P.l[i];
+            r.l[i] = (u64)c;
+            c >>= 64;
+        }
+    }
+    return r;
+}
+
+static inline fp fp_neg(const fp &a) {
+    return fp_is_zero(a) ? a : fp_sub(FP_ZERO, a);
+}
+
+static inline fp fp_dbl(const fp &a) { return fp_add(a, a); }
+
+// CIOS Montgomery multiplication: r = a*b*R^-1 mod p
+static fp fp_mul(const fp &a, const fp &b) {
+    u64 t[8] = {0};
+    for (int i = 0; i < 6; i++) {
+        u128 c = 0;
+        for (int j = 0; j < 6; j++) {
+            c += (u128)t[j] + (u128)a.l[i] * b.l[j];
+            t[j] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[6] = (u64)c;
+        t[7] = (u64)(c >> 64);
+        u64 m = t[0] * N0;
+        c = (u128)t[0] + (u128)m * P.l[0];
+        c >>= 64;
+        for (int j = 1; j < 6; j++) {
+            c += (u128)t[j] + (u128)m * P.l[j];
+            t[j - 1] = (u64)c;
+            c >>= 64;
+        }
+        c += t[6];
+        t[5] = (u64)c;
+        t[6] = t[7] + (u64)(c >> 64);
+        t[7] = 0;
+    }
+    fp r;
+    memcpy(r.l, t, sizeof r.l);
+    // result < 2p (t[6] can only be set transiently); settle to [0,p)
+    fp_cond_sub_p(r);
+    return r;
+}
+
+static inline fp fp_sqr(const fp &a) { return fp_mul(a, a); }
+
+// generic pow over an exponent given as little-endian limbs
+static fp fp_pow(const fp &a, const u64 *e, int nbits) {
+    fp out = FP_ONE_M, base = a;
+    for (int i = 0; i < nbits; i++) {
+        if ((e[i >> 6] >> (i & 63)) & 1) out = fp_mul(out, base);
+        base = fp_sqr(base);
+    }
+    return out;
+}
+
+// derived exponents, built at init from P's limbs
+static u64 E_P_M2[6];      // p - 2         (inversion)
+static u64 E_P_P1_D4[6];   // (p + 1) / 4   (fp sqrt; p = 3 mod 4)
+static u64 E_P_M3_D4[6];   // (p - 3) / 4   (fp2 sqrt)
+static u64 E_P_M1_D2[6];   // (p - 1) / 2   (fp2 sqrt correction)
+static fp HALF_P;          // (p - 1) / 2 as a canonical value for sign tests
+
+static void big_sub_small(u64 *r, const u64 *a, u64 k) {
+    u128 bw = k;
+    for (int i = 0; i < 6; i++) {
+        u128 t = (u128)a[i] - bw;
+        r[i] = (u64)t;
+        bw = (t >> 64) & 1;
+    }
+}
+
+static void big_add_small(u64 *r, const u64 *a, u64 k) {
+    u128 c = k;
+    for (int i = 0; i < 6; i++) {
+        c += a[i];
+        r[i] = (u64)c;
+        c >>= 64;
+    }
+}
+
+static void big_shr(u64 *r, const u64 *a, int k) {
+    for (int i = 0; i < 6; i++) {
+        u64 lo = a[i] >> k;
+        u64 hi = (i + 1 < 6) ? (a[i + 1] << (64 - k)) : 0;
+        r[i] = lo | hi;
+    }
+}
+
+static inline fp fp_inv(const fp &a) { return fp_pow(a, E_P_M2, 381); }
+
+static bool fp_sqrt(fp &out, const fp &a) {
+    fp r = fp_pow(a, E_P_P1_D4, 379);
+    if (fp_cmp(fp_sqr(r), a) != 0) return false;
+    out = r;
+    return true;
+}
+
+static fp fp_from_mont(const fp &a) {
+    fp one = {{1, 0, 0, 0, 0, 0}};
+    return fp_mul(a, one);
+}
+
+static fp fp_to_mont(const fp &a) { return fp_mul(a, R2); }
+
+static void fp_to_bytes_be(u8 out[48], const fp &a_mont) {
+    fp a = fp_from_mont(a_mont);
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+            out[47 - 8 * i - j] = (u8)(a.l[i] >> (8 * j));
+}
+
+// returns false when the 48 bytes encode a value >= p
+static bool fp_from_bytes_be(fp &out, const u8 in[48]) {
+    fp a = FP_ZERO;
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+            a.l[i] |= (u64)in[47 - 8 * i - j] << (8 * j);
+    if (fp_cmp(a, P) >= 0) return false;
+    out = fp_to_mont(a);
+    return true;
+}
+
+// canonical comparison against (p-1)/2 (the "larger" lexicographic sign)
+static bool fp_is_larger(const fp &a_mont) {
+    fp a = fp_from_mont(a_mont);
+    return fp_cmp(a, HALF_P) > 0;
+}
+
+static bool fp_is_odd(const fp &a_mont) {
+    return fp_from_mont(a_mont).l[0] & 1;
+}
+
+// ----------------------------------------------------------------- fp2
+
+struct fp2 { fp c0, c1; };
+
+static const fp2 F2_ZERO = {FP_ZERO, FP_ZERO};
+
+static inline fp2 f2_add(const fp2 &a, const fp2 &b) {
+    return {fp_add(a.c0, b.c0), fp_add(a.c1, b.c1)};
+}
+static inline fp2 f2_sub(const fp2 &a, const fp2 &b) {
+    return {fp_sub(a.c0, b.c0), fp_sub(a.c1, b.c1)};
+}
+static inline fp2 f2_neg(const fp2 &a) {
+    return {fp_neg(a.c0), fp_neg(a.c1)};
+}
+static inline bool f2_is_zero(const fp2 &a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+static inline bool f2_eq(const fp2 &a, const fp2 &b) {
+    return fp_cmp(a.c0, b.c0) == 0 && fp_cmp(a.c1, b.c1) == 0;
+}
+
+static fp2 f2_mul(const fp2 &a, const fp2 &b) {
+    // Karatsuba over u^2 = -1
+    fp t0 = fp_mul(a.c0, b.c0);
+    fp t1 = fp_mul(a.c1, b.c1);
+    fp s = fp_mul(fp_add(a.c0, a.c1), fp_add(b.c0, b.c1));
+    return {fp_sub(t0, t1), fp_sub(s, fp_add(t0, t1))};
+}
+
+static fp2 f2_sqr(const fp2 &a) {
+    // (a0+a1)(a0-a1) + 2 a0 a1 u
+    fp s = fp_mul(fp_add(a.c0, a.c1), fp_sub(a.c0, a.c1));
+    fp t = fp_mul(a.c0, a.c1);
+    return {s, fp_dbl(t)};
+}
+
+static fp2 f2_scalar_fp(const fp2 &a, const fp &k) {
+    return {fp_mul(a.c0, k), fp_mul(a.c1, k)};
+}
+
+static fp2 f2_inv(const fp2 &a) {
+    fp t = fp_add(fp_sqr(a.c0), fp_sqr(a.c1));
+    fp ti = fp_inv(t);
+    return {fp_mul(a.c0, ti), fp_neg(fp_mul(a.c1, ti))};
+}
+
+static fp2 f2_conj(const fp2 &a) { return {a.c0, fp_neg(a.c1)}; }
+
+static fp2 f2_pow(const fp2 &a, const u64 *e, int nbits) {
+    fp2 out = {FP_ONE_M, FP_ZERO}, base = a;
+    for (int i = 0; i < nbits; i++) {
+        if ((e[i >> 6] >> (i & 63)) & 1) out = f2_mul(out, base);
+        base = f2_sqr(base);
+    }
+    return out;
+}
+
+// sqrt in fp2 (p = 3 mod 4, Adj–Rodríguez-Henríquez complex method),
+// mirroring _bls12381_py.f2_sqrt
+static bool f2_sqrt(fp2 &out, const fp2 &a) {
+    if (f2_is_zero(a)) { out = F2_ZERO; return true; }
+    fp2 a1 = f2_pow(a, E_P_M3_D4, 379);
+    fp2 alpha = f2_mul(f2_sqr(a1), a);
+    fp2 x0 = f2_mul(a1, a);
+    fp2 minus_one = {fp_neg(FP_ONE_M), FP_ZERO};
+    if (f2_eq(alpha, minus_one)) {
+        out = {fp_neg(x0.c1), x0.c0};                // i * x0
+        return true;
+    }
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    fp2 b = f2_pow(f2_add(one, alpha), E_P_M1_D2, 381);
+    fp2 x = f2_mul(b, x0);
+    if (!f2_eq(f2_sqr(x), a)) return false;
+    out = x;
+    return true;
+}
+
+// sgn0 for m=2 (RFC 9380 section 4.1)
+static int f2_sgn0(const fp2 &x) {
+    bool z0 = fp_is_zero(x.c0);
+    int s0 = fp_is_odd(x.c0) ? 1 : 0;
+    int s1 = fp_is_odd(x.c1) ? 1 : 0;
+    return s0 | (z0 ? s1 : 0);
+}
+
+// lexicographic "larger" (compare c1 first) for G2 compression sign
+static bool f2_is_larger(const fp2 &y) {
+    if (!fp_is_zero(y.c1)) return fp_is_larger(y.c1);
+    return fp_is_larger(y.c0);
+}
+
+// ----------------------------------------------------------------- fp6
+// fp6 = fp2[v]/(v^3 - XI), XI = 1 + u
+
+static inline fp2 mul_xi(const fp2 &a) {
+    // (c0 + c1 u)(1 + u) = (c0 - c1) + (c0 + c1) u
+    return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
+}
+
+struct fp6 { fp2 c0, c1, c2; };
+
+static inline fp6 f6_add(const fp6 &a, const fp6 &b) {
+    return {f2_add(a.c0, b.c0), f2_add(a.c1, b.c1), f2_add(a.c2, b.c2)};
+}
+static inline fp6 f6_sub(const fp6 &a, const fp6 &b) {
+    return {f2_sub(a.c0, b.c0), f2_sub(a.c1, b.c1), f2_sub(a.c2, b.c2)};
+}
+static inline fp6 f6_neg(const fp6 &a) {
+    return {f2_neg(a.c0), f2_neg(a.c1), f2_neg(a.c2)};
+}
+
+static fp6 f6_mul(const fp6 &a, const fp6 &b) {
+    fp2 t0 = f2_mul(a.c0, b.c0);
+    fp2 t1 = f2_mul(a.c1, b.c1);
+    fp2 t2 = f2_mul(a.c2, b.c2);
+    fp2 c0 = f2_add(t0, mul_xi(f2_sub(
+        f2_mul(f2_add(a.c1, a.c2), f2_add(b.c1, b.c2)), f2_add(t1, t2))));
+    fp2 c1 = f2_add(f2_sub(f2_mul(f2_add(a.c0, a.c1), f2_add(b.c0, b.c1)),
+                           f2_add(t0, t1)), mul_xi(t2));
+    fp2 c2 = f2_add(f2_sub(f2_mul(f2_add(a.c0, a.c2), f2_add(b.c0, b.c2)),
+                           f2_add(t0, t2)), t1);
+    return {c0, c1, c2};
+}
+
+static inline fp6 f6_sqr(const fp6 &a) { return f6_mul(a, a); }
+
+static fp6 f6_inv(const fp6 &a) {
+    fp2 c0 = f2_sub(f2_sqr(a.c0), mul_xi(f2_mul(a.c1, a.c2)));
+    fp2 c1 = f2_sub(mul_xi(f2_sqr(a.c2)), f2_mul(a.c0, a.c1));
+    fp2 c2 = f2_sub(f2_sqr(a.c1), f2_mul(a.c0, a.c2));
+    fp2 t = f2_add(mul_xi(f2_add(f2_mul(a.c2, c1), f2_mul(a.c1, c2))),
+                   f2_mul(a.c0, c0));
+    fp2 ti = f2_inv(t);
+    return {f2_mul(c0, ti), f2_mul(c1, ti), f2_mul(c2, ti)};
+}
+
+// (c0 + c1 v + c2 v^2) * v = XI c2 + c0 v + c1 v^2
+static inline fp6 f6_mul_v(const fp6 &a) {
+    return {mul_xi(a.c2), a.c0, a.c1};
+}
+
+// ---------------------------------------------------------------- fp12
+// fp12 = fp6[w]/(w^2 - v)
+
+struct fp12 { fp6 c0, c1; };
+
+static fp12 F12_ONE;       // set at init
+
+static fp12 f12_mul(const fp12 &a, const fp12 &b) {
+    fp6 t0 = f6_mul(a.c0, b.c0);
+    fp6 t1 = f6_mul(a.c1, b.c1);
+    fp6 c0 = f6_add(t0, f6_mul_v(t1));
+    fp6 c1 = f6_sub(f6_mul(f6_add(a.c0, a.c1), f6_add(b.c0, b.c1)),
+                    f6_add(t0, t1));
+    return {c0, c1};
+}
+
+static inline fp12 f12_sqr(const fp12 &a) { return f12_mul(a, a); }
+
+static fp12 f12_inv(const fp12 &a) {
+    fp6 t = f6_sub(f6_mul(a.c0, a.c0), f6_mul_v(f6_mul(a.c1, a.c1)));
+    fp6 ti = f6_inv(t);
+    return {f6_mul(a.c0, ti), f6_neg(f6_mul(a.c1, ti))};
+}
+
+static inline fp12 f12_conj(const fp12 &a) { return {a.c0, f6_neg(a.c1)}; }
+
+static inline fp12 f12_sub(const fp12 &a, const fp12 &b) {
+    return {f6_sub(a.c0, b.c0), f6_sub(a.c1, b.c1)};
+}
+
+static bool f12_is_one(const fp12 &a) {
+    return f2_eq(a.c0.c0, {FP_ONE_M, FP_ZERO}) &&
+           f2_is_zero(a.c0.c1) && f2_is_zero(a.c0.c2) &&
+           f2_is_zero(a.c1.c0) && f2_is_zero(a.c1.c1) &&
+           f2_is_zero(a.c1.c2);
+}
+
+static fp12 f12_pow(const fp12 &a, const u64 *e, int nbits) {
+    fp12 out = F12_ONE, base = a;
+    for (int i = 0; i < nbits; i++) {
+        if ((e[i >> 6] >> (i & 63)) & 1) out = f12_mul(out, base);
+        base = f12_sqr(base);
+    }
+    return out;
+}
+
+// Frobenius^2: multiplies the w^i v^j coefficient (basis power
+// k = 2j + i) by gamma_k = XI^(k (p^2-1)/6); all six gammas lie in fp.
+static fp G2GAMMA[6];      // Montgomery, set at init (canonical below)
+static const fp G2GAMMA_CANON[6] = {
+    {{1, 0, 0, 0, 0, 0}},
+    {{0x2e01fffffffeffffull, 0xde17d813620a0002ull, 0xddb3a93be6f89688ull,
+      0xba69c6076a0f77eaull, 0x5f19672fdf76ce51ull, 0}},
+    {{0x2e01fffffffefffeull, 0xde17d813620a0002ull, 0xddb3a93be6f89688ull,
+      0xba69c6076a0f77eaull, 0x5f19672fdf76ce51ull, 0}},
+    {{0xb9feffffffffaaaaull, 0x1eabfffeb153ffffull, 0x6730d2a0f6b0f624ull,
+      0x64774b84f38512bfull, 0x4b1ba7b6434bacd7ull, 0x1a0111ea397fe69aull}},
+    {{0x8bfd00000000aaacull, 0x409427eb4f49fffdull, 0x897d29650fb85f9bull,
+      0xaa0d857d89759ad4ull, 0xec02408663d4de85ull, 0x1a0111ea397fe699ull}},
+    {{0x8bfd00000000aaadull, 0x409427eb4f49fffdull, 0x897d29650fb85f9bull,
+      0xaa0d857d89759ad4ull, 0xec02408663d4de85ull, 0x1a0111ea397fe699ull}},
+};
+
+static fp12 f12_frob2(const fp12 &a) {
+    return {{f2_scalar_fp(a.c0.c0, G2GAMMA[0]),
+             f2_scalar_fp(a.c0.c1, G2GAMMA[2]),
+             f2_scalar_fp(a.c0.c2, G2GAMMA[4])},
+            {f2_scalar_fp(a.c1.c0, G2GAMMA[1]),
+             f2_scalar_fp(a.c1.c1, G2GAMMA[3]),
+             f2_scalar_fp(a.c1.c2, G2GAMMA[5])}};
+}
+
+// hard part exponent (p^4 - p^2 + 1)/r, 1268 bits
+static const u64 HARD_EXP[20] = {
+    0xe516c3f438e3ba79ull, 0xfa9912aae208ccf1ull, 0x905ce937335d5b68ull,
+    0xc71a2629b0dea236ull, 0x83774940996754c8ull, 0x21d160aeb6a1e799ull,
+    0x2ed0b283ed237db4ull, 0x915c97f36c6f1821ull, 0x67f17fcbde783765ull,
+    0x2378b9039096d1b7ull, 0x7988f8761bdc51dcull, 0x2076995003fc77a1ull,
+    0x827eca0ba621315bull, 0xe5a72bce8d63cb9full, 0xf68f7764c28b6f8aull,
+    0x2f230063cf081517ull, 0x94506632528d6a9aull, 0xd3cde88eeb996ca3ull,
+    0xc0bd38c3195c899eull, 0x000f686b3d807d01ull};
+
+static fp12 final_exponentiation(const fp12 &f) {
+    fp12 g = f12_mul(f12_conj(f), f12_inv(f));     // f^(p^6 - 1)
+    g = f12_mul(f12_frob2(g), g);                  // ^(p^2 + 1)
+    return f12_pow(g, HARD_EXP, 1268);
+}
+
+// ------------------------------------------------------------ G1 points
+
+struct g1a { fp x, y; bool inf; };
+struct g1j { fp X, Y, Z; };        // Z == 0 -> infinity
+
+static const fp G1X_CANON = {{0xfb3af00adb22c6bbull, 0x6c55e83ff97a1aefull,
+                              0xa14e3a3f171bac58ull, 0xc3688c4f9774b905ull,
+                              0x2695638c4fa9ac0full, 0x17f1d3a73197d794ull}};
+static const fp G1Y_CANON = {{0x0caa232946c5e7e1ull, 0xd03cc744a2888ae4ull,
+                              0x00db18cb2c04b3edull, 0xfcf5e095d5d00af6ull,
+                              0xa09e30ed741d8ae4ull, 0x08b3f481e3aaa0f1ull}};
+static g1a G1_GEN;                 // Montgomery, set at init
+static fp FP_B;                    // curve b = 4, Montgomery
+
+// group order r (255 bits), big-endian byte form built at init
+static const u64 ORDER_R[4] = {0xffffffff00000001ull, 0x53bda402fffe5bfeull,
+                               0x3339d80809a1d805ull, 0x73eda753299d7d48ull};
+
+static g1j g1_dbl(const g1j &p) {
+    if (fp_is_zero(p.Z)) return p;
+    // standard a=0 Jacobian doubling
+    fp A = fp_sqr(p.X), B = fp_sqr(p.Y), C = fp_sqr(B);
+    fp D = fp_dbl(fp_sub(fp_sub(fp_sqr(fp_add(p.X, B)), A), C));
+    fp E = fp_add(fp_dbl(A), A);
+    fp F = fp_sqr(E);
+    g1j r;
+    r.X = fp_sub(F, fp_dbl(D));
+    r.Y = fp_sub(fp_mul(E, fp_sub(D, r.X)),
+                 fp_dbl(fp_dbl(fp_dbl(C))));
+    r.Z = fp_mul(fp_dbl(p.Y), p.Z);
+    return r;
+}
+
+static g1j g1_add_mixed(const g1j &p, const g1a &q) {
+    if (q.inf) return p;
+    if (fp_is_zero(p.Z)) {
+        g1j r = {q.x, q.y, FP_ONE_M};
+        return r;
+    }
+    fp Z2 = fp_sqr(p.Z);
+    fp U2 = fp_mul(q.x, Z2);
+    fp S2 = fp_mul(fp_mul(q.y, Z2), p.Z);
+    if (fp_cmp(U2, p.X) == 0) {
+        if (fp_cmp(S2, p.Y) != 0) return {FP_ZERO, FP_ONE_M, FP_ZERO};
+        return g1_dbl(p);
+    }
+    fp H = fp_sub(U2, p.X), Rr = fp_sub(S2, p.Y);
+    fp H2 = fp_sqr(H), H3 = fp_mul(H2, H);
+    fp V = fp_mul(p.X, H2);
+    g1j r;
+    r.X = fp_sub(fp_sub(fp_sqr(Rr), H3), fp_dbl(V));
+    r.Y = fp_sub(fp_mul(Rr, fp_sub(V, r.X)), fp_mul(p.Y, H3));
+    r.Z = fp_mul(p.Z, H);
+    return r;
+}
+
+// scalar multiply by a big-endian byte string
+static g1j g1_mul_be(const g1a &p, const u8 *e, int elen) {
+    g1j acc = {FP_ZERO, FP_ONE_M, FP_ZERO};
+    for (int i = 0; i < elen; i++)
+        for (int b = 7; b >= 0; b--) {
+            acc = g1_dbl(acc);
+            if ((e[i] >> b) & 1) acc = g1_add_mixed(acc, p);
+        }
+    return acc;
+}
+
+static bool g1_to_affine(g1a &out, const g1j &p) {
+    if (fp_is_zero(p.Z)) { out.inf = true; return true; }
+    fp zi = fp_inv(p.Z), zi2 = fp_sqr(zi);
+    out.x = fp_mul(p.X, zi2);
+    out.y = fp_mul(p.Y, fp_mul(zi2, zi));
+    out.inf = false;
+    return true;
+}
+
+static bool g1_on_curve(const g1a &p) {
+    if (p.inf) return true;
+    fp y2 = fp_sqr(p.y);
+    fp x3 = fp_mul(fp_sqr(p.x), p.x);
+    return fp_cmp(y2, fp_add(x3, FP_B)) == 0;
+}
+
+static void order_be_bytes(u8 out[32]) {
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            out[31 - 8 * i - j] = (u8)(ORDER_R[i] >> (8 * j));
+}
+
+static bool g1_in_subgroup(const g1a &p) {
+    if (!g1_on_curve(p)) return false;
+    if (p.inf) return true;
+    u8 rb[32];
+    order_be_bytes(rb);
+    return fp_is_zero(g1_mul_be(p, rb, 32).Z);
+}
+
+// ------------------------------------------------------------ G2 points
+
+struct g2a { fp2 x, y; bool inf; };
+struct g2j { fp2 X, Y, Z; };
+
+static fp2 F2_B2;                  // 4(1+u), Montgomery, set at init
+
+static g2j g2_dbl(const g2j &p) {
+    if (f2_is_zero(p.Z)) return p;
+    fp2 A = f2_sqr(p.X), B = f2_sqr(p.Y), C = f2_sqr(B);
+    fp2 D = f2_add(f2_sub(f2_sub(f2_sqr(f2_add(p.X, B)), A), C),
+                   f2_sub(f2_sub(f2_sqr(f2_add(p.X, B)), A), C));
+    fp2 E = f2_add(f2_add(A, A), A);
+    fp2 F = f2_sqr(E);
+    g2j r;
+    r.X = f2_sub(F, f2_add(D, D));
+    fp2 C8 = f2_add(C, C); C8 = f2_add(C8, C8); C8 = f2_add(C8, C8);
+    r.Y = f2_sub(f2_mul(E, f2_sub(D, r.X)), C8);
+    r.Z = f2_mul(f2_add(p.Y, p.Y), p.Z);
+    return r;
+}
+
+static g2j g2_add_mixed(const g2j &p, const g2a &q) {
+    if (q.inf) return p;
+    if (f2_is_zero(p.Z)) {
+        fp2 one = {FP_ONE_M, FP_ZERO};
+        g2j r = {q.x, q.y, one};
+        return r;
+    }
+    fp2 Z2 = f2_sqr(p.Z);
+    fp2 U2 = f2_mul(q.x, Z2);
+    fp2 S2 = f2_mul(f2_mul(q.y, Z2), p.Z);
+    if (f2_eq(U2, p.X)) {
+        if (!f2_eq(S2, p.Y)) {
+            fp2 one = {FP_ONE_M, FP_ZERO};
+            return {F2_ZERO, one, F2_ZERO};
+        }
+        return g2_dbl(p);
+    }
+    fp2 H = f2_sub(U2, p.X), Rr = f2_sub(S2, p.Y);
+    fp2 H2 = f2_sqr(H), H3 = f2_mul(H2, H);
+    fp2 V = f2_mul(p.X, H2);
+    g2j r;
+    r.X = f2_sub(f2_sub(f2_sqr(Rr), H3), f2_add(V, V));
+    r.Y = f2_sub(f2_mul(Rr, f2_sub(V, r.X)), f2_mul(p.Y, H3));
+    r.Z = f2_mul(p.Z, H);
+    return r;
+}
+
+static g2j g2_mul_be(const g2a &p, const u8 *e, int elen) {
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    g2j acc = {F2_ZERO, one, F2_ZERO};
+    for (int i = 0; i < elen; i++)
+        for (int b = 7; b >= 0; b--) {
+            acc = g2_dbl(acc);
+            if ((e[i] >> b) & 1) acc = g2_add_mixed(acc, p);
+        }
+    return acc;
+}
+
+static bool g2_to_affine(g2a &out, const g2j &p) {
+    if (f2_is_zero(p.Z)) { out.inf = true; return true; }
+    fp2 zi = f2_inv(p.Z), zi2 = f2_sqr(zi);
+    out.x = f2_mul(p.X, zi2);
+    out.y = f2_mul(p.Y, f2_mul(zi2, zi));
+    out.inf = false;
+    return true;
+}
+
+// affine addition (used by the Miller loop's point ladder and hash map)
+static g2a g2_add_affine(const g2a &p, const g2a &q) {
+    if (p.inf) return q;
+    if (q.inf) return p;
+    fp2 lam;
+    if (f2_eq(p.x, q.x)) {
+        if (!f2_eq(p.y, q.y) || f2_is_zero(p.y))
+            return {F2_ZERO, F2_ZERO, true};
+        fp2 x2 = f2_sqr(p.x);
+        fp2 num = f2_add(f2_add(x2, x2), x2);
+        lam = f2_mul(num, f2_inv(f2_add(p.y, p.y)));
+    } else {
+        lam = f2_mul(f2_sub(q.y, p.y), f2_inv(f2_sub(q.x, p.x)));
+    }
+    fp2 x3 = f2_sub(f2_sub(f2_sqr(lam), p.x), q.x);
+    fp2 y3 = f2_sub(f2_mul(lam, f2_sub(p.x, x3)), p.y);
+    return {x3, y3, false};
+}
+
+static bool g2_on_curve(const g2a &p) {
+    if (p.inf) return true;
+    fp2 y2 = f2_sqr(p.y);
+    fp2 x3 = f2_mul(f2_sqr(p.x), p.x);
+    return f2_eq(y2, f2_add(x3, F2_B2));
+}
+
+static bool g2_in_subgroup(const g2a &p) {
+    if (!g2_on_curve(p)) return false;
+    if (p.inf) return true;
+    u8 rb[32];
+    order_be_bytes(rb);
+    return f2_is_zero(g2_mul_be(p, rb, 32).Z);
+}
+
+// -------------------------------------------------------------- pairing
+// Optimal ate, affine Miller loop over |x| = 0xd201000000010000, lines
+// evaluated generically in fp12 through the same untwist embeddings the
+// Python implementation uses (x'/w^2, y'/w^3, lam/w, each times XI^-1).
+
+static fp2 XI_INV_M;       // (1+u)^-1, set at init
+
+// fp12 element layout: ((c00,c01,c02),(c10,c11,c12)) =
+//   c00 + c01 v + c02 v^2 + w (c10 + c11 v + c12 v^2), v = w^2
+static fp12 embed_fq(const fp &c) {
+    fp12 r = {};
+    r.c0.c0 = {c, FP_ZERO};
+    return r;
+}
+static fp12 embed_g2_x(const fp2 &x) {
+    fp12 r = {};
+    r.c0.c2 = f2_mul(x, XI_INV_M);         // x' v^2 / XI
+    return r;
+}
+static fp12 embed_g2_y(const fp2 &y) {
+    fp12 r = {};
+    r.c1.c1 = f2_mul(y, XI_INV_M);         // y' v w / XI
+    return r;
+}
+static fp12 embed_g2_lambda(const fp2 &lam) {
+    fp12 r = {};
+    r.c1.c2 = f2_mul(lam, XI_INV_M);       // lam w v^2 / XI
+    return r;
+}
+
+// line through t and q (tangent when equal) evaluated at p, as fp12;
+// *vertical set when x_t == x_q but the points are not doubleable
+static fp12 line_eval(const g2a &t, const g2a &q, const g1a &p,
+                      bool *vertical) {
+    *vertical = false;
+    fp2 lam;
+    if (f2_eq(t.x, q.x) && f2_eq(t.y, q.y)) {
+        if (f2_is_zero(t.y)) { *vertical = true; }
+        else {
+            fp2 x2 = f2_sqr(t.x);
+            lam = f2_mul(f2_add(f2_add(x2, x2), x2),
+                         f2_inv(f2_add(t.y, t.y)));
+        }
+    } else if (f2_eq(t.x, q.x)) {
+        *vertical = true;
+    } else {
+        lam = f2_mul(f2_sub(q.y, t.y), f2_inv(f2_sub(q.x, t.x)));
+    }
+    if (*vertical) {
+        // x - x_t at untwisted coordinates: xp - x_t/w^2
+        return f12_sub(embed_fq(p.x), embed_g2_x(t.x));
+    }
+    // (y_p - y_t) - lam (x_p - x_t), all embedded
+    fp12 yp = embed_fq(p.y), xp = embed_fq(p.x);
+    fp12 xt = embed_g2_x(t.x), yt = embed_g2_y(t.y);
+    fp12 l = embed_g2_lambda(lam);
+    return f12_sub(f12_sub(yp, yt), f12_mul(l, f12_sub(xp, xt)));
+}
+
+// |x| = 0xd201000000010000, all 64 bits MSB-first (the loop skips the
+// leading 1, mirroring the Python bin(n)[3:] iteration)
+static const char *ATE_BITS =
+    "1101001000000001" "0000000000000000"
+    "0000000000000001" "0000000000000000";
+
+static fp12 miller_loop(const g2a &q, const g1a &p) {
+    if (q.inf || p.inf) return F12_ONE;
+    g2a t = q;
+    fp12 f = F12_ONE;
+    bool vert;
+    for (const char *b = ATE_BITS + 1; *b; b++) {
+        fp12 val = line_eval(t, t, p, &vert);
+        f = f12_mul(f12_sqr(f), val);
+        t = vert ? g2a{F2_ZERO, F2_ZERO, true} : g2_add_affine(t, t);
+        if (*b == '1') {
+            val = line_eval(t, q, p, &vert);
+            f = f12_mul(f, val);
+            t = g2_add_affine(t, q);
+        }
+    }
+    return f12_conj(f);        // x < 0
+}
+
+// ------------------------------------------------------------- SHA-256
+
+struct sha256_ctx { uint32_t h[8]; u8 buf[64]; u64 len; };
+
+static const uint32_t SHA_K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t ror(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static void sha_compress(uint32_t h[8], const u8 blk[64]) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = (uint32_t)blk[4 * i] << 24 | (uint32_t)blk[4 * i + 1] << 16 |
+               (uint32_t)blk[4 * i + 2] << 8 | blk[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ror(w[i - 15], 7) ^ ror(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ror(w[i - 2], 17) ^ ror(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ror(e, 6) ^ ror(e, 11) ^ ror(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = hh + S1 + ch + SHA_K[i] + w[i];
+        uint32_t S0 = ror(a, 2) ^ ror(a, 13) ^ ror(a, 22);
+        uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + mj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static void sha_init(sha256_ctx &c) {
+    static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                   0x1f83d9ab, 0x5be0cd19};
+    memcpy(c.h, iv, sizeof iv);
+    c.len = 0;
+}
+
+static void sha_update(sha256_ctx &c, const u8 *d, size_t n) {
+    size_t fill = c.len % 64;
+    c.len += n;
+    if (fill) {
+        size_t take = 64 - fill < n ? 64 - fill : n;
+        memcpy(c.buf + fill, d, take);
+        d += take; n -= take;
+        if (fill + take == 64) sha_compress(c.h, c.buf);
+        else return;
+    }
+    while (n >= 64) { sha_compress(c.h, d); d += 64; n -= 64; }
+    if (n) memcpy(c.buf, d, n);
+}
+
+static void sha_final(sha256_ctx &c, u8 out[32]) {
+    u64 bits = c.len * 8;
+    u8 pad[72] = {0x80};
+    size_t padlen = (c.len % 64 < 56) ? 56 - c.len % 64 : 120 - c.len % 64;
+    u8 lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = (u8)(bits >> (56 - 8 * i));
+    sha_update(c, pad, padlen);
+    sha_update(c, lenb, 8);
+    for (int i = 0; i < 8; i++)
+        for (int j = 0; j < 4; j++)
+            out[4 * i + j] = (u8)(c.h[i] >> (24 - 8 * j));
+}
+
+// --------------------------------------------------- hash to G2 (RFC 9380)
+
+static const char DST[] = "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_";
+#define DST_LEN 43
+
+// expand_message_xmd for length <= 255*32; here always 256 bytes
+static void expand_xmd(u8 *out, int outlen, const u8 *msg, size_t msglen) {
+    int ell = (outlen + 31) / 32;
+    u8 b0[32], bi[32];
+    u8 dst_prime[DST_LEN + 1];
+    memcpy(dst_prime, DST, DST_LEN);
+    dst_prime[DST_LEN] = DST_LEN;
+    sha256_ctx c;
+    sha_init(c);
+    u8 zpad[64] = {0};
+    sha_update(c, zpad, 64);
+    sha_update(c, msg, msglen);
+    u8 lib[3] = {(u8)(outlen >> 8), (u8)outlen, 0};
+    sha_update(c, lib, 3);
+    sha_update(c, dst_prime, DST_LEN + 1);
+    sha_final(c, b0);
+    sha_init(c);
+    sha_update(c, b0, 32);
+    u8 one = 1;
+    sha_update(c, &one, 1);
+    sha_update(c, dst_prime, DST_LEN + 1);
+    sha_final(c, bi);
+    int off = 0;
+    for (int i = 2;; i++) {
+        int take = outlen - off < 32 ? outlen - off : 32;
+        memcpy(out + off, bi, take);
+        off += take;
+        if (off >= outlen) break;
+        u8 x[32];
+        for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
+        sha_init(c);
+        sha_update(c, x, 32);
+        u8 ib = (u8)i;
+        sha_update(c, &ib, 1);
+        sha_update(c, dst_prime, DST_LEN + 1);
+        sha_final(c, bi);
+    }
+}
+
+// 64 big-endian bytes -> fp (mod p), Montgomery
+static fp fp_from_wide_be(const u8 in[64]) {
+    fp acc = FP_ZERO;
+    fp c256 = fp_to_mont({{256, 0, 0, 0, 0, 0}});
+    for (int i = 0; i < 64; i++) {
+        acc = fp_mul(acc, c256);
+        fp b = fp_to_mont({{in[i], 0, 0, 0, 0, 0}});
+        acc = fp_add(acc, b);
+    }
+    return acc;
+}
+
+// SSWU constants on the isogenous curve E'' (RFC 9380 section 8.8.2)
+static fp2 SSWU_A, SSWU_B, SSWU_Z;     // set at init
+
+// 3-isogeny coefficients (RFC 9380 Appendix E.3), canonical hex pairs;
+// converted to Montgomery fp2 at init.  Layout: low->high degree.
+struct k2 { const char *c0, *c1; };
+static const k2 ISO_XNUM_H[4] = {
+    {"5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6",
+     "5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97d6"},
+    {"0",
+     "11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71a"},
+    {"11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71e",
+     "8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38d"},
+    {"171d6541fa38ccfaed6dea691f5fb614cb14b4e7f4e810aa22d6108f142b85757098e38d0f671c7188e2aaaaaaaa5ed1",
+     "0"},
+};
+static const k2 ISO_XDEN_H[3] = {
+    {"0",
+     "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa63"},
+    {"c",
+     "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa9f"},
+    {"1", "0"},
+};
+static const k2 ISO_YNUM_H[4] = {
+    {"1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706",
+     "1530477c7ab4113b59a4c18b076d11930f7da5d4a07f649bf54439d87d27e500fc8c25ebf8c92f6812cfc71c71c6d706"},
+    {"0",
+     "5c759507e8e333ebb5b7a9a47d7ed8532c52d39fd3a042a88b58423c50ae15d5c2638e343d9c71c6238aaaaaaaa97be"},
+    {"11560bf17baa99bc32126fced787c88f984f87adf7ae0c7f9a208c6b4f20a4181472aaa9cb8d555526a9ffffffffc71c",
+     "8ab05f8bdd54cde190937e76bc3e447cc27c3d6fbd7063fcd104635a790520c0a395554e5c6aaaa9354ffffffffe38f"},
+    {"124c9ad43b6cf79bfbf7043de3811ad0761b0f37a1e26286b0e977c69aa274524e79097a56dc4bd9e1b371c71c718b10",
+     "0"},
+};
+static const k2 ISO_YDEN_H[4] = {
+    {"1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb",
+     "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa8fb"},
+    {"0",
+     "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffa9d3"},
+    {"12",
+     "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f6241eabfffeb153ffffb9feffffffffaa99"},
+    {"1", "0"},
+};
+static fp2 ISO_XNUM[4], ISO_XDEN[3], ISO_YNUM[4], ISO_YDEN[4];
+
+// h_eff for the G2 suite (RFC 9380 section 8.8.2): parsed at init from
+// the canonical hex to avoid byte-transcription risk
+static u8 H_EFF_BYTES[80];
+static int H_EFF_LEN;
+
+static int hexval(char c) {
+    if (c >= '0' && c <= '9') return c - '0';
+    if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+    if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+    return -1;
+}
+
+// canonical hex string -> Montgomery fp
+static fp fp_from_hex(const char *h) {
+    fp a = FP_ZERO;
+    for (const char *p = h; *p; p++) {
+        int v = hexval(*p);
+        // a = a*16 + v over the raw limbs (values stay < p by input)
+        u128 c = v;
+        for (int i = 0; i < 6; i++) {
+            u128 t = ((u128)a.l[i] << 4) + (u64)c;
+            a.l[i] = (u64)t;
+            c = t >> 64;
+        }
+    }
+    return fp_to_mont(a);
+}
+
+static fp2 f2_from_hex(const k2 &k) {
+    return {fp_from_hex(k.c0), fp_from_hex(k.c1)};
+}
+
+static fp2 horner(const fp2 *k, int n, const fp2 &x) {
+    fp2 acc = k[n - 1];
+    for (int i = n - 2; i >= 0; i--) acc = f2_add(f2_mul(acc, x), k[i]);
+    return acc;
+}
+
+// simple SWU map on E'' (RFC 9380 section 6.6.2)
+static g2a map_to_curve_sswu(const fp2 &u) {
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    fp2 u2 = f2_sqr(u);
+    fp2 zu2 = f2_mul(SSWU_Z, u2);
+    fp2 tv = f2_add(f2_sqr(zu2), zu2);
+    fp2 x1;
+    if (f2_is_zero(tv)) {
+        x1 = f2_mul(SSWU_B, f2_inv(f2_mul(SSWU_Z, SSWU_A)));
+    } else {
+        x1 = f2_mul(f2_mul(f2_neg(SSWU_B), f2_inv(SSWU_A)),
+                    f2_add(one, f2_inv(tv)));
+    }
+    fp2 gx1 = f2_add(f2_add(f2_mul(f2_sqr(x1), x1), f2_mul(SSWU_A, x1)),
+                     SSWU_B);
+    fp2 x, y;
+    if (f2_sqrt(y, gx1)) {
+        x = x1;
+    } else {
+        fp2 x2 = f2_mul(zu2, x1);
+        fp2 gx2 = f2_add(f2_add(f2_mul(f2_sqr(x2), x2), f2_mul(SSWU_A, x2)),
+                         SSWU_B);
+        if (!f2_sqrt(y, gx2)) { return {F2_ZERO, F2_ZERO, true}; }
+        x = x2;
+    }
+    if (f2_sgn0(u) != f2_sgn0(y)) y = f2_neg(y);
+    return {x, y, false};
+}
+
+// 3-isogeny E'' -> E' (Appendix E.3 rational maps)
+static g2a iso3_map(const g2a &p) {
+    if (p.inf) return p;
+    fp2 xn = horner(ISO_XNUM, 4, p.x);
+    fp2 xd = horner(ISO_XDEN, 3, p.x);
+    fp2 yn = horner(ISO_YNUM, 4, p.x);
+    fp2 yd = horner(ISO_YDEN, 4, p.x);
+    if (f2_is_zero(xd) || f2_is_zero(yd)) return {F2_ZERO, F2_ZERO, true};
+    g2a r;
+    r.x = f2_mul(xn, f2_inv(xd));
+    r.y = f2_mul(p.y, f2_mul(yn, f2_inv(yd)));
+    r.inf = false;
+    return r;
+}
+
+static g2a hash_to_g2(const u8 *msg, size_t msglen) {
+    u8 uniform[256];
+    expand_xmd(uniform, 256, msg, msglen);
+    fp2 u0 = {fp_from_wide_be(uniform), fp_from_wide_be(uniform + 64)};
+    fp2 u1 = {fp_from_wide_be(uniform + 128), fp_from_wide_be(uniform + 192)};
+    g2a q0 = iso3_map(map_to_curve_sswu(u0));
+    g2a q1 = iso3_map(map_to_curve_sswu(u1));
+    g2a s = g2_add_affine(q0, q1);
+    g2a out;
+    g2_to_affine(out, g2_mul_be(s, H_EFF_BYTES, H_EFF_LEN));
+    return out;
+}
+
+// --------------------------------------------------- serialization (zcash)
+
+static void g1_compress(u8 out[48], const g1a &p) {
+    if (p.inf) {
+        memset(out, 0, 48);
+        out[0] = 0xC0;
+        return;
+    }
+    fp_to_bytes_be(out, p.x);
+    out[0] |= 0x80 | (fp_is_larger(p.y) ? 0x20 : 0);
+}
+
+static bool g1_decompress(g1a &out, const u8 in[48]) {
+    if (!(in[0] & 0x80)) return false;
+    if (in[0] & 0x40) {
+        if (in[0] != 0xC0) return false;
+        for (int i = 1; i < 48; i++) if (in[i]) return false;
+        out = {FP_ZERO, FP_ZERO, true};
+        return true;
+    }
+    bool sign = in[0] & 0x20;
+    u8 xb[48];
+    memcpy(xb, in, 48);
+    xb[0] &= 0x1F;
+    fp x;
+    if (!fp_from_bytes_be(x, xb)) return false;
+    fp y2 = fp_add(fp_mul(fp_sqr(x), x), FP_B);
+    fp y;
+    if (!fp_sqrt(y, y2)) return false;
+    if (fp_is_larger(y) != sign) y = fp_neg(y);
+    out = {x, y, false};
+    return true;
+}
+
+static void g2_compress(u8 out[96], const g2a &p) {
+    if (p.inf) {
+        memset(out, 0, 96);
+        out[0] = 0xC0;
+        return;
+    }
+    fp_to_bytes_be(out, p.x.c1);
+    fp_to_bytes_be(out + 48, p.x.c0);
+    out[0] |= 0x80 | (f2_is_larger(p.y) ? 0x20 : 0);
+}
+
+static bool g2_decompress(g2a &out, const u8 in[96]) {
+    if (!(in[0] & 0x80)) return false;
+    if (in[0] & 0x40) {
+        if (in[0] != 0xC0) return false;
+        for (int i = 1; i < 96; i++) if (in[i]) return false;
+        out = {F2_ZERO, F2_ZERO, true};
+        return true;
+    }
+    bool sign = in[0] & 0x20;
+    u8 xb[48];
+    memcpy(xb, in, 48);
+    xb[0] &= 0x1F;
+    fp x1, x0;
+    if (!fp_from_bytes_be(x1, xb)) return false;
+    if (!fp_from_bytes_be(x0, in + 48)) return false;
+    fp2 x = {x0, x1};
+    fp2 y2 = f2_add(f2_mul(f2_sqr(x), x), F2_B2);
+    fp2 y;
+    if (!f2_sqrt(y, y2)) return false;
+    if (f2_is_larger(y) != sign) y = f2_neg(y);
+    out = {x, y, false};
+    return true;
+}
+
+// ----------------------------------------------------------------- init
+
+static bool INIT_DONE = false;
+
+static void bls_init() {
+    if (INIT_DONE) return;
+    // derived exponents from P
+    big_sub_small(E_P_M2, P.l, 2);
+    u64 t[6];
+    big_add_small(t, P.l, 1);
+    big_shr(E_P_P1_D4, t, 2);
+    big_sub_small(t, P.l, 3);
+    big_shr(E_P_M3_D4, t, 2);
+    big_sub_small(t, P.l, 1);
+    big_shr(E_P_M1_D2, t, 1);
+    memcpy(HALF_P.l, E_P_M1_D2, sizeof HALF_P.l);
+    // towers & constants
+    fp four = fp_to_mont({{4, 0, 0, 0, 0, 0}});
+    FP_B = four;
+    F2_B2 = {four, four};
+    fp2 xi = {FP_ONE_M, FP_ONE_M};
+    XI_INV_M = f2_inv(xi);
+    F12_ONE = {};
+    F12_ONE.c0.c0 = {FP_ONE_M, FP_ZERO};
+    for (int k = 0; k < 6; k++) G2GAMMA[k] = fp_to_mont(G2GAMMA_CANON[k]);
+    G1_GEN = {fp_to_mont(G1X_CANON), fp_to_mont(G1Y_CANON), false};
+    // SSWU constants: A' = 240 u, B' = 1012(1+u), Z = -(2+u)
+    fp c240 = fp_to_mont({{240, 0, 0, 0, 0, 0}});
+    fp c1012 = fp_to_mont({{1012, 0, 0, 0, 0, 0}});
+    fp c2 = fp_to_mont({{2, 0, 0, 0, 0, 0}});
+    SSWU_A = {FP_ZERO, c240};
+    SSWU_B = {c1012, c1012};
+    SSWU_Z = {fp_neg(c2), fp_neg(FP_ONE_M)};
+    for (int i = 0; i < 4; i++) ISO_XNUM[i] = f2_from_hex(ISO_XNUM_H[i]);
+    for (int i = 0; i < 3; i++) ISO_XDEN[i] = f2_from_hex(ISO_XDEN_H[i]);
+    for (int i = 0; i < 4; i++) ISO_YNUM[i] = f2_from_hex(ISO_YNUM_H[i]);
+    for (int i = 0; i < 4; i++) ISO_YDEN[i] = f2_from_hex(ISO_YDEN_H[i]);
+    // h_eff bytes from the canonical hex (80 bytes, 636 bits)
+    static const char *heff_hex =
+        "bc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff031508ffe13"
+        "29c2f178731db956d82bf015d1212b02ec0ec69d7477c1ae954cbc06689f6a35"
+        "9894c0adebbf6b4e8020005aaa95551";
+    // parse hex into big-endian bytes
+    int n = 0;
+    const char *h = heff_hex;
+    int hl = (int)strlen(h);
+    int off = hl & 1;           // odd-length hex: first byte is one nibble
+    if (off) H_EFF_BYTES[n++] = (u8)hexval(h[0]);
+    for (int i = off; i < hl; i += 2)
+        H_EFF_BYTES[n++] = (u8)((hexval(h[i]) << 4) | hexval(h[i + 1]));
+    H_EFF_LEN = n;
+    INIT_DONE = true;
+}
+
+// ------------------------------------------------------------------ API
+
+extern "C" {
+
+// sk: 32 bytes big-endian (already reduced mod r by the caller)
+int bls_sk_to_pk(const u8 *sk, u8 *out48) {
+    bls_init();
+    g1a pk;
+    g1_to_affine(pk, g1_mul_be(G1_GEN, sk, 32));
+    g1_compress(out48, pk);
+    return 1;
+}
+
+int bls_sign(const u8 *sk, const u8 *msg, size_t msglen, u8 *out96) {
+    bls_init();
+    g2a h = hash_to_g2(msg, msglen);
+    g2a sig;
+    g2_to_affine(sig, g2_mul_be(h, sk, 32));
+    g2_compress(out96, sig);
+    return 1;
+}
+
+int bls_verify(const u8 *pk48, const u8 *msg, size_t msglen,
+               const u8 *sig96) {
+    bls_init();
+    g1a pk;
+    g2a sig;
+    if (!g1_decompress(pk, pk48)) return 0;
+    if (!g2_decompress(sig, sig96)) return 0;
+    if (pk.inf || sig.inf) return 0;
+    if (!g1_in_subgroup(pk)) return 0;
+    if (!g2_in_subgroup(sig)) return 0;
+    g2a h = hash_to_g2(msg, msglen);
+    // e(pk, H(m)) == e(g1, sig)  <=>  e(pk, H(m)) e(-g1, sig) == 1
+    g1a neg_g1 = {G1_GEN.x, fp_neg(G1_GEN.y), false};
+    fp12 f = f12_mul(miller_loop(h, pk), miller_loop(sig, neg_g1));
+    return f12_is_one(final_exponentiation(f)) ? 1 : 0;
+}
+
+// sanity pipeline: key -> pk -> sign -> verify (+ tamper reject)
+int bls_selftest(void) {
+    bls_init();
+    if (!g1_on_curve(G1_GEN)) return 0;
+    u8 sk[32] = {0};
+    sk[31] = 7;
+    u8 pk[48], sig[96];
+    bls_sk_to_pk(sk, pk);
+    const u8 msg[] = "bls-selftest";
+    bls_sign(sk, msg, sizeof msg - 1, sig);
+    if (!bls_verify(pk, msg, sizeof msg - 1, sig)) return 0;
+    u8 bad[96];
+    memcpy(bad, sig, 96);
+    bad[95] ^= 1;
+    if (bls_verify(pk, msg, sizeof msg - 1, bad)) return 0;
+    const u8 msg2[] = "bls-selftest2";
+    if (bls_verify(pk, msg2, sizeof msg2 - 1, sig)) return 0;
+    return 1;
+}
+
+}  // extern "C"

@@ -44,9 +44,14 @@ run_mode () {  # $1 = mode name, rest = env pairs
     local mode="$1"; shift
     case " $MODES " in (*" $mode "*) ;; (*) return 0;; esac
     # the node mode has no accelerator leg (bench.py always runs its CPU
-    # full-stack measurement) — never stamp its artifact with a tpu tag
+    # full-stack measurement) — never stamp its artifact with the tpu
+    # tag.  Custom TAGs (rehearsals) keep their own name so they cannot
+    # clobber the canonical r*-node-cpu.json artifact.
     local tag="$TAG" backend="${BENCH_BACKEND:-$DEFAULT_BACKEND}"
-    if [ "$mode" = node ]; then tag=cpu; backend=cpu; fi
+    if [ "$mode" = node ]; then
+        backend=cpu
+        [ "$tag" = tpu ] && tag=cpu
+    fi
     local out="docs/bench/r${ROUND}-${mode}-${tag}.json"
     echo "--- BENCH_MODE=$mode -> $out"
     if env BENCH_MODE="$mode" BENCH_BACKEND="$backend" \
@@ -75,7 +80,10 @@ print("dryrun_multichip: ok")'; then :; else
     echo "dryrun_multichip FAILED"; fail=1
 fi
 
-git add docs/bench/r${ROUND}-*-${TAG}.json docs/bench/r${ROUND}-node-cpu.json "$LOG" 2>/dev/null
+git add "$LOG"
+for f in docs/bench/r${ROUND}-*-${TAG}.json docs/bench/r${ROUND}-node-cpu.json; do
+    [ -f "$f" ] && git add "$f"
+done
 echo "artifacts staged; commit with:"
 echo "  git commit -m 'round ${ROUND#0}: TPU bench artifacts (chip awake)'"
 exit $fail

@@ -134,3 +134,50 @@ def test_signature_scheme_through_key_seam():
     assert not pub.verify_signature(b"other", sig)
     assert not pub.verify_signature(b"payload", b"\x00" * 96)
     assert not pub.verify_signature(b"payload", sig[:-1])
+
+
+def test_native_backend_selected_and_byte_parity():
+    """The backend seam prefers the native C++ build, and its pk/sig/
+    verify are byte-identical with the RFC-pinned pure-Python
+    implementation (which transitively pins the native hash-to-curve to
+    the RFC 9380 QUUX vectors above)."""
+    from cometbft_tpu.crypto import _bls12381_py as b
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    assert isinstance(keys._BACKEND, keys._NativeBackend), \
+        type(keys._BACKEND).__name__
+    n = keys._BACKEND
+    for seed, msg in ((5, b""), (12345, b"native-parity"),
+                      (2 ** 200 + 17, b"x" * 75)):
+        sk = seed % b.R
+        assert n.sk_to_pk(sk) == b.sk_to_pk(sk)
+        sig_n = n.sign(sk, msg)
+        assert sig_n == b.sign(sk, msg)
+        assert n.verify(b.sk_to_pk(sk), msg, sig_n)
+        assert b.verify(b.sk_to_pk(sk), msg, sig_n)
+
+
+def test_native_backend_rejects_malleated_inputs():
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    n = keys._BACKEND
+    assert isinstance(n, keys._NativeBackend)
+    sk = 99991
+    pk = n.sk_to_pk(sk)
+    msg = b"reject-malleation"
+    sig = n.sign(sk, msg)
+    assert n.verify(pk, msg, sig)
+    for pos in (0, 1, 47, 48, 95):
+        bad = bytearray(sig)
+        bad[pos] ^= 0x04
+        assert not n.verify(pk, msg, bytes(bad)), pos
+    for pos in (0, 5, 47):
+        bad = bytearray(pk)
+        bad[pos] ^= 0x04
+        assert not n.verify(bytes(bad), msg, sig), pos
+    assert not n.verify(pk, msg + b".", sig)
+    # infinity encodings must be rejected outright
+    inf_pk = bytes([0xC0] + [0] * 47)
+    inf_sig = bytes([0xC0] + [0] * 95)
+    assert not n.verify(inf_pk, msg, sig)
+    assert not n.verify(pk, msg, inf_sig)
