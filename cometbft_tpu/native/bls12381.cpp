@@ -12,7 +12,13 @@
 //   fp2    = fp[u]/(u^2+1);  fp6 = fp2[v]/(v^3 - (1+u));  fp12 = fp6[w]/(w^2 - v)
 //   G1     = E(fp):  y^2 = x^3 + 4        (pk, 48-byte compressed)
 //   G2     = E'(fp2): y^2 = x^3 + 4(1+u)  (sig, 96-byte compressed, M-twist)
-//   e      = optimal ate pairing, affine Miller loop, factored final exp
+//   e      = optimal ate pairing: inversion-free Jacobian Miller loop with
+//            sparse line multiplication (affine fallback for degenerate
+//            inputs), easy final exp + base-p digit / 4-way-Shamir hard part
+//   G2 aux = psi-endomorphism subgroup check (Scott) and RFC 9380 App. G.3
+//            fast cofactor clearing
+// Remaining known headroom (measured, not yet taken): Granger-Scott
+// cyclotomic squaring in the hard-part ladder (~2x its cost).
 //
 // Shared material is limited to forced constants: the curve parameters,
 // RFC 9380 Appendix E.3 isogeny coefficients, and the suite's h_eff.
@@ -58,14 +64,17 @@ static inline bool fp_is_zero(const fp &a) {
 }
 
 static inline void fp_cond_sub_p(fp &a) {
-    if (fp_cmp(a, P) >= 0) {
-        u128 bw = 0;
-        for (int i = 0; i < 6; i++) {
-            u128 t = (u128)a.l[i] - P.l[i] - bw;
-            a.l[i] = (u64)t;
-            bw = (t >> 64) & 1;
-        }
+    // branchless: compute a - p, keep it unless the subtract borrowed
+    u64 d[6];
+    u128 bw = 0;
+    for (int i = 0; i < 6; i++) {
+        u128 t = (u128)a.l[i] - P.l[i] - bw;
+        d[i] = (u64)t;
+        bw = (t >> 64) & 1;
     }
+    u64 keep = (u64)0 - (u64)(1 - (u64)bw);   // all-ones when a >= p
+    for (int i = 0; i < 6; i++)
+        a.l[i] = (a.l[i] & ~keep) | (d[i] & keep);
 }
 
 static inline fp fp_add(const fp &a, const fp &b) {
@@ -172,6 +181,15 @@ static void big_add_small(u64 *r, const u64 *a, u64 k) {
         c += a[i];
         r[i] = (u64)c;
         c >>= 64;
+    }
+}
+
+static void big_div_small(u64 *r, const u64 *a, u64 d) {
+    u128 rem = 0;
+    for (int i = 5; i >= 0; i--) {
+        u128 cur = (rem << 64) | a[i];
+        r[i] = (u64)(cur / d);
+        rem = cur % d;
     }
 }
 
@@ -406,15 +424,6 @@ static bool f12_is_one(const fp12 &a) {
            f2_is_zero(a.c1.c2);
 }
 
-static fp12 f12_pow(const fp12 &a, const u64 *e, int nbits) {
-    fp12 out = F12_ONE, base = a;
-    for (int i = 0; i < nbits; i++) {
-        if ((e[i >> 6] >> (i & 63)) & 1) out = f12_mul(out, base);
-        base = f12_sqr(base);
-    }
-    return out;
-}
-
 // Frobenius^2: multiplies the w^i v^j coefficient (basis power
 // k = 2j + i) by gamma_k = XI^(k (p^2-1)/6); all six gammas lie in fp.
 static fp G2GAMMA[6];      // Montgomery, set at init (canonical below)
@@ -441,20 +450,59 @@ static fp12 f12_frob2(const fp12 &a) {
              f2_scalar_fp(a.c1.c2, G2GAMMA[5])}};
 }
 
-// hard part exponent (p^4 - p^2 + 1)/r, 1268 bits
-static const u64 HARD_EXP[20] = {
-    0xe516c3f438e3ba79ull, 0xfa9912aae208ccf1ull, 0x905ce937335d5b68ull,
-    0xc71a2629b0dea236ull, 0x83774940996754c8ull, 0x21d160aeb6a1e799ull,
-    0x2ed0b283ed237db4ull, 0x915c97f36c6f1821ull, 0x67f17fcbde783765ull,
-    0x2378b9039096d1b7ull, 0x7988f8761bdc51dcull, 0x2076995003fc77a1ull,
-    0x827eca0ba621315bull, 0xe5a72bce8d63cb9full, 0xf68f7764c28b6f8aull,
-    0x2f230063cf081517ull, 0x94506632528d6a9aull, 0xd3cde88eeb996ca3ull,
-    0xc0bd38c3195c899eull, 0x000f686b3d807d01ull};
+// Frobenius^1: w^p = w * XI^((p-1)/6), and x^p = conj(x) on fp2, so the
+// coefficient at basis power k (w-degree + 2*v-degree ordering as in
+// frob2 above) maps to conj(c_k) * GAMMA1^k.  GAMMA1 = XI^((p-1)/6) is
+// computed at init (it is a full fp2 element, unlike the frob2 gammas).
+static fp2 GAMMA1_POW[6];
+
+static fp12 f12_frob1(const fp12 &a) {
+    return {{f2_mul(f2_conj(a.c0.c0), GAMMA1_POW[0]),
+             f2_mul(f2_conj(a.c0.c1), GAMMA1_POW[2]),
+             f2_mul(f2_conj(a.c0.c2), GAMMA1_POW[4])},
+            {f2_mul(f2_conj(a.c1.c0), GAMMA1_POW[1]),
+             f2_mul(f2_conj(a.c1.c1), GAMMA1_POW[3]),
+             f2_mul(f2_conj(a.c1.c2), GAMMA1_POW[5])}};
+}
+
+// The hard part (p^4 - p^2 + 1)/r written in base p: h = d3 p^3 + d2 p^2
+// + d1 p + d0 (each digit < p), so f^h = f^d0 (f^p)^d1 (f^p^2)^d2
+// (f^p^3)^d3 — the p-power bases are one Frobenius map each, and the
+// four 381-bit exponentiations run as ONE 4-way Shamir joint ladder
+// (381 squarings + <=381 multiplies by a 15-entry product table)
+// instead of a 1268-bit double-and-square chain.
+static const u64 HARD_DIG[4][6] = {
+    {0xaaaa0000aaaaaaacull, 0x33813d5206aa1800ull, 0x665a045e22ec661full,
+     0xf7a34148de09bf34ull, 0x2b688550f8cebd66ull, 0x1a0111ea397fe69aull},
+    {0x73ffffffffff5554ull, 0x9d586d584eacaaaaull, 0xc49f25e1a737f5e2ull,
+     0x26a48d1bb889d46dull, 0, 0},
+    {0x1ea8ffff5554aaabull, 0xb27c92a7df51e7feull, 0x38158e5c24aff488ull,
+     0x64774b84f38512bfull, 0x4b1ba7b6434bacd7ull, 0x1a0111ea397fe69aull},
+    {0x8c00aaab0000aaaaull, 0x396c8c005555e156ull, 0, 0, 0, 0},
+};
 
 static fp12 final_exponentiation(const fp12 &f) {
     fp12 g = f12_mul(f12_conj(f), f12_inv(f));     // f^(p^6 - 1)
     g = f12_mul(f12_frob2(g), g);                  // ^(p^2 + 1)
-    return f12_pow(g, HARD_EXP, 1268);
+    // bases g^(p^i) and the 15 subset products
+    fp12 base[4];
+    base[0] = g;
+    for (int i = 1; i < 4; i++) base[i] = f12_frob1(base[i - 1]);
+    fp12 tab[16];
+    tab[0] = F12_ONE;
+    for (int m = 1; m < 16; m++) {
+        int lb = m & -m, rest = m ^ lb, bi = __builtin_ctz(lb);
+        tab[m] = rest ? f12_mul(tab[rest], base[bi]) : base[bi];
+    }
+    fp12 acc = F12_ONE;
+    for (int i = 380; i >= 0; i--) {
+        acc = f12_sqr(acc);
+        int m = 0;
+        for (int d = 0; d < 4; d++)
+            m |= (int)((HARD_DIG[d][i >> 6] >> (i & 63)) & 1) << d;
+        if (m) acc = f12_mul(acc, tab[m]);
+    }
+    return acc;
 }
 
 // ------------------------------------------------------------ G1 points
@@ -649,12 +697,80 @@ static bool g2_on_curve(const g2a &p) {
     return f2_eq(y2, f2_add(x3, F2_B2));
 }
 
+// psi = twist o frobenius o untwist on E'(fp2): with this file's
+// untwist (x'/w^2, y'/w^3) and w^p = w GAMMA1,
+//   psi(x, y) = (conj(x) GAMMA1^-2, conj(y) GAMMA1^-3).
+static fp2 PSI_CX, PSI_CY;         // set at init
+
+static g2a g2_psi(const g2a &p) {
+    if (p.inf) return p;
+    return {f2_mul(f2_conj(p.x), PSI_CX),
+            f2_mul(f2_conj(p.y), PSI_CY), false};
+}
+
+// psi on Jacobian coordinates: x = X/Z^2, y = Y/Z^3, and conj is
+// multiplicative, so conj each coordinate and scale X, Y only.
+static g2j g2j_psi(const g2j &p) {
+    return {f2_mul(f2_conj(p.X), PSI_CX),
+            f2_mul(f2_conj(p.Y), PSI_CY), f2_conj(p.Z)};
+}
+
+static g2j g2j_neg(const g2j &p) { return {p.X, f2_neg(p.Y), p.Z}; }
+
+// general Jacobian-Jacobian addition
+static g2j g2j_add(const g2j &p, const g2j &q) {
+    if (f2_is_zero(p.Z)) return q;
+    if (f2_is_zero(q.Z)) return p;
+    fp2 Z1Z1 = f2_sqr(p.Z), Z2Z2 = f2_sqr(q.Z);
+    fp2 U1 = f2_mul(p.X, Z2Z2), U2 = f2_mul(q.X, Z1Z1);
+    fp2 S1 = f2_mul(f2_mul(p.Y, q.Z), Z2Z2);
+    fp2 S2 = f2_mul(f2_mul(q.Y, p.Z), Z1Z1);
+    if (f2_eq(U1, U2)) {
+        if (!f2_eq(S1, S2)) {
+            fp2 one = {FP_ONE_M, FP_ZERO};
+            return {F2_ZERO, one, F2_ZERO};
+        }
+        return g2_dbl(p);
+    }
+    fp2 H = f2_sub(U2, U1), Rr = f2_sub(S2, S1);
+    fp2 H2 = f2_sqr(H), H3 = f2_mul(H2, H);
+    fp2 V = f2_mul(U1, H2);
+    g2j r;
+    r.X = f2_sub(f2_sub(f2_sqr(Rr), H3), f2_add(V, V));
+    r.Y = f2_sub(f2_mul(Rr, f2_sub(V, r.X)), f2_mul(S1, H3));
+    r.Z = f2_mul(f2_mul(p.Z, q.Z), H);
+    return r;
+}
+
+// |x| = 0xd201000000010000 big-endian (the BLS parameter magnitude)
+static const u8 ABS_X_BE[8] = {0xd2, 0x01, 0, 0, 0, 0x01, 0, 0};
+
+// [x]P over a Jacobian base, x = -|x| (no inversion: stays Jacobian)
+static g2j g2j_mul_by_x(const g2j &p) {
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    g2j acc = {F2_ZERO, one, F2_ZERO};
+    for (int i = 0; i < 8; i++)
+        for (int b = 7; b >= 0; b--) {
+            acc = g2_dbl(acc);
+            if ((ABS_X_BE[i] >> b) & 1) acc = g2j_add(acc, p);
+        }
+    return g2j_neg(acc);
+}
+
 static bool g2_in_subgroup(const g2a &p) {
+    // psi acts on G2 as multiplication by t-1 = x (Scott's criterion:
+    // P is in G2 iff psi(P) == [x]P); a 64-bit ladder instead of the
+    // generic 255-bit order multiplication, compared cross-multiplied
+    // so no inversion is spent normalizing [x]P
     if (!g2_on_curve(p)) return false;
     if (p.inf) return true;
-    u8 rb[32];
-    order_be_bytes(rb);
-    return f2_is_zero(g2_mul_be(p, rb, 32).Z);
+    g2a lhs = g2_psi(p);                 // p != inf so psi(p) != inf
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    g2j rhs = g2j_mul_by_x({p.x, p.y, one});
+    if (f2_is_zero(rhs.Z)) return false;
+    fp2 Z2 = f2_sqr(rhs.Z);
+    return f2_eq(f2_mul(lhs.x, Z2), rhs.X) &&
+           f2_eq(f2_mul(f2_mul(lhs.y, Z2), rhs.Z), rhs.Y);
 }
 
 // -------------------------------------------------------------- pairing
@@ -722,7 +838,7 @@ static const char *ATE_BITS =
     "1101001000000001" "0000000000000000"
     "0000000000000001" "0000000000000000";
 
-static fp12 miller_loop(const g2a &q, const g1a &p) {
+static fp12 miller_loop_affine(const g2a &q, const g1a &p) {
     if (q.inf || p.inf) return F12_ONE;
     g2a t = q;
     fp12 f = F12_ONE;
@@ -735,6 +851,96 @@ static fp12 miller_loop(const g2a &q, const g1a &p) {
             val = line_eval(t, q, p, &vert);
             f = f12_mul(f, val);
             t = g2_add_affine(t, q);
+        }
+    }
+    return f12_conj(f);        // x < 0
+}
+
+// --- inversion-free fast path -------------------------------------------
+// Lines are tracked in the sparse form  a + b (v w) + c (v^2 w)  (fp2
+// coefficients; exactly the slots the affine embedding populates), and
+// the running T stays Jacobian so no per-step field inversion is needed.
+// Each line is scaled by a nonzero fp2 constant (the cleared
+// denominator), which the final exponentiation's easy part kills:
+// fp2* elements are roots of unity under (p^6-1).
+
+// f *= a + b(vw) + c(v^2 w)
+static fp12 f12_mul_sparse(const fp12 &f, const fp2 &a, const fp2 &b,
+                           const fp2 &c) {
+    // A6 = (a,0,0), B6 = (0,b,c):  r0 = f0 A6 + v (f1 B6);
+    // r1 = f0 B6 + f1 A6
+    fp6 f0a = {f2_mul(f.c0.c0, a), f2_mul(f.c0.c1, a), f2_mul(f.c0.c2, a)};
+    fp6 f1a = {f2_mul(f.c1.c0, a), f2_mul(f.c1.c1, a), f2_mul(f.c1.c2, a)};
+    // f6 * (0,b,c): 5-mul sparse product (f6_mul with b0 = 0)
+    auto mul_sp = [](const fp6 &x, const fp2 &b, const fp2 &c) -> fp6 {
+        fp2 t1 = f2_mul(x.c1, b);
+        fp2 t2 = f2_mul(x.c2, c);
+        fp2 c0 = mul_xi(f2_sub(
+            f2_mul(f2_add(x.c1, x.c2), f2_add(b, c)), f2_add(t1, t2)));
+        fp2 c1 = f2_add(f2_sub(f2_mul(f2_add(x.c0, x.c1), b), t1),
+                        mul_xi(t2));
+        fp2 c2 = f2_add(f2_sub(f2_mul(f2_add(x.c0, x.c2), c), t2), t1);
+        return {c0, c1, c2};
+    };
+    fp6 f0b = mul_sp(f.c0, b, c);
+    fp6 f1b = mul_sp(f.c1, b, c);
+    return {f6_add(f0a, f6_mul_v(f1b)), f6_add(f0b, f1a)};
+}
+
+// doubling step: line through T (Jacobian), scaled by 2 Y Z^4
+static void dbl_step(g2j &t, const g1a &p, fp2 &a, fp2 &b, fp2 &c,
+                     bool *bad) {
+    if (f2_is_zero(t.Z) || f2_is_zero(t.Y)) { *bad = true; return; }
+    fp2 X2 = f2_sqr(t.X);
+    fp2 X3 = f2_mul(X2, t.X);
+    fp2 Y2 = f2_sqr(t.Y);
+    fp2 Z2 = f2_sqr(t.Z);
+    fp2 Z3 = f2_mul(Z2, t.Z);
+    fp2 Z4 = f2_sqr(Z2);
+    // lambda = 3X^2 / (2YZ); value * 2YZ^4:
+    //   a = 2 Y Z^4 yp;  b = Z (3X^3 - 2Y^2) / XI;  c = -3 X^2 Z^3 xp / XI
+    fp2 yz4 = f2_mul(t.Y, Z4);
+    a = f2_scalar_fp(f2_add(yz4, yz4), p.y);
+    fp2 x3_3 = f2_add(f2_add(X3, X3), X3);
+    b = f2_mul(f2_mul(t.Z, f2_sub(x3_3, f2_add(Y2, Y2))), XI_INV_M);
+    fp2 x2_3 = f2_add(f2_add(X2, X2), X2);
+    c = f2_scalar_fp(f2_neg(f2_mul(f2_mul(x2_3, Z3), XI_INV_M)), p.x);
+    t = g2_dbl(t);
+}
+
+// addition step: line through T and affine Q, scaled by H Z
+static void add_step(g2j &t, const g2a &q, const g1a &p, fp2 &a, fp2 &b,
+                     fp2 &c, bool *bad) {
+    if (f2_is_zero(t.Z)) { *bad = true; return; }
+    fp2 Z2 = f2_sqr(t.Z);
+    fp2 Z3 = f2_mul(Z2, t.Z);
+    fp2 H = f2_sub(f2_mul(q.x, Z2), t.X);       // xq Z^2 - X
+    fp2 M = f2_sub(f2_mul(q.y, Z3), t.Y);       // yq Z^3 - Y
+    if (f2_is_zero(H)) { *bad = true; return; }
+    // lambda = M / (H Z); value * H Z:
+    //   a = H Z yp;  b = (M xq - H Z yq) / XI;  c = -M xp / XI
+    fp2 hz = f2_mul(H, t.Z);
+    a = f2_scalar_fp(hz, p.y);
+    b = f2_mul(f2_sub(f2_mul(M, q.x), f2_mul(hz, q.y)), XI_INV_M);
+    c = f2_scalar_fp(f2_neg(f2_mul(M, XI_INV_M)), p.x);
+    t = g2_add_mixed(t, q);
+}
+
+static fp12 miller_loop(const g2a &q, const g1a &p) {
+    if (q.inf || p.inf) return F12_ONE;
+    fp2 one2 = {FP_ONE_M, FP_ZERO};
+    g2j t = {q.x, q.y, one2};
+    fp12 f = F12_ONE;
+    fp2 a, b, c;
+    bool bad = false;
+    for (const char *bit = ATE_BITS + 1; *bit; bit++) {
+        dbl_step(t, p, a, b, c, &bad);
+        if (bad) return miller_loop_affine(q, p);   // degenerate input
+        f = f12_mul_sparse(f12_sqr(f), a, b, c);
+        if (*bit == '1') {
+            add_step(t, q, p, a, b, c, &bad);
+            if (bad) return miller_loop_affine(q, p);
+            f = f12_mul_sparse(f, a, b, c);
         }
     }
     return f12_conj(f);        // x < 0
@@ -922,11 +1128,6 @@ static const k2 ISO_YDEN_H[4] = {
 };
 static fp2 ISO_XNUM[4], ISO_XDEN[3], ISO_YNUM[4], ISO_YDEN[4];
 
-// h_eff for the G2 suite (RFC 9380 section 8.8.2): parsed at init from
-// the canonical hex to avoid byte-transcription risk
-static u8 H_EFF_BYTES[80];
-static int H_EFF_LEN;
-
 static int hexval(char c) {
     if (c >= '0' && c <= '9') return c - '0';
     if (c >= 'a' && c <= 'f') return c - 'a' + 10;
@@ -1004,6 +1205,29 @@ static g2a iso3_map(const g2a &p) {
     return r;
 }
 
+// fast cofactor clearing (RFC 9380 Appendix G.3): equivalent to the
+// h_eff multiplication, via Q = [x^2-x-1]P + [x-1]psi(P) + psi^2(2P),
+// with two 64-bit parameter ladders instead of one 636-bit ladder.
+// The whole chain stays Jacobian (one inversion at the very end).
+// Byte-parity with the pure-Python h_eff path is pinned by the tests.
+static g2a g2_clear_cofactor(const g2a &p) {
+    if (p.inf) return p;
+    fp2 one = {FP_ONE_M, FP_ZERO};
+    g2j pj = {p.x, p.y, one};
+    g2j t1 = g2j_mul_by_x(pj);                   // [x]P
+    g2j t2 = g2j_psi(pj);                        // psi(P)
+    g2j t3 = g2j_psi(g2j_psi(g2_dbl(pj)));       // psi^2(2P)
+    t3 = g2j_add(t3, g2j_neg(t2));               // - psi(P)
+    t2 = g2j_add(t1, t2);                        // [x]P + psi(P)
+    t2 = g2j_mul_by_x(t2);                       // [x]([x]P + psi(P))
+    t3 = g2j_add(t3, t2);
+    t3 = g2j_add(t3, g2j_neg(t1));               // - [x]P
+    t3 = g2j_add(t3, g2j_neg(pj));               // - P
+    g2a out;
+    g2_to_affine(out, t3);
+    return out;
+}
+
 static g2a hash_to_g2(const u8 *msg, size_t msglen) {
     u8 uniform[256];
     expand_xmd(uniform, 256, msg, msglen);
@@ -1011,10 +1235,7 @@ static g2a hash_to_g2(const u8 *msg, size_t msglen) {
     fp2 u1 = {fp_from_wide_be(uniform + 128), fp_from_wide_be(uniform + 192)};
     g2a q0 = iso3_map(map_to_curve_sswu(u0));
     g2a q1 = iso3_map(map_to_curve_sswu(u1));
-    g2a s = g2_add_affine(q0, q1);
-    g2a out;
-    g2_to_affine(out, g2_mul_be(s, H_EFF_BYTES, H_EFF_LEN));
-    return out;
+    return g2_clear_cofactor(g2_add_affine(q0, q1));
 }
 
 // --------------------------------------------------- serialization (zcash)
@@ -1111,6 +1332,16 @@ static void bls_init() {
     F12_ONE = {};
     F12_ONE.c0.c0 = {FP_ONE_M, FP_ZERO};
     for (int k = 0; k < 6; k++) G2GAMMA[k] = fp_to_mont(G2GAMMA_CANON[k]);
+    // GAMMA1 = XI^((p-1)/6) for the Frobenius^1 coefficient map
+    u64 e16[6];
+    big_sub_small(t, P.l, 1);
+    big_div_small(e16, t, 6);
+    GAMMA1_POW[0] = {FP_ONE_M, FP_ZERO};
+    GAMMA1_POW[1] = f2_pow(xi, e16, 381);
+    for (int k = 2; k < 6; k++)
+        GAMMA1_POW[k] = f2_mul(GAMMA1_POW[k - 1], GAMMA1_POW[1]);
+    PSI_CX = f2_inv(GAMMA1_POW[2]);
+    PSI_CY = f2_inv(GAMMA1_POW[3]);
     G1_GEN = {fp_to_mont(G1X_CANON), fp_to_mont(G1Y_CANON), false};
     // SSWU constants: A' = 240 u, B' = 1012(1+u), Z = -(2+u)
     fp c240 = fp_to_mont({{240, 0, 0, 0, 0, 0}});
@@ -1123,20 +1354,6 @@ static void bls_init() {
     for (int i = 0; i < 3; i++) ISO_XDEN[i] = f2_from_hex(ISO_XDEN_H[i]);
     for (int i = 0; i < 4; i++) ISO_YNUM[i] = f2_from_hex(ISO_YNUM_H[i]);
     for (int i = 0; i < 4; i++) ISO_YDEN[i] = f2_from_hex(ISO_YDEN_H[i]);
-    // h_eff bytes from the canonical hex (80 bytes, 636 bits)
-    static const char *heff_hex =
-        "bc69f08f2ee75b3584c6a0ea91b352888e2a8e9145ad7689986ff031508ffe13"
-        "29c2f178731db956d82bf015d1212b02ec0ec69d7477c1ae954cbc06689f6a35"
-        "9894c0adebbf6b4e8020005aaa95551";
-    // parse hex into big-endian bytes
-    int n = 0;
-    const char *h = heff_hex;
-    int hl = (int)strlen(h);
-    int off = hl & 1;           // odd-length hex: first byte is one nibble
-    if (off) H_EFF_BYTES[n++] = (u8)hexval(h[0]);
-    for (int i = off; i < hl; i += 2)
-        H_EFF_BYTES[n++] = (u8)((hexval(h[i]) << 4) | hexval(h[i + 1]));
-    H_EFF_LEN = n;
     INIT_DONE = true;
 }
 
