@@ -887,7 +887,9 @@ static fp12 f12_mul_sparse(const fp12 &f, const fp2 &a, const fp2 &b,
     return {f6_add(f0a, f6_mul_v(f1b)), f6_add(f0b, f1a)};
 }
 
-// doubling step: line through T (Jacobian), scaled by 2 Y Z^4
+// doubling step: line through T (Jacobian), scaled by 2 Y Z^4; the
+// point doubling is inlined so the X^2/Y^2/Z^2 squarings are shared
+// with the line coefficients instead of recomputed by g2_dbl
 static void dbl_step(g2j &t, const g1a &p, fp2 &a, fp2 &b, fp2 &c,
                      bool *bad) {
     if (f2_is_zero(t.Z) || f2_is_zero(t.Y)) { *bad = true; return; }
@@ -905,7 +907,18 @@ static void dbl_step(g2j &t, const g1a &p, fp2 &a, fp2 &b, fp2 &c,
     b = f2_mul(f2_mul(t.Z, f2_sub(x3_3, f2_add(Y2, Y2))), XI_INV_M);
     fp2 x2_3 = f2_add(f2_add(X2, X2), X2);
     c = f2_scalar_fp(f2_neg(f2_mul(f2_mul(x2_3, Z3), XI_INV_M)), p.x);
-    t = g2_dbl(t);
+    // doubling with the squares above: C = (Y^2)^2, D = 2((X+Y^2)^2 -
+    // X^2 - C), E = 3X^2, F = E^2 (a=0 Jacobian, as g2_dbl)
+    fp2 C = f2_sqr(Y2);
+    fp2 D = f2_sub(f2_sub(f2_sqr(f2_add(t.X, Y2)), X2), C);
+    D = f2_add(D, D);
+    fp2 F = f2_sqr(x2_3);
+    g2j r;
+    r.X = f2_sub(F, f2_add(D, D));
+    fp2 C8 = f2_add(C, C); C8 = f2_add(C8, C8); C8 = f2_add(C8, C8);
+    r.Y = f2_sub(f2_mul(x2_3, f2_sub(D, r.X)), C8);
+    r.Z = f2_mul(f2_add(t.Y, t.Y), t.Z);
+    t = r;
 }
 
 // addition step: line through T and affine Q, scaled by H Z

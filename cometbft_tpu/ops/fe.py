@@ -117,17 +117,50 @@ for _i in range(NLIMBS):
             _MUL_MSK[_i, _k] = 1
 
 
-def mul(a, b):
-    """Field multiply (loose in, loose out).
-
-    One gather builds the (…,20,39) Toeplitz matrix of b, one int32
-    contraction produces all 39 product columns — 3 XLA ops instead of an
-    unrolled 400-MAC graph, and a shape the TPU backend can tile.
-    """
+def _mul_einsum(a, b):
+    """One gather builds the (…,20,39) Toeplitz matrix of b, one int32
+    contraction produces all 39 product columns — 3 XLA ops instead of
+    an unrolled 400-MAC graph."""
     bmat = b[..., jnp.asarray(_MUL_IDX)] * jnp.asarray(_MUL_MSK)
     cols = jnp.einsum("...i,...ik->...k", a, bmat,
                       preferred_element_type=jnp.int32)
     return _reduce_columns(cols)
+
+
+def _mul_shift(a, b):
+    """Shifted accumulation: 20 statically-sliced partial products into
+    the 39 columns, no (…,20,39) intermediate.  Candidate fix for the
+    measured large-batch HBM cliff (TPU v5e: einsum throughput halves
+    past ~4k lanes because the 32MB-per-mul Toeplitz intermediate falls
+    out of VMEM — docs/bench/r04-notes.md); fully fusable elementwise
+    graph instead."""
+    out = jnp.zeros(a.shape[:-1] + (NCOLS,), jnp.int32)
+    for i in range(NLIMBS):
+        out = out.at[..., i:i + NLIMBS].add(a[..., i:i + 1] * b)
+    return _reduce_columns(out)
+
+
+# Selected at import: the einsum form is the measured default; the shift
+# form is promotable once hardware numbers exist for it (the chip was
+# wedged when it landed — see scripts/kern_layout_probe.py).
+_MUL_IMPL = {"einsum": _mul_einsum, "shift": _mul_shift}
+
+
+def mul(a, b):
+    """Field multiply (loose in, loose out)."""
+    return _mul_active(a, b)
+
+
+import os as _os  # noqa: E402  (grouped with the selection it serves)
+
+_mul_choice = _os.environ.get("COMETBFT_TPU_FE_MUL", "").strip().lower()
+if _mul_choice and _mul_choice not in _MUL_IMPL:
+    # a typo here would silently measure the WRONG kernel during a
+    # scarce hardware window — fail loudly instead
+    raise ValueError(
+        f"COMETBFT_TPU_FE_MUL={_mul_choice!r}: expected one of "
+        f"{sorted(_MUL_IMPL)}")
+_mul_active = _MUL_IMPL.get(_mul_choice, _mul_einsum)
 
 
 def square(a):

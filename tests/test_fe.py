@@ -195,3 +195,22 @@ def test_eq_loose():
     assert eq1.all()
     c = to_limbs_batch([(x + 1) % P for x in xs] * 2)
     assert not np.asarray(j_eq(a, c)).any()
+
+
+def test_mul_shift_matches_einsum():
+    """Both field-multiply implementations (einsum Toeplitz and shifted
+    accumulation) agree on random loose-form operands; the shift form is
+    the candidate fix for the TPU large-batch HBM cliff and must be
+    interchangeable."""
+    rng = np.random.default_rng(77)
+    a = rng.integers(0, fe.LIMB_MAX + 1, (64, 20)).astype(np.int32)
+    b = rng.integers(0, fe.LIMB_MAX + 1, (64, 20)).astype(np.int32)
+    r1 = np.asarray(fe._mul_einsum(a, b))
+    r2 = np.asarray(fe._mul_shift(a, b))
+    for i in range(8):
+        v1 = fe.int_from_limbs(r1[i]) % fe.P_INT
+        v2 = fe.int_from_limbs(r2[i]) % fe.P_INT
+        want = (fe.int_from_limbs(a[i]) * fe.int_from_limbs(b[i])) % fe.P_INT
+        assert v1 == want and v2 == want, i
+    # loose-form bound holds for both
+    assert r1.max() <= fe.LIMB_MAX and r2.max() <= fe.LIMB_MAX
