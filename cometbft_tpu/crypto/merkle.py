@@ -46,6 +46,55 @@ def hash_from_byte_slices(items: list[bytes]) -> bytes:
                       hash_from_byte_slices(items[k:]))
 
 
+_NATIVE_ROOT = None
+
+
+def _native_root_fn():
+    """ctypes binding for the C++ RFC-6962 root (native/kvstore.cpp), or
+    None when the native build is unavailable."""
+    global _NATIVE_ROOT
+    if _NATIVE_ROOT is None:
+        import ctypes
+
+        try:
+            from ..native import lib_path
+
+            lib = ctypes.CDLL(lib_path("kvstore"))
+            lib.kv_merkle_root.restype = None
+            lib.kv_merkle_root.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64, ctypes.c_char_p]
+            _NATIVE_ROOT = (lib,)
+        except Exception:
+            _NATIVE_ROOT = ()
+    return _NATIVE_ROOT[0] if _NATIVE_ROOT else None
+
+
+def hash_from_byte_slices_fast(items: list[bytes]) -> bytes:
+    """Root-only merkle hash through the native tree when available —
+    identical output to :func:`hash_from_byte_slices` (pinned by tests),
+    ~30x faster on big leaf sets (the builtin kvstore's per-block app
+    hash was the hottest function in the e2e throughput profile)."""
+    if len(items) < 64:        # BEFORE lib resolution: small callers must
+        # not pay the one-time native build/load on first use
+        return hash_from_byte_slices(items)
+    lib = _native_root_fn()
+    if lib is None:
+        return hash_from_byte_slices(items)
+    import ctypes
+
+    buf = b"".join(items)
+    offs = (ctypes.c_uint64 * (len(items) + 1))()
+    pos = 0
+    for i, it in enumerate(items):
+        offs[i] = pos
+        pos += len(it)
+    offs[len(items)] = pos
+    out = ctypes.create_string_buffer(32)
+    lib.kv_merkle_root(buf, offs, len(items), out)
+    return out.raw
+
+
 @dataclass
 class Proof:
     """Merkle inclusion proof (crypto/merkle/proof.go semantics)."""

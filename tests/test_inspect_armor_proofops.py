@@ -167,3 +167,39 @@ def test_inspect_serves_chain_data_from_dead_node_dir(tmp_path):
         return True
 
     assert run(main())
+
+
+def test_kvstore_proof_cache_invalidated_on_value_change():
+    """A proven query after a same-key value update must prove the NEW
+    value against the NEW app hash: the proof cache is invalidated on
+    every state mutation (it used to be keyed only on key PRESENCE, so a
+    changed value could in principle have served a stale proof)."""
+    import asyncio
+
+    from cometbft_tpu.abci import types as t
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+    async def main():
+        app = KVStoreApplication()
+
+        async def commit_kv(height, k, v):
+            await app.finalize_block(t.FinalizeBlockRequest(
+                txs=[k + b"=" + v], height=height, time_ns=0))
+
+        await commit_kv(1, b"alpha", b"one")
+        r1 = await app.query("", b"alpha", 0, True)
+        op1 = ProofOperators.decode([ProofOp(**r1.proof_ops[0])])
+        op1.verify(app.app_hash, [b"alpha"], b"one")
+        hash1 = app.app_hash
+
+        await commit_kv(2, b"alpha", b"two")     # same key, new value
+        assert app.app_hash != hash1
+        r2 = await app.query("", b"alpha", 0, True)
+        assert r2.value == b"two"
+        op2 = ProofOperators.decode([ProofOp(**r2.proof_ops[0])])
+        op2.verify(app.app_hash, [b"alpha"], b"two")
+        with pytest.raises(ProofOpError):       # stale proof must fail
+            op1.verify(app.app_hash, [b"alpha"], b"one")
+        return True
+
+    assert asyncio.run(main())

@@ -154,3 +154,36 @@ def run(coro):
         return loop.run_until_complete(coro)
     finally:
         loop.close()
+
+
+def test_native_merkle_root_matches_python():
+    """The C++ RFC-6962 root (kv_merkle_root) is byte-identical to the
+    Python tree across sizes, including the power-of-two split edges."""
+    import hashlib
+
+    from cometbft_tpu.crypto import merkle
+
+    lib = merkle._native_root_fn()
+    assert lib is not None, "native kvstore lib should build on this image"
+    import ctypes
+
+    def native_root(items):
+        buf = b"".join(items)
+        offs = (ctypes.c_uint64 * (len(items) + 1))()
+        pos = 0
+        for i, it in enumerate(items):
+            offs[i] = pos
+            pos += len(it)
+        offs[len(items)] = pos
+        out = ctypes.create_string_buffer(32)
+        lib.kv_merkle_root(buf, offs, len(items), out)
+        return out.raw
+
+    for n in (0, 1, 2, 3, 63, 64, 65, 200, 1000):
+        items = [hashlib.sha256(b"%d" % i).digest()[: (i % 40) + 1]
+                 for i in range(n)]
+        assert native_root(items) == merkle.hash_from_byte_slices(items), n
+    # and the dispatching wrapper agrees with the pure tree
+    big = [b"leaf-%d" % i for i in range(500)]
+    assert merkle.hash_from_byte_slices_fast(big) == \
+        merkle.hash_from_byte_slices(big)
