@@ -347,6 +347,9 @@ def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
         try:
             import asyncio
 
+            conns = int(os.environ.get("BENCH_NODE_CONNS", "8"))
+            batch = int(os.environ.get("BENCH_NODE_BATCH", "4"))
+
             async def drive():
                 cli = HTTPClient("127.0.0.1", rpc_port)
                 for _ in range(120):           # wait for RPC
@@ -367,8 +370,6 @@ def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
                 else:
                     raise RuntimeError(
                         "bench node RPC never came up (see node.log)")
-                conns = int(os.environ.get("BENCH_NODE_CONNS", "8"))
-                batch = int(os.environ.get("BENCH_NODE_BATCH", "4"))
                 note(f"driving {rate:.0f} tx/s for {duration_s:.0f}s "
                      f"({tx_size}B txs, {conns} connections, "
                      f"batch {batch})")
@@ -409,6 +410,8 @@ def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
             "p50_latency_s": rep.get("p50_s"),
             "p99_latency_s": rep.get("p99_s"),
             "blocks": rep.get("blocks"),
+            "load_connections": conns,
+            "load_batch": batch,
             "backend": "cpu",
         }), flush=True)
     finally:

@@ -26,6 +26,7 @@ VALSET_PREFIX = b"val:"
 class KVStoreApplication(Application):
     def __init__(self):
         self.state: dict[bytes, bytes] = {}
+        self._leaves: dict[bytes, bytes] = {}   # key -> kv_leaf, lazily
         self.height = 0
         self.app_hash = self._compute_app_hash()
         self.validators: dict[bytes, int] = {}     # pubkey bytes -> power
@@ -92,6 +93,7 @@ class KVStoreApplication(Application):
             d = json.loads(req.app_state_bytes)
             self.state = {str(k).encode(): str(v).encode()
                           for k, v in d.items()}
+            self._leaves.clear()
         self.app_hash = self._compute_app_hash()
         return t.InitChainResponse(app_hash=self.app_hash)
 
@@ -125,6 +127,7 @@ class KVStoreApplication(Application):
             else:
                 _, k, v = parsed
                 self.state[k] = v
+                self._leaves.pop(k, None)   # leaf recomputed at hash time
                 results.append(t.ExecTxResult(
                     gas_used=1,
                     events=[t.Event("app", [
@@ -177,12 +180,19 @@ class KVStoreApplication(Application):
         in :meth:`_ensure_proof_cache` and invalidated on mutation).
         The reference kvstore's app hash is just the store size
         (``abci/example/kvstore/kvstore.go:556``); this one keeps the
-        provable-query extension without paying for it per block."""
+        provable-query extension without paying for it per block.
+
+        Leaf bytes are cached per key (``_leaves``; writers invalidate
+        the touched key): each block re-hashes the tree but not the
+        untouched leaves' value digests."""
         from ..crypto.merkle import hash_from_byte_slices_fast, kv_leaf
 
         self._proof_cache = None           # state changed: proofs stale
+        leaves = self._leaves
         return hash_from_byte_slices_fast(
-            [kv_leaf(k, self.state[k]) for k in sorted(self.state)])
+            [leaves.get(k) or
+             leaves.setdefault(k, kv_leaf(k, self.state[k]))
+             for k in sorted(self.state)])
 
     def _ensure_proof_cache(self):
         """Build (lazily) the per-key inclusion proofs for proven
@@ -239,6 +249,7 @@ class KVStoreApplication(Application):
                 return t.APPLY_CHUNK_RETRY
             d = msgpack.unpackb(raw, raw=False)
             self.state = dict(d["state"])
+            self._leaves.clear()
             self.validators = dict(d["vals"])
             self.height = d["height"]
             self.app_hash = self._compute_app_hash()
