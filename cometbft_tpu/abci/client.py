@@ -151,13 +151,26 @@ class LocalClient(ABCIClient):
 
 # ------------------------------------------------------------ socket client
 
+# per-type field-name cache: dataclasses.fields() reflection per VALUE
+# made this encoder ~10% of a loaded node's core (every block's
+# FinalizeBlockResponse is persisted through it); False = not a dataclass
+_DC_FIELDS: dict[type, tuple | bool] = {}
+
+
 def _encode_value(v):
     """Shallow per-level dataclass encoding so nested dataclasses keep their
     own __dc__ tags (asdict would flatten them into anonymous dicts)."""
-    if is_dataclass(v) and not isinstance(v, type):
-        return {"__dc__": type(v).__name__,
-                **{f.name: _encode_value(getattr(v, f.name))
-                   for f in fields(v)}}
+    t = type(v)
+    names = _DC_FIELDS.get(t)
+    if names is None:
+        names = tuple(f.name for f in fields(v)) \
+            if is_dataclass(v) and not isinstance(v, type) else False
+        _DC_FIELDS[t] = names
+    if names is not False:
+        out = {"__dc__": t.__name__}
+        for n in names:
+            out[n] = _encode_value(getattr(v, n))
+        return out
     if isinstance(v, (list, tuple)):
         return [_encode_value(x) for x in v]
     if isinstance(v, dict):

@@ -192,7 +192,7 @@ class FinalizeBlockResponse:
     def results_hash(self) -> bytes:
         from ..crypto import merkle
 
-        return merkle.hash_from_byte_slices(
+        return merkle.hash_from_byte_slices_fast(
             [r.encode() for r in self.tx_results])
 
 

@@ -355,9 +355,12 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
     import numpy as np
 
     done = 0
-    pre_keys = set(_VALSET_TABLES)   # drop only warmup-created entries:
-    # a REAL commit can populate the cache concurrently (warmup runs in
-    # an executor while the node syncs) and must not lose its tables
+    # Cleanup must drop only the tables built from warmup's OWN fake
+    # valset matrices: a REAL commit can populate the cache concurrently
+    # (warmup runs in an executor while the node syncs) and must not
+    # lose its tables.  Entries are matched by the identity of the pubs
+    # array they were built from — warmup keeps every matrix it passed.
+    warm_arrays: list = []
     try:
         for lanes in lane_buckets:
             for nb in block_buckets:
@@ -369,6 +372,7 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                 msgs = np.zeros((lanes, msg_len), np.uint8)
                 lens = np.full((lanes,), msg_len, np.int64)
                 scope = np.zeros((lanes,), np.int64)
+                warm_arrays.append(pubs)
                 try:
                     _device_verify_chunk(pubs, rs, ss, msgs, lens, device)
                     device_verify_ed25519_cached(pubs, scope, pubs, rs, ss,
@@ -384,6 +388,7 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                 msgs = np.zeros((n_vals, msg_len), np.uint8)
                 lens = np.full((n_vals,), msg_len, np.int64)
                 scope = np.zeros((n_vals,), np.int64)
+                warm_arrays.append(valset)
                 try:
                     # drives the real dispatch: one table build at the
                     # n_vals TABLE bucket + every chunked gather shape
@@ -394,8 +399,10 @@ def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
                 except Exception:
                     return done
     finally:
-        for k in [k for k in _VALSET_TABLES if k not in pre_keys]:
-            _VALSET_TABLES.pop(k, None)   # warmup matrices aren't real
+        for k in list(_VALSET_TABLES):    # snapshot: concurrent inserts
+            ent = _VALSET_TABLES.get(k)
+            if ent is not None and any(ent[0] is a for a in warm_arrays):
+                _VALSET_TABLES.pop(k, None)   # warmup matrices aren't real
     return done
 
 

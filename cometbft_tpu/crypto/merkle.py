@@ -83,15 +83,18 @@ def hash_from_byte_slices_fast(items: list[bytes]) -> bytes:
         return hash_from_byte_slices(items)
     import ctypes
 
+    import numpy as np
+
     buf = b"".join(items)
-    offs = (ctypes.c_uint64 * (len(items) + 1))()
-    pos = 0
-    for i, it in enumerate(items):
-        offs[i] = pos
-        pos += len(it)
-    offs[len(items)] = pos
+    # prefix offsets via numpy: a Python accumulation loop here was
+    # ~5x the native tree's own cost at 20k leaves
+    offs = np.zeros(len(items) + 1, np.uint64)
+    np.cumsum(np.fromiter(map(len, items), np.uint64, len(items)),
+              out=offs[1:])
     out = ctypes.create_string_buffer(32)
-    lib.kv_merkle_root(buf, offs, len(items), out)
+    lib.kv_merkle_root(buf,
+                       offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                       len(items), out)
     return out.raw
 
 

@@ -52,8 +52,16 @@ def lib_path(name: str = "kvstore") -> str:
     host CPU changed.  Concurrent callers serialize on an advisory lock
     so two processes can't interleave writes to the same .so."""
     src = os.path.join(_DIR, f"{name}.cpp")
+    h = hashlib.sha256()
     with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16] + "-" + _host_id()
+        h.update(f.read())
+    # local headers are part of every unit's build input: an edit to a
+    # shared .h must rebuild the libraries that include it
+    for hdr in sorted(os.listdir(_DIR)):
+        if hdr.endswith(".h"):
+            with open(os.path.join(_DIR, hdr), "rb") as f:
+                h.update(f.read())
+    digest = h.hexdigest()[:16] + "-" + _host_id()
     out = os.path.join(_DIR, f"_lib{name}.so")
     stamp = out + ".hash"
 

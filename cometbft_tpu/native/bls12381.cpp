@@ -959,87 +959,7 @@ static fp12 miller_loop(const g2a &q, const g1a &p) {
     return f12_conj(f);        // x < 0
 }
 
-// ------------------------------------------------------------- SHA-256
-
-struct sha256_ctx { uint32_t h[8]; u8 buf[64]; u64 len; };
-
-static const uint32_t SHA_K[64] = {
-    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
-    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
-    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
-    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
-    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
-    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
-    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
-    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
-    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
-    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
-
-static inline uint32_t ror(uint32_t x, int n) {
-    return (x >> n) | (x << (32 - n));
-}
-
-static void sha_compress(uint32_t h[8], const u8 blk[64]) {
-    uint32_t w[64];
-    for (int i = 0; i < 16; i++)
-        w[i] = (uint32_t)blk[4 * i] << 24 | (uint32_t)blk[4 * i + 1] << 16 |
-               (uint32_t)blk[4 * i + 2] << 8 | blk[4 * i + 3];
-    for (int i = 16; i < 64; i++) {
-        uint32_t s0 = ror(w[i - 15], 7) ^ ror(w[i - 15], 18) ^ (w[i - 15] >> 3);
-        uint32_t s1 = ror(w[i - 2], 17) ^ ror(w[i - 2], 19) ^ (w[i - 2] >> 10);
-        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
-    }
-    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
-             g = h[6], hh = h[7];
-    for (int i = 0; i < 64; i++) {
-        uint32_t S1 = ror(e, 6) ^ ror(e, 11) ^ ror(e, 25);
-        uint32_t ch = (e & f) ^ (~e & g);
-        uint32_t t1 = hh + S1 + ch + SHA_K[i] + w[i];
-        uint32_t S0 = ror(a, 2) ^ ror(a, 13) ^ ror(a, 22);
-        uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
-        uint32_t t2 = S0 + mj;
-        hh = g; g = f; f = e; e = d + t1;
-        d = c; c = b; b = a; a = t1 + t2;
-    }
-    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
-    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
-}
-
-static void sha_init(sha256_ctx &c) {
-    static const uint32_t iv[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
-                                   0xa54ff53a, 0x510e527f, 0x9b05688c,
-                                   0x1f83d9ab, 0x5be0cd19};
-    memcpy(c.h, iv, sizeof iv);
-    c.len = 0;
-}
-
-static void sha_update(sha256_ctx &c, const u8 *d, size_t n) {
-    size_t fill = c.len % 64;
-    c.len += n;
-    if (fill) {
-        size_t take = 64 - fill < n ? 64 - fill : n;
-        memcpy(c.buf + fill, d, take);
-        d += take; n -= take;
-        if (fill + take == 64) sha_compress(c.h, c.buf);
-        else return;
-    }
-    while (n >= 64) { sha_compress(c.h, d); d += 64; n -= 64; }
-    if (n) memcpy(c.buf, d, n);
-}
-
-static void sha_final(sha256_ctx &c, u8 out[32]) {
-    u64 bits = c.len * 8;
-    u8 pad[72] = {0x80};
-    size_t padlen = (c.len % 64 < 56) ? 56 - c.len % 64 : 120 - c.len % 64;
-    u8 lenb[8];
-    for (int i = 0; i < 8; i++) lenb[i] = (u8)(bits >> (56 - 8 * i));
-    sha_update(c, pad, padlen);
-    sha_update(c, lenb, 8);
-    for (int i = 0; i < 8; i++)
-        for (int j = 0; j < 4; j++)
-            out[4 * i + j] = (u8)(c.h[i] >> (24 - 8 * j));
-}
+#include "sha256_inline.h"
 
 // --------------------------------------------------- hash to G2 (RFC 9380)
 
@@ -1053,21 +973,21 @@ static void expand_xmd(u8 *out, int outlen, const u8 *msg, size_t msglen) {
     u8 dst_prime[DST_LEN + 1];
     memcpy(dst_prime, DST, DST_LEN);
     dst_prime[DST_LEN] = DST_LEN;
-    sha256_ctx c;
-    sha_init(c);
+    sha256i::ctx c;
+    sha256i::init(c);
     u8 zpad[64] = {0};
-    sha_update(c, zpad, 64);
-    sha_update(c, msg, msglen);
+    sha256i::update(c, zpad, 64);
+    sha256i::update(c, msg, msglen);
     u8 lib[3] = {(u8)(outlen >> 8), (u8)outlen, 0};
-    sha_update(c, lib, 3);
-    sha_update(c, dst_prime, DST_LEN + 1);
-    sha_final(c, b0);
-    sha_init(c);
-    sha_update(c, b0, 32);
+    sha256i::update(c, lib, 3);
+    sha256i::update(c, dst_prime, DST_LEN + 1);
+    sha256i::final(c, b0);
+    sha256i::init(c);
+    sha256i::update(c, b0, 32);
     u8 one = 1;
-    sha_update(c, &one, 1);
-    sha_update(c, dst_prime, DST_LEN + 1);
-    sha_final(c, bi);
+    sha256i::update(c, &one, 1);
+    sha256i::update(c, dst_prime, DST_LEN + 1);
+    sha256i::final(c, bi);
     int off = 0;
     for (int i = 2;; i++) {
         int take = outlen - off < 32 ? outlen - off : 32;
@@ -1076,12 +996,12 @@ static void expand_xmd(u8 *out, int outlen, const u8 *msg, size_t msglen) {
         if (off >= outlen) break;
         u8 x[32];
         for (int j = 0; j < 32; j++) x[j] = b0[j] ^ bi[j];
-        sha_init(c);
-        sha_update(c, x, 32);
+        sha256i::init(c);
+        sha256i::update(c, x, 32);
         u8 ib = (u8)i;
-        sha_update(c, &ib, 1);
-        sha_update(c, dst_prime, DST_LEN + 1);
-        sha_final(c, bi);
+        sha256i::update(c, &ib, 1);
+        sha256i::update(c, dst_prime, DST_LEN + 1);
+        sha256i::final(c, bi);
     }
 }
 

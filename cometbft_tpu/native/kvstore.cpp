@@ -301,84 +301,7 @@ uint64_t kv_size(void* h) { return (uint64_t)((Store*)h)->data.size(); }
 
 typedef unsigned long long u64k;
 
-static const uint32_t SHA256_K[64] = {
-    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
-    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
-    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
-    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
-    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
-    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
-    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
-    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
-    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
-    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
-
-static inline uint32_t rotr32(uint32_t x, int n) {
-    return (x >> n) | (x << (32 - n));
-}
-
-static void sha256_compress(uint32_t h[8], const uint8_t blk[64]) {
-    uint32_t w[64];
-    for (int i = 0; i < 16; i++)
-        w[i] = (uint32_t)blk[4 * i] << 24 | (uint32_t)blk[4 * i + 1] << 16 |
-               (uint32_t)blk[4 * i + 2] << 8 | blk[4 * i + 3];
-    for (int i = 16; i < 64; i++) {
-        uint32_t s0 = rotr32(w[i - 15], 7) ^ rotr32(w[i - 15], 18) ^
-                      (w[i - 15] >> 3);
-        uint32_t s1 = rotr32(w[i - 2], 17) ^ rotr32(w[i - 2], 19) ^
-                      (w[i - 2] >> 10);
-        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
-    }
-    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
-             g = h[6], hh = h[7];
-    for (int i = 0; i < 64; i++) {
-        uint32_t S1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
-        uint32_t ch = (e & f) ^ (~e & g);
-        uint32_t t1 = hh + S1 + ch + SHA256_K[i] + w[i];
-        uint32_t S0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
-        uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
-        hh = g; g = f; f = e; e = d + t1;
-        d = c; c = b; b = a; a = t1 + S0 + mj;
-    }
-    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
-    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
-}
-
-static void sha256_oneshot(const uint8_t *d1, u64k n1, const uint8_t *d2,
-                           u64k n2, const uint8_t *d3, u64k n3,
-                           uint8_t out[32]) {
-    // 3-part message, streamed through the compressor
-    uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
-                     0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
-    uint8_t buf[64];
-    u64k fill = 0, total = n1 + n2 + n3;
-    const uint8_t *parts[3] = {d1, d2, d3};
-    u64k lens[3] = {n1, n2, n3};
-    for (int p = 0; p < 3; p++) {
-        const uint8_t *d = parts[p];
-        u64k n = lens[p];
-        while (n) {
-            u64k take = 64 - fill < n ? 64 - fill : n;
-            for (u64k i = 0; i < take; i++) buf[fill + i] = d[i];
-            fill += take; d += take; n -= take;
-            if (fill == 64) { sha256_compress(h, buf); fill = 0; }
-        }
-    }
-    buf[fill++] = 0x80;
-    if (fill > 56) {
-        while (fill < 64) buf[fill++] = 0;
-        sha256_compress(h, buf);
-        fill = 0;
-    }
-    while (fill < 56) buf[fill++] = 0;
-    u64k bits = total * 8;
-    for (int i = 0; i < 8; i++) buf[56 + i] = (uint8_t)(bits >> (56 - 8 * i));
-    sha256_compress(h, buf);
-    for (int i = 0; i < 8; i++)
-        for (int j = 0; j < 4; j++)
-            out[4 * i + j] = (uint8_t)(h[i] >> (24 - 8 * j));
-}
+#include "sha256_inline.h"
 
 static u64k split_point(u64k n) {
     u64k k = 1;
@@ -391,15 +314,15 @@ static void merkle_node(const uint8_t *buf, const u64k *offs, u64k lo,
     static const uint8_t LEAF = 0x00, INNER = 0x01;
     u64k n = hi - lo;
     if (n == 1) {
-        sha256_oneshot(&LEAF, 1, buf + offs[lo], offs[lo + 1] - offs[lo],
-                       nullptr, 0, out);
+        sha256i::oneshot3(&LEAF, 1, buf + offs[lo],
+                          offs[lo + 1] - offs[lo], nullptr, 0, out);
         return;
     }
     u64k k = split_point(n);
     uint8_t l[32], r[32];
     merkle_node(buf, offs, lo, lo + k, l);
     merkle_node(buf, offs, lo + k, hi, r);
-    sha256_oneshot(&INNER, 1, l, 32, r, 32, out);
+    sha256i::oneshot3(&INNER, 1, l, 32, r, 32, out);
 }
 
 extern "C" {
@@ -409,7 +332,7 @@ extern "C" {
 void kv_merkle_root(const uint8_t *buf, const u64k *offs, u64k n,
                     uint8_t *out32) {
     if (n == 0) {
-        sha256_oneshot(nullptr, 0, nullptr, 0, nullptr, 0, out32);
+        sha256i::oneshot(nullptr, 0, out32);
         return;
     }
     merkle_node(buf, offs, 0, n, out32);

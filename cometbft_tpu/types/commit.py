@@ -185,7 +185,7 @@ class Commit:
                     validator_index=idx, signature=cs.signature)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
+        return merkle.hash_from_byte_slices_fast(
             [cs.encode() for cs in self.signatures])
 
     def validate_basic(self) -> str | None:

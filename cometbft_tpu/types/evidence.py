@@ -149,4 +149,4 @@ class LightClientAttackEvidence(Evidence):
 def evidence_list_hash(evidence: list[Evidence]) -> bytes:
     from ..crypto import merkle
 
-    return merkle.hash_from_byte_slices([e.hash() for e in evidence])
+    return merkle.hash_from_byte_slices_fast([e.hash() for e in evidence])

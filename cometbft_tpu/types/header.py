@@ -115,7 +115,8 @@ class Data:
     txs: list[bytes] = field(default_factory=list)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([tx_hash(t) for t in self.txs])
+        return merkle.hash_from_byte_slices_fast(
+            [tx_hash(t) for t in self.txs])
 
 
 @dataclass
@@ -133,7 +134,7 @@ class Block:
         self.header.data_hash = self.data.hash()
         if self.last_commit is not None:
             self.header.last_commit_hash = self.last_commit.hash()
-        self.header.evidence_hash = merkle.hash_from_byte_slices(
+        self.header.evidence_hash = merkle.hash_from_byte_slices_fast(
             [e.hash() for e in self.evidence])
 
     def validate_basic(self) -> str | None:

@@ -155,7 +155,7 @@ class ValidatorSet:
     # ------------------------------------------------------------- hashing
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
+        return merkle.hash_from_byte_slices_fast(
             [v.simple_encode() for v in self.validators])
 
     # ------------------------------------------------- proposer rotation

@@ -27,6 +27,12 @@ STAMP=$(date -u +%Y-%m-%dT%H:%M)
 # bench.py pick (the cpu attempt), so the rehearsal measures something.
 DEFAULT_BACKEND=tpu
 if [ "${FORCE:-}" = "1" ]; then
+    if [ "$TAG" = tpu ]; then
+        # a rehearsal must never write cpu measurements into the
+        # canonical r*-<mode>-tpu.json artifacts
+        echo "FORCE=1 requires a custom TAG (e.g. TAG=cputest)" >&2
+        exit 3
+    fi
     echo "FORCE=1: skipping probe gate (artifacts tagged -$TAG)"
     DEFAULT_BACKEND=
 elif timeout 60 python -c 'import jax; assert any(d.platform != "cpu" for d in jax.devices())' 2>/dev/null; then

@@ -108,6 +108,11 @@ def test_node_runs_on_native_backend(tmp_path):
         c.p2p.laddr = "tcp://127.0.0.1:0"
         c.rpc.laddr = "tcp://127.0.0.1:0"
         c.storage.db_backend = "native"
+        # this test exercises the DB backend, not device warmup — a
+        # warmup compile left running on the device-owner thread makes
+        # LATER tests' dispatches silently host-fallback (the bounded
+        # wait sees an in-flight future)
+        c.base.device_warmup = False
         return c
 
     async def main():
