@@ -481,6 +481,42 @@ static const u64 HARD_DIG[4][6] = {
     {0x8c00aaab0000aaaaull, 0x396c8c005555e156ull, 0, 0, 0, 0},
 };
 
+// Granger-Scott cyclotomic squaring: after the easy part the element
+// lies in the cyclotomic subgroup, where w-basis coefficients (g0..g5,
+// fp4 pairs (g0,g3),(g1,g4),(g2,g5) over s = w^3, s^2 = XI) square as
+//   h0 = 3 A0 - 2 g0   h3 = 3 A1 + 2 g3      (A = (g0+g3 s)^2)
+//   h2 = 3 B0 - 2 g2   h5 = 3 B1 + 2 g5      (B = (g1+g4 s)^2)
+//   h4 = 3 C0 - 2 g4   h1 = 3 XI C1 + 2 g1   (C = (g2+g5 s)^2)
+// — 3 fp4 squarings instead of a full f12 multiply (~2.6x cheaper).
+// The coefficient pattern was solved and uniquely pinned against this
+// file's own tower by exhaustive check on random cyclotomic elements
+// (and every verify exercises it end to end against the Python oracle).
+static inline void fp4_sq(const fp2 &a, const fp2 &b, fp2 &r0, fp2 &r1) {
+    r0 = f2_add(f2_sqr(a), mul_xi(f2_sqr(b)));
+    fp2 ab = f2_mul(a, b);
+    r1 = f2_add(ab, ab);
+}
+
+static fp12 f12_cyclo_sqr(const fp12 &g) {
+    // w-basis: g0=c0.c0 g1=c1.c0 g2=c0.c1 g3=c1.c1 g4=c0.c2 g5=c1.c2
+    const fp2 &g0 = g.c0.c0, &g1 = g.c1.c0, &g2 = g.c0.c1,
+              &g3 = g.c1.c1, &g4 = g.c0.c2, &g5 = g.c1.c2;
+    fp2 A0, A1, B0, B1, C0, C1;
+    fp4_sq(g0, g3, A0, A1);
+    fp4_sq(g1, g4, B0, B1);
+    fp4_sq(g2, g5, C0, C1);
+    auto three = [](const fp2 &x) { return f2_add(f2_add(x, x), x); };
+    auto two = [](const fp2 &x) { return f2_add(x, x); };
+    fp12 h;
+    h.c0.c0 = f2_sub(three(A0), two(g0));
+    h.c1.c1 = f2_add(three(A1), two(g3));
+    h.c0.c1 = f2_sub(three(B0), two(g2));
+    h.c1.c2 = f2_add(three(B1), two(g5));
+    h.c0.c2 = f2_sub(three(C0), two(g4));
+    h.c1.c0 = f2_add(three(mul_xi(C1)), two(g1));
+    return h;
+}
+
 static fp12 final_exponentiation(const fp12 &f) {
     fp12 g = f12_mul(f12_conj(f), f12_inv(f));     // f^(p^6 - 1)
     g = f12_mul(f12_frob2(g), g);                  // ^(p^2 + 1)
@@ -496,7 +532,9 @@ static fp12 final_exponentiation(const fp12 &f) {
     }
     fp12 acc = F12_ONE;
     for (int i = 380; i >= 0; i--) {
-        acc = f12_sqr(acc);
+        acc = f12_cyclo_sqr(acc);   // acc stays in the cyclotomic
+        // subgroup: it starts at one and only ever multiplies subgroup
+        // elements (frobenius images and products of g)
         int m = 0;
         for (int d = 0; d < 4; d++)
             m |= (int)((HARD_DIG[d][i >> 6] >> (i & 63)) & 1) << d;

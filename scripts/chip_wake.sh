@@ -12,7 +12,8 @@
 #                                   # CPU rehearsal: skip probe gate, tag
 #                                   # artifacts, run a subset of modes
 #
-# Exit codes: 0 = sweep complete, 2 = chip still wedged (logged).
+# Exit codes: 0 = sweep complete, 2 = chip still wedged (logged),
+# 3 = FORCE=1 rehearsal attempted under the canonical tpu TAG.
 set -u
 cd "$(dirname "$0")/.."
 ROUND="${ROUND:-04}"

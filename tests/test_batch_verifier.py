@@ -244,11 +244,9 @@ def _drain_device_worker():
 
     fut = B._DEVICE_INFLIGHT
     if fut is not None and not fut.done():
-        import concurrent.futures
-
         try:
             fut.result(timeout=600)
-        except (concurrent.futures.TimeoutError, Exception):
+        except Exception:      # any outcome is fine — it just must END
             pass
 
 
