@@ -79,6 +79,26 @@ run_mode blocksync    BENCH_BLOCKS=500 BENCH_VALS=1000
 run_mode stress       BENCH_VALS=10000 BENCH_SECP_PCT=10
 run_mode node         BENCH_RATE=2000 BENCH_DURATION=20
 
+# Kernel-layout experiments (fe.mul shifted-accumulation, limb-major
+# layout, batch scaling) — the measurements the wedged chip has owed
+# since the first alive window; results feed the next fe.mul default.
+case " $MODES " in (*" kernlayout "*|*" commit "*)
+    klout="docs/bench/r${ROUND}-kernlayout-${TAG}.txt"
+    echo "--- kernel layout probe -> $klout"
+    # tpu-tagged artifacts must hold tpu measurements (the probe asserts
+    # the platform), and a failed run must not clobber a committed one
+    kreq=1; [ "$TAG" != tpu ] && kreq=
+    if env KERNLAYOUT_REQUIRE_TPU="$kreq" timeout 1800 \
+         python scripts/kern_layout_probe.py > "$klout.tmp" 2>&1; then
+        mv "$klout.tmp" "$klout"
+        tail -6 "$klout"
+        git add "$klout"
+    else
+        echo "kernel layout probe FAILED (non-fatal):"; tail -3 "$klout.tmp"
+        rm -f "$klout.tmp"
+    fi
+;; esac
+
 echo "--- dryrun_multichip(8)"
 if timeout 900 python -c '
 import __graft_entry__ as g

@@ -1,10 +1,20 @@
-import sys, time
-sys.path.insert(0, __import__("os").path.join(__import__("os").path.dirname(__file__), ".."))
+import os, sys, time
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # CPU rehearsal on a box with a wedged relay: plain `import jax`
+    # hangs in accelerator discovery unless the factories are dropped
+    from cometbft_tpu.jaxenv import harden_cpu_pinned_env
+
+    harden_cpu_pinned_env()
 import numpy as np
 import jax, jax.numpy as jnp
 from cometbft_tpu.ops import fe
 
 print("device:", jax.devices()[0])
+if os.environ.get("KERNLAYOUT_REQUIRE_TPU"):
+    # a tpu-tagged artifact must never hold silent-CPU-fallback numbers
+    assert jax.devices()[0].platform != "cpu", \
+        "KERNLAYOUT_REQUIRE_TPU set but jax fell back to CPU"
 B = 10240
 rng = np.random.default_rng(7)
 an = rng.integers(0, 8191, (B, 20), dtype=np.int32)
