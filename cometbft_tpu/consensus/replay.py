@@ -47,13 +47,6 @@ class Handshaker:
         if state.last_block_height == 0 and app_height == 0:
             state = await self._init_chain(state, app_conns)
 
-        # crash between SaveBlock and ApplyBlock: finish applying
-        if store_height == state.last_block_height + 1 and store_height > 0:
-            block = self.block_store.load_block(store_height)
-            meta = self.block_store.load_block_meta(store_height)
-            state = await executor.apply_block(state, meta.block_id, block)
-            self.state_store.save(state)
-
         if app_height > state.last_block_height:
             raise HandshakeError(
                 f"app height {app_height} ahead of state "
@@ -72,11 +65,50 @@ class Handshaker:
                 syncing_to_height=state.last_block_height)
             resp = await app_conns.consensus.finalize_block(req)
             await app_conns.consensus.commit()
+            # Pinpoint divergence at the FIRST height whose replayed app
+            # hash disagrees with the stored per-height ABCI response,
+            # not just at the tip — an app-hash mismatch was observed
+            # once as a contention-timed flake (docs/r04-report.md), and
+            # "which height first diverged" is the fact a post-mortem
+            # needs to separate original-run misbehavior from replay
+            # misbehavior.
+            stored_hash = None
+            try:
+                from ..sm.execution import unpack_finalize_response
+
+                raw = self.state_store.load_finalize_block_response(h)
+                if raw is not None:
+                    stored_hash = unpack_finalize_response(raw).app_hash
+            except Exception:
+                pass
+            if stored_hash is not None and resp.app_hash != stored_hash:
+                raise HandshakeError(
+                    f"app hash mismatch after replay at {h} (first "
+                    f"divergent height; replaying {app_height + 1}.."
+                    f"{state.last_block_height}): replayed "
+                    f"{resp.app_hash.hex()} != stored {stored_hash.hex()} "
+                    f"({len(block.data.txs)} txs at {h})")
             if h == state.last_block_height and \
                     resp.app_hash != state.app_hash:
                 raise HandshakeError(
                     f"app hash mismatch after replay at {h}: "
-                    f"{resp.app_hash.hex()} != {state.app_hash.hex()}")
+                    f"replayed {resp.app_hash.hex()} != stored "
+                    f"{state.app_hash.hex()} (app replayed from "
+                    f"{app_height + 1})")
+
+        # Crash between SaveBlock and ApplyBlock: finish applying the
+        # pending block — AFTER the catch-up replay above, so the app
+        # has seen every earlier block exactly once.  The previous
+        # ordering (recovery first) both fed the pending block to an app
+        # that could still be missing earlier blocks AND re-finalized it
+        # in the replay loop (the loop's app_height predates the
+        # recovery apply) — a double-execution that idempotent apps mask
+        # but stateful ones must never see.
+        if store_height == state.last_block_height + 1 and store_height > 0:
+            block = self.block_store.load_block(store_height)
+            meta = self.block_store.load_block_meta(store_height)
+            state = await executor.apply_block(state, meta.block_id, block)
+            self.state_store.save(state)
         return state
 
     async def _init_chain(self, state: State, app_conns: AppConns) -> State:

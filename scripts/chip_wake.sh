@@ -87,8 +87,13 @@ case " $MODES " in (*" kernlayout "*|*" commit "*)
     echo "--- kernel layout probe -> $klout"
     # tpu-tagged artifacts must hold tpu measurements (the probe asserts
     # the platform), and a failed run must not clobber a committed one
-    kreq=1; [ "$TAG" != tpu ] && kreq=
-    if env KERNLAYOUT_REQUIRE_TPU="$kreq" timeout 1800 \
+    kreq=1 kplat=
+    if [ "$TAG" != tpu ]; then
+        # rehearsal: pin jax to CPU so a wedged relay cannot hang the
+        # probe's import in accelerator discovery
+        kreq= kplat=cpu
+    fi
+    if env KERNLAYOUT_REQUIRE_TPU="$kreq" JAX_PLATFORMS="$kplat" timeout 1800 \
          python scripts/kern_layout_probe.py > "$klout.tmp" 2>&1; then
         mv "$klout.tmp" "$klout"
         tail -6 "$klout"

@@ -7,6 +7,7 @@ import asyncio
 import pytest
 
 from cometbft_tpu.e2e import (ManifestError, Runner, manifest_from_dict)
+from cometbft_tpu.e2e.runner import RunnerError
 
 pytestmark = pytest.mark.timeout(240)
 
@@ -156,3 +157,26 @@ def test_e2e_generated_seed_runs_green(tmp_path, seed):
     finally:
         runner.stop()
     assert all(h >= m.final_height for h in report["heights"].values())
+
+
+def test_runner_detects_port_squatter():
+    """A status response from a node OTHER than the one the runner
+    generated must raise, naming the foreign id: stale nodes from a
+    killed previous run squat the same ports, serve the same chain id
+    and monikers, and poisoned runs with another chain's blocks (the
+    'app hash mismatch after replay' flake this guard closes)."""
+    m = manifest_from_dict({
+        "chain_id": "squat-net",
+        "validators": {"v1": 10},
+        "node": {"v1": {}},
+    })
+    r = Runner(m, "/tmp/e2e-squat-test-unused", base_port=29990,
+               log=lambda *a: None)
+    r.node_ids = {"v1": "aabbccddeeff00112233"}
+    ok_st = {"node_info": {"id": "aabbccddeeff00112233", "moniker": "v1"}}
+    r._check_identity("v1", ok_st)          # matching id: fine
+    r._check_identity("v1", {})             # no node_info: tolerated
+    r._check_identity("v2", ok_st)          # unknown name: tolerated
+    foreign = {"node_info": {"id": "ffffffffffffffffffff", "moniker": "v1"}}
+    with pytest.raises(RunnerError, match="FOREIGN node"):
+        r._check_identity("v1", foreign)
