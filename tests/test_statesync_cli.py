@@ -113,8 +113,19 @@ def test_statesync_via_cli_config(tmp_path):
 
             # compare a block node3 committed itself, post-snapshot (its
             # store has no blocks at/below the snapshot height — that is
-            # the point of statesync)
+            # the point of statesync).  h_check must sit ABOVE node3's
+            # store base: when the restored snapshot is near the tip,
+            # latest-1 can land on the snapshot height itself, which its
+            # store never has by design (this raced as a rare flake).
             st3 = await call(cli3, "status")
+            base3 = st3["sync_info"]["earliest_block_height"]
+            deadline = time.monotonic() + 60
+            while st3["sync_info"]["latest_block_height"] - 1 <= base3:
+                assert time.monotonic() < deadline, \
+                    f"node3 stopped committing past its statesync " \
+                    f"base: {st3['sync_info']}"
+                await asyncio.sleep(0.3)
+                st3 = await call(cli3, "status")
             h_check = st3["sync_info"]["latest_block_height"] - 1
             b0 = await call(cli0, "block", height=h_check)
             b3 = await call(cli3, "block", height=h_check)
