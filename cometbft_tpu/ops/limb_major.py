@@ -1,0 +1,374 @@
+"""Limb-major (20, B) variant of the Ed25519 verify kernel.
+
+The production kernel (``ops/ed25519.py``) shapes field elements
+``(B, 20)`` — limbs on the minor axis.  On TPU the minor axis maps to
+the 128-wide vector lane dimension, so 20 limbs occupy 20 of 128 lanes
+(~16% utilization) and ``fe.mul``'s Toeplitz intermediate is tiled
+wastefully; the measured symptom is the large-batch HBM cliff
+(docs/bench/r04-notes.md).  This module flips the layout: field
+elements are ``(20, B)`` — the BATCH rides the vector lanes, limbs ride
+the sublane axis — with the multiply as 20 statically-shifted
+row-accumulations (no Toeplitz intermediate at all).  The CPU rehearsal
+of ``scripts/kern_layout_probe.py`` measures the multiply alone at
+~4.6x the batch-major form; this module exists so the next TPU window
+can measure the WHOLE pipeline and, if the win holds, swap the dispatch
+(`crypto/batch.py`) over.
+
+Scope: fe + edwards layers only.  SHA-512 and the mod-L scalar pipeline
+stay batch-major (together ~5% of device time) — their outputs feed the
+ladder purely as (B,) gather indices, which are layout-agnostic.
+
+Interface parity: :func:`verify_padded_lm` takes exactly the arguments
+of ``ed25519.verify_padded`` and returns the same (B,) bool mask;
+``tests/test_limb_major.py`` pins bit-identical accept/reject against
+the production kernel over random batches and the ZIP-215 edge corpus.
+
+Duplication note: the point formulas and exponentiation chains below
+mirror ``ops/edwards.py`` / ``ops/fe.py`` verbatim modulo the broadcast
+axis — deliberate for an EXPERIMENTAL twin that must not perturb the
+production kernel while awaiting hardware numbers.  If the measured win
+holds and this layout is promoted, the production ``edwards.py`` gets
+parameterized over its field-ops module instead of keeping two copies.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import fe, scalar, sha512
+from . import ed25519 as _prod
+
+RADIX, MASK, NL, NC, FOLD = fe.RADIX, fe.MASK, fe.NLIMBS, fe.NCOLS, fe.FOLD
+
+
+def _const(x_limbs) -> jnp.ndarray:
+    """Canonical (20,) limb constant -> (20, 1) column for broadcast."""
+    return jnp.asarray(np.asarray(x_limbs, np.int32).reshape(NL, 1))
+
+
+ONE = _const(fe.ONE_LIMBS)
+ZERO = _const(fe.ZERO_LIMBS)
+D = _const(fe.D_LIMBS)
+D2 = _const(fe.D2_LIMBS)
+SQRT_M1 = _const(fe.SQRT_M1_LIMBS)
+SUB_OFF = _const(fe.SUB_OFF)
+P_COL = _const(fe.P_LIMBS)
+
+
+# ------------------------------------------------------------------ fe
+
+def _wrap_carry(x, passes: int):
+    for _ in range(passes):
+        lo = x & MASK
+        hi = x >> RADIX
+        wrapped = jnp.concatenate([hi[-1:] * FOLD, hi[:-1]], axis=0)
+        x = lo + wrapped
+    return x
+
+
+def add(a, b):
+    return _wrap_carry(a + b, 1)
+
+
+def sub(a, b):
+    return _wrap_carry(a + SUB_OFF - b, 2)
+
+
+def neg(a):
+    return sub(jnp.zeros_like(a), a)
+
+
+def _reduce_columns(cols):
+    """(39, B) product columns -> loose (20, B)."""
+    lo = cols & MASK
+    hi = cols >> RADIX
+    limbs40 = jnp.concatenate([lo, jnp.zeros_like(lo[:1])],
+                              axis=0).at[1:].add(hi)
+    folded = limbs40[:NL] + FOLD * limbs40[NL:]
+    return _wrap_carry(folded, 3)
+
+
+def mul(a, b):
+    """Shifted accumulation: 20 statically-placed partial products, no
+    (…,20,39) intermediate (the batch-major kernel's HBM hazard)."""
+    out = jnp.zeros((NC,) + jnp.broadcast_shapes(a.shape[1:], b.shape[1:]),
+                    jnp.int32)
+    for i in range(NL):
+        out = out.at[i:i + NL].add(a[i:i + 1] * b)
+    return _reduce_columns(out)
+
+
+def square(a):
+    return mul(a, a)
+
+
+def select(mask, a, b):
+    """mask (B,) bool -> limbs from a where true else b."""
+    return jnp.where(mask[None, :], a, b)
+
+
+def freeze(a):
+    """Loose -> canonical in [0, p); mirrors fe.freeze on axis 0."""
+    limbs = []
+    c = jnp.zeros_like(a[0])
+    for i in range(NL):
+        t = a[i] + c
+        limbs.append(t & MASK)
+        c = t >> RADIX
+    t = limbs[0] + c * FOLD
+    limbs[0] = t & MASK
+    c = t >> RADIX
+    for i in range(1, NL):
+        t = limbs[i] + c
+        limbs[i] = t & MASK
+        c = t >> RADIX
+    limbs[0] = limbs[0] + c * FOLD
+    q = limbs[19] >> 8
+    limbs[19] = limbs[19] & 255
+    c = q * 19
+    for i in range(NL):
+        t = limbs[i] + c
+        limbs[i] = t & MASK
+        c = t >> RADIX
+    x = jnp.stack(limbs, axis=0)
+    borrow = jnp.zeros_like(x[0])
+    diff = []
+    for i in range(NL):
+        t = x[i] - jnp.int32(int(fe.P_LIMBS[i])) - borrow
+        diff.append(t & MASK)
+        borrow = (t >> RADIX) & 1
+    d = jnp.stack(diff, axis=0)
+    return select(borrow == 0, d, x)
+
+
+def is_zero(a):
+    return jnp.all(freeze(a) == 0, axis=0)
+
+
+def eq(a, b):
+    return is_zero(sub(a, b))
+
+
+def from_bytes32_T(bt, mask_bit255: bool = True):
+    """(32, B) little-endian bytes -> (20, B) limbs (raw 255-bit value)."""
+    bt = bt.astype(jnp.int32)
+    limbs = []
+    for i in range(NL):
+        bit0 = RADIX * i
+        acc = jnp.zeros_like(bt[0])
+        for j in range(bit0 // 8, min((bit0 + RADIX + 7) // 8, 32)):
+            shift = 8 * j - bit0
+            byte = bt[j]
+            if mask_bit255 and j == 31:
+                byte = byte & 127
+            acc = acc + (byte << shift if shift >= 0 else byte >> -shift)
+        limbs.append(acc & MASK)
+    return jnp.stack(limbs, axis=0)
+
+
+def _sq_n(a, n: int):
+    if n <= 4:
+        for _ in range(n):
+            a = square(a)
+        return a
+    return jax.lax.fori_loop(0, n, lambda _, x: square(x), a)
+
+
+def _pow_chain(z):
+    """z^(2^250 - 1) (no z^11 second return: nothing here inverts)."""
+    z2 = square(z)
+    z9 = mul(z, _sq_n(z2, 2))
+    z11 = mul(z2, z9)
+    z_5_0 = mul(z9, square(z11))
+    z_10_0 = mul(_sq_n(z_5_0, 5), z_5_0)
+    z_20_0 = mul(_sq_n(z_10_0, 10), z_10_0)
+    z_40_0 = mul(_sq_n(z_20_0, 20), z_20_0)
+    z_50_0 = mul(_sq_n(z_40_0, 10), z_10_0)
+    z_100_0 = mul(_sq_n(z_50_0, 50), z_50_0)
+    z_200_0 = mul(_sq_n(z_100_0, 100), z_100_0)
+    z_250_0 = mul(_sq_n(z_200_0, 50), z_50_0)
+    return z_250_0
+
+
+def pow22523(z):
+    return mul(_sq_n(_pow_chain(z), 2), z)
+
+
+def sqrt_ratio(u, v):
+    v3 = mul(square(v), v)
+    uv3 = mul(u, v3)
+    uv7 = mul(uv3, square(square(v)))
+    x = mul(uv3, pow22523(uv7))
+    vxx = mul(v, square(x))
+    ok_direct = eq(vxx, u)
+    ok_flip = eq(vxx, neg(u))
+    x = select(ok_direct, x, mul(x, SQRT_M1))
+    return x, ok_direct | ok_flip
+
+
+# ------------------------------------------------------------- edwards
+
+class Ext(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+class Cached(NamedTuple):
+    ypx: jnp.ndarray
+    ymx: jnp.ndarray
+    z2: jnp.ndarray
+    t2d: jnp.ndarray
+
+
+class Niels(NamedTuple):
+    ypx: jnp.ndarray
+    ymx: jnp.ndarray
+    t2d: jnp.ndarray
+
+
+def identity(n: int) -> Ext:
+    zero = jnp.broadcast_to(ZERO, (NL, n))
+    one = jnp.broadcast_to(ONE, (NL, n))
+    return Ext(zero, one, one, zero)
+
+
+def cache(p: Ext) -> Cached:
+    return Cached(add(p.y, p.x), sub(p.y, p.x), add(p.z, p.z),
+                  mul(p.t, D2))
+
+
+def neg_ext(p: Ext) -> Ext:
+    return Ext(neg(p.x), p.y, p.z, neg(p.t))
+
+
+def dbl(p: Ext) -> Ext:
+    a = square(p.x)
+    b = square(p.y)
+    c = add(square(p.z), square(p.z))
+    h = add(a, b)
+    e = sub(h, square(add(p.x, p.y)))
+    g = sub(a, b)
+    f = add(c, g)
+    return Ext(mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+
+def add_cached(p: Ext, q: Cached) -> Ext:
+    a = mul(sub(p.y, p.x), q.ymx)
+    b = mul(add(p.y, p.x), q.ypx)
+    c = mul(p.t, q.t2d)
+    d = mul(p.z, q.z2)
+    e = sub(b, a)
+    f = sub(d, c)
+    g = add(d, c)
+    h = add(b, a)
+    return Ext(mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+
+def add_niels(p: Ext, q: Niels) -> Ext:
+    a = mul(sub(p.y, p.x), q.ymx)
+    b = mul(add(p.y, p.x), q.ypx)
+    c = mul(p.t, q.t2d)
+    d = add(p.z, p.z)
+    e = sub(b, a)
+    f = sub(d, c)
+    g = add(d, c)
+    h = add(b, a)
+    return Ext(mul(e, f), mul(g, h), mul(f, g), mul(e, h))
+
+
+def decompress_zip215(enc_T):
+    """(32, B) encoded bytes -> (Ext over (20, B), (B,) ok)."""
+    sign = (enc_T[31].astype(jnp.int32) >> 7) & 1
+    y = from_bytes32_T(enc_T, mask_bit255=True)
+    yy = square(y)
+    u = sub(yy, ONE)
+    v = add(mul(yy, D), ONE)
+    x, ok = sqrt_ratio(u, v)
+    x = freeze(x)
+    flip = (x[0] & 1) != sign
+    x = select(flip, neg(x), x)
+    one = jnp.broadcast_to(ONE, x.shape)
+    return Ext(x, y, one, mul(x, y)), ok
+
+
+def mul_by_cofactor(p: Ext) -> Ext:
+    return dbl(dbl(dbl(p)))
+
+
+def is_identity(p: Ext):
+    return is_zero(p.x) & eq(p.y, p.z)
+
+
+# -------------------------------------------------------------- kernel
+
+# constant [j]B niels table, limb-major: (3, 20, 16)
+BASE_NIELS_T = np.transpose(_prod.BASE_NIELS, (1, 2, 0)).copy()
+
+
+def _build_neg_a_table(neg_a: Ext) -> Cached:
+    """(16, 20, B)-stacked cached table of [j](-A), j = 0..15."""
+    n = neg_a.x.shape[1]
+    entries = [cache(identity(n)), cache(neg_a)]
+    p2 = dbl(neg_a)
+    entries.append(cache(p2))
+    pj = p2
+    for _ in range(3, 16):
+        pj = add_cached(pj, entries[1])
+        entries.append(cache(pj))
+    return Cached(*[jnp.stack([e[i] for e in entries], axis=0)
+                    for i in range(4)])
+
+
+def _gather_niels(digit) -> Niels:
+    """(B,) digit -> constant-table Niels entry over (20, B)."""
+    tab = jnp.asarray(BASE_NIELS_T)              # (3, 20, 16)
+    ent = jnp.take(tab, digit, axis=2)           # (3, 20, B)
+    return Niels(ent[0], ent[1], ent[2])
+
+
+def _gather_cached(tab: Cached, digit) -> Cached:
+    """Per-lane table (16, 20, B) + (B,) digit -> (20, B) entry."""
+    idx = digit[None, None, :]
+    return Cached(*[jnp.take_along_axis(c, idx, axis=0)[0] for c in tab])
+
+
+def verify_padded_lm(pub, rb, sb, blocks, active):
+    """Drop-in limb-major twin of ``ed25519.verify_padded``: identical
+    arguments (batch-major byte matrices) and identical (B,) verdict."""
+    pub_T = jnp.transpose(pub)                   # (32, B)
+    rb_T = jnp.transpose(rb)
+
+    a_pt, ok_a = decompress_zip215(pub_T)
+    neg_a_tab = _build_neg_a_table(neg_ext(a_pt))
+    r_pt, ok_r = decompress_zip215(rb_T)
+
+    # scalar + hash pipeline stays batch-major: outputs are (B,) digit
+    # vectors consumed only as gather indices
+    s_limbs = scalar.bytes32_to_limbs(sb)
+    ok_s = scalar.lt_l(s_limbs)
+    s_dig = scalar.nibbles(s_limbs)
+    h_dig = scalar.nibbles(scalar.reduce512(
+        sha512.sha512_blocks(blocks, active)))
+
+    n = pub.shape[0]
+
+    def window(i, acc):
+        w = 63 - i
+        acc = dbl(dbl(dbl(dbl(acc))))
+        ds = jax.lax.dynamic_index_in_dim(s_dig, w, axis=s_dig.ndim - 1,
+                                          keepdims=False)
+        acc = add_niels(acc, _gather_niels(ds))
+        dh = jax.lax.dynamic_index_in_dim(h_dig, w, axis=h_dig.ndim - 1,
+                                          keepdims=False)
+        acc = add_cached(acc, _gather_cached(neg_a_tab, dh))
+        return acc
+
+    acc = jax.lax.fori_loop(0, 64, window, identity(n))
+    acc = add_cached(acc, cache(neg_ext(r_pt)))
+    return ok_a & ok_r & ok_s & is_identity(mul_by_cofactor(acc))

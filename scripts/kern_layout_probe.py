@@ -113,3 +113,25 @@ bench("100 fe.add (B,20)", add100, a, b)
 def add100T(a, b):
     return jax.lax.fori_loop(0, 100, lambda _, x: wrap_carry_T(x + b, 1), a)
 bench("100 add+carry (20,B)", add100T, aT, bT)
+
+
+# ---- full-pipeline comparison: production vs limb-major twin ----------
+from cometbft_tpu.ops import ed25519 as _prod_kernel
+from cometbft_tpu.ops import limb_major as _lm
+from cometbft_tpu.testing import dense_signature_batch as _dsb
+
+for B2 in (1024, 4096):
+    args, _ = _dsb(B2, msg_len=120, seed=2024)
+    args = jax.device_put(args)
+    f_prod = jax.jit(_prod_kernel.verify_padded)
+    f_lm = jax.jit(_lm.verify_padded_lm)
+    o1 = np.asarray(f_prod(*args)); o2 = np.asarray(f_lm(*args))
+    assert o1.all() and (o1 == o2).all(), "limb-major verdict mismatch!"
+    for name, f in (("batch-major", f_prod), ("limb-major", f_lm)):
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            ts.append(time.perf_counter() - t0)
+        print(f"verify_padded {name:12s} B={B2:5d} {min(ts)*1e3:9.2f} ms "
+              f"({B2/min(ts):8.0f} sigs/s)", flush=True)

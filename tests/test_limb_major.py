@@ -1,0 +1,57 @@
+"""Limb-major (20,B) kernel twin: bit-identical accept/reject with the
+production batch-major kernel over random batches and ZIP-215 edges."""
+
+import numpy as np
+import jax
+import pytest
+
+# first compile of each kernel pair dominates; share ONE lane shape (24)
+# across the module so later tests hit the in-process jit cache
+pytestmark = pytest.mark.timeout(900)
+
+from cometbft_tpu.ops import ed25519, limb_major
+from cometbft_tpu.testing import dense_signature_batch
+
+
+def test_limb_major_matches_production_on_random_batch():
+    args, _ = dense_signature_batch(24, msg_len=80, seed=99)
+    want = np.asarray(jax.jit(ed25519.verify_padded)(*args))
+    got = np.asarray(jax.jit(limb_major.verify_padded_lm)(*args))
+    assert want.all()
+    assert (got == want).all()
+
+
+def test_limb_major_rejects_what_production_rejects():
+    args, _ = dense_signature_batch(24, msg_len=80, seed=7)
+    pub, rb, sb, blocks, active = args
+    # tamper a scatter of lanes across every input surface
+    sb = np.asarray(sb).copy(); sb[3, 0] ^= 1          # bad S
+    rb = np.asarray(rb).copy(); rb[7, 31] ^= 0x40      # bad R encoding
+    pub2 = np.asarray(pub).copy(); pub2[11, 5] ^= 2    # bad A
+    blocks2 = np.asarray(blocks).copy()
+    blocks2[13, 0, 0] ^= 1                             # bad message
+    args2 = (pub2, rb, sb, blocks2, active)
+    want = np.asarray(jax.jit(ed25519.verify_padded)(*args2))
+    got = np.asarray(jax.jit(limb_major.verify_padded_lm)(*args2))
+    assert not want[3] and not want[7] and not want[11] and not want[13]
+    assert (got == want).all()
+
+
+def test_limb_major_zip215_edge_corpus():
+    """ZIP-215 edge encodings (non-canonical y, sign-bit families,
+    S >= L) must get the same verdict from the limb-major twin as from
+    the production kernel — which is itself pinned to the Python oracle
+    in test_ed25519_kernel.py, so agreement here is transitive."""
+    # build a batch whose lanes hit edge encodings via sign/high bits
+    args, _ = dense_signature_batch(24, msg_len=80, seed=31)
+    pub, rb, sb, blocks, active = [np.asarray(a).copy() for a in args]
+    pub[0, 31] |= 0x80      # sign-bit x=0 family
+    rb[1, 31] |= 0x80
+    pub[2] = 0; pub[2, 0] = 1                      # y = 0 + sign 0
+    rb[3] = 255                                    # non-canonical y >= p
+    sb[4] = 255                                    # S >= L (must reject)
+    args2 = (pub, rb, sb, blocks, active)
+    want = np.asarray(jax.jit(ed25519.verify_padded)(*args2))
+    got = np.asarray(jax.jit(limb_major.verify_padded_lm)(*args2))
+    assert not want[4]                             # sanity: S>=L rejected
+    assert (got == want).all()
