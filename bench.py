@@ -301,6 +301,86 @@ def _child_stress(backend: str, n_vals: int, secp_pct: int) -> None:
     }), flush=True)
 
 
+def _child_p50commit(backend: str, n_vals: int) -> None:
+    """BASELINE's latency bar: p50 VerifyCommit @10k validators < 5 ms.
+    Times the PRODUCTION dense dispatch (``crypto/batch.verify_dense``
+    with the whole-valset cached-table route) end to end — host packing,
+    coefficient draw, transfer, kernel, sync — and reports a
+    pack/dispatch breakdown so the next latency fix targets the
+    measured stage (VERDICT r4 next 4)."""
+    note, kernel_backend = _mode_child_setup("p50", backend)
+
+    import numpy as np
+
+    from cometbft_tpu.crypto import batch as cb
+    from cometbft_tpu.testing import dense_signature_batch
+
+    note(f"building {n_vals}-validator commit-shaped batch")
+    args, host_items = dense_signature_batch(n_vals, msg_len=120, seed=77,
+                                             n_keys=min(n_vals, 256))
+    pubs = np.asarray(args[0], np.uint8)
+    sigs = np.concatenate([np.asarray(args[1], np.uint8),
+                           np.asarray(args[2], np.uint8)], axis=1)
+    msgs = np.stack([np.frombuffer(m, np.uint8).copy()
+                     for _, m, _ in host_items])
+    lens = np.full((n_vals,), msgs.shape[1], np.int64)
+    # a REAL 10k valset has 10k distinct rows; the signing keys repeat
+    # (sign cost), but the pubkey matrix identity drives the table cache
+    scope = np.arange(n_vals, dtype=np.int64)
+
+    def one_commit():
+        out = cb.verify_dense(kernel_backend, pubs, sigs, msgs, lens,
+                              valset_pubs=pubs, scope=scope)
+        assert out is not None and out[0], "commit batch failed"
+
+    note("cold call (compiles + builds the valset table)")
+    cold, _ = _timed_cold_warm(one_commit)
+    note(f"cold took {cold:.1f}s; timing warm commits")
+    reps = int(os.environ.get("BENCH_REPS", "15"))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        one_commit()
+        times.append(time.perf_counter() - t0)
+    p50 = float(np.percentile(times, 50))
+
+    # breakdown (device path only — the native CPU route never packs
+    # lane matrices): host packing (lane padding + SHA block assembly +
+    # RLC coefficient draw) vs everything after dispatch, over the SAME
+    # chunk sequence the measured commit actually runs (n_vals > the
+    # lane cap dispatches several chunks, each paying its own pack)
+    pack_ms = dispatch_ms = None
+    if kernel_backend != "cpu":
+        cap = cb._LANE_BUCKETS[-1]
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            for start in range(0, n_vals, cap):
+                end = min(start + cap, n_vals)
+                bb = cb._chunk_bucket(end - start, ())
+                sl = slice(start, end)
+                cb._padded_lane_args(pubs[sl], sigs[sl, :32],
+                                     sigs[sl, 32:], msgs[sl], lens[sl], bb)
+                cb._rlc_args(bb, end - start)
+        pack_ms = round((time.perf_counter() - t0) / reps * 1e3, 3)
+        dispatch_ms = round(p50 * 1e3 - pack_ms, 3)
+
+    print(json.dumps({
+        "metric": f"p50 VerifyCommit latency @{n_vals} validators "
+                  f"(production dense dispatch)",
+        "value": round(p50 * 1e3, 3),
+        "unit": "ms",
+        # BASELINE bar: < 5 ms p50; >1 means the bar is met
+        "vs_baseline": round(5.0 / (p50 * 1e3), 3),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p90_ms": round(float(np.percentile(times, 90)) * 1e3, 3),
+        "pack_ms": pack_ms,
+        "dispatch_ms": dispatch_ms,
+        "cold_s": round(cold, 3),
+        "n_validators": n_vals,
+        "backend": backend,
+    }), flush=True)
+
+
 def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
     """Single-node end-to-end throughput: one validator committing load
     txs through the FULL stack (RPC -> mempool -> consensus -> ABCI
@@ -456,6 +536,9 @@ def _child_main(backend: str, nsig: int) -> None:
         return _child_stress(backend,
                              int(os.environ.get("BENCH_VALS", "10000")),
                              int(os.environ.get("BENCH_SECP_PCT", "10")))
+    if mode == "p50commit":
+        return _child_p50commit(backend,
+                                int(os.environ.get("BENCH_VALS", "10000")))
 
     def note(msg):
         print(f"[bench:{backend}] {msg}", file=sys.stderr, flush=True)
@@ -519,7 +602,7 @@ def _child_main(backend: str, nsig: int) -> None:
 
     import jax
 
-    from cometbft_tpu.ops import ed25519
+    from cometbft_tpu.ops import ed25519, rlc
 
     enable_compile_cache()
 
@@ -532,7 +615,7 @@ def _child_main(backend: str, nsig: int) -> None:
         raise RuntimeError("requested accelerator but got CPU backend")
     fn = jax.jit(ed25519.verify_padded)
     args = jax.device_put(batch_args, dev)
-    note("compiling + first run")
+    note("compiling + first run (per-lane straus)")
     t0 = time.perf_counter()
     out = np.asarray(fn(*args))
     note(f"compile+run took {time.perf_counter() - t0:.1f}s")
@@ -552,7 +635,28 @@ def _child_main(backend: str, nsig: int) -> None:
         times.append(time.perf_counter() - t0)
     if profile_dir:
         jax.profiler.stop_trace()
-    p50 = float(np.percentile(times, 50))
+    p50_straus = float(np.percentile(times, 50))
+
+    # RLC batch kernel: the production fast path for batches >= the RLC
+    # threshold (one all-or-nothing verdict; ~3x less group-op work)
+    note("compiling + first run (rlc batch)")
+    z = rlc.host_rlc_coeffs(nsig, np.ones(nsig, bool))
+    rfn = jax.jit(rlc.verify_batch_rlc)
+    rargs = jax.device_put(batch_args + (z,), dev)
+    t0 = time.perf_counter()
+    rok = bool(np.asarray(rfn(*rargs)))
+    note(f"compile+run took {time.perf_counter() - t0:.1f}s")
+    assert rok, "RLC rejected the benchmark batch"
+    rtimes = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        rfn(*rargs).block_until_ready()
+        rtimes.append(time.perf_counter() - t0)
+    p50_rlc = float(np.percentile(rtimes, 50))
+
+    # the production router dispatches RLC first at this batch size, so
+    # the headline is the better of the two (they verify the same batch)
+    p50 = min(p50_straus, p50_rlc)
     sigs_per_sec = nsig / p50
 
     # Host baseline: single-verify over a sample, extrapolated to nsig.
@@ -568,6 +672,9 @@ def _child_main(backend: str, nsig: int) -> None:
         "vs_single_loop": round(vs_single, 2),
         "vs_reference_batch_est": round(vs_single / 2.0, 2),
         "p50_batch_latency_ms": round(p50 * 1e3, 3),
+        "straus_sigs_per_sec": round(nsig / p50_straus, 1),
+        "rlc_sigs_per_sec": round(nsig / p50_rlc, 1),
+        "rlc_vs_straus": round(p50_straus / p50_rlc, 2),
         "batch_size": nsig,
         "backend": backend,
         "device": str(dev),
@@ -688,7 +795,7 @@ def main() -> None:
         # vs_baseline against its OWN in-process single-loop run, which
         # box contention can skew across attempts.  verifycommit is a
         # latency (lower wins); every other mode is a rate.
-        if os.environ.get("BENCH_MODE") == "verifycommit":
+        if os.environ.get("BENCH_MODE") in ("verifycommit", "p50commit"):
             best = min(results,
                        key=lambda r: r.get("value") or float("inf"))
         else:
@@ -711,6 +818,7 @@ def main() -> None:
                   "headers/s"),
         "blocksync": ("blocksync replay, blocks/sec", "blocks/s"),
         "verifycommit": ("VerifyCommitLight latency", "ms"),
+        "p50commit": ("p50 VerifyCommit latency @10k validators", "ms"),
         "stress": ("mixed-key extended-commit verify", "sigs/s"),
         "node": ("single-node end-to-end throughput", "tx/s"),
     }.get(mode, (mode, "ops/s"))

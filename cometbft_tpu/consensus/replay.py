@@ -47,6 +47,17 @@ class Handshaker:
         if state.last_block_height == 0 and app_height == 0:
             state = await self._init_chain(state, app_conns)
 
+        if app_height == store_height == state.last_block_height + 1:
+            # Crash between app Commit and state save (the
+            # exec:after-app-commit window): a PERSISTENT app already
+            # holds block H, so re-executing would double-apply it.
+            # Advance state from the persisted finalize response alone —
+            # the reference handles appBlockHeight == storeBlockHeight
+            # with a mock app built from stored ABCI responses
+            # (replay.go ReplayBlocks -> replayBlock via mockProxyApp).
+            state = self._recover_state_from_stored_response(
+                state, store_height, executor)
+
         if app_height > state.last_block_height:
             raise HandshakeError(
                 f"app height {app_height} ahead of state "
@@ -109,6 +120,28 @@ class Handshaker:
             meta = self.block_store.load_block_meta(store_height)
             state = await executor.apply_block(state, meta.block_id, block)
             self.state_store.save(state)
+        return state
+
+    def _recover_state_from_stored_response(self, state: State, height: int,
+                                            executor: BlockExecutor) -> State:
+        """Advance state over a block the app has already committed,
+        using the finalize response persisted before the app Commit
+        (``exec:after-save-response`` precedes ``exec:after-app-commit``,
+        so the response is always on disk in this crash window) — no
+        FinalizeBlock/Commit is sent to the app."""
+        from ..sm.execution import unpack_finalize_response
+
+        block = self.block_store.load_block(height)
+        meta = self.block_store.load_block_meta(height)
+        raw = self.state_store.load_finalize_block_response(height)
+        if block is None or meta is None or raw is None:
+            raise HandshakeError(
+                f"app height {height} ahead of state "
+                f"{state.last_block_height} and no stored block/response "
+                f"to recover from")
+        resp = unpack_finalize_response(raw)
+        state = executor._update_state(state, meta.block_id, block, resp)
+        self.state_store.save(state)
         return state
 
     async def _init_chain(self, state: State, app_conns: AppConns) -> State:

@@ -225,23 +225,87 @@ class WAL:
             if not clean:
                 return
 
+    def _segment_first_endheight(self, path: str):
+        """First EndHeight sentinel value in a segment, or None (no
+        sentinel / unreadable).  Decodes only up to the first sentinel —
+        the binary-search probe cost."""
+        for item in self._iter_segment(path):
+            if isinstance(item, bool):
+                return None
+            if item.get("#") == "endheight":
+                return item["h"]
+        return None
+
+    def _search_start_segment(self, segs: list[str], height: int) -> int:
+        """Binary search for the last segment that can contain the
+        EndHeight(height) sentinel (reference: autofile group binary
+        search, ``internal/autofile/group.go:34-54`` via
+        ``internal/consensus/wal.go:232`` SearchForEndHeight): sentinel
+        heights increase monotonically across segments, so the segment
+        whose FIRST sentinel is <= height is a safe scan start — a
+        restarting validator reads O(log n) segment heads plus the tail
+        instead of every record of every segment.  Segments without any
+        sentinel probe their nearest keyed predecessor."""
+        if height == 0 or len(segs) <= 1:
+            return 0
+        probed: dict = {}            # memo: a keyless segment decodes once
+
+        def first_eh(i):
+            if i not in probed:
+                probed[i] = self._segment_first_endheight(segs[i])
+            return probed[i]
+
+        best = 0
+        lo, hi = 0, len(segs) - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            j, key = mid, None
+            while j >= lo:           # nearest keyed segment at/below mid
+                key = first_eh(j)
+                if key is not None:
+                    break
+                j -= 1
+            if key is None:          # no sentinel anywhere in [lo, mid]
+                lo = mid + 1
+                continue
+            if key <= height:
+                best = j
+                lo = mid + 1
+            else:
+                hi = j - 1
+        return best
+
     def records_after_height(self, height: int) -> list[dict]:
         """Records following the EndHeight(h) sentinel for h == height
         (replay input: catchupReplay, replay.go:95).  If the sentinel is
-        missing, returns records from the start (fresh WAL)."""
+        missing, returns records from the start (fresh WAL).  Scans only
+        from the binary-searched start segment — corruption in the
+        unreachable earlier segments is not re-verified (their records
+        cannot be replay input)."""
+        self.flush_and_sync()
+        segs = self._segments()
         out: list[dict] = []
         found = height == 0
-        for rec in self.iter_records():
-            if rec.get("#") == "endheight":
-                if rec["h"] == height:
-                    found = True
-                    out = []
-                elif rec["h"] > height and not found:
-                    raise WALError(
-                        f"WAL jumped past height {height} (saw {rec['h']})")
-                continue
-            if found or height == 0:
-                out.append(rec)
+        for path in segs[self._search_start_segment(segs, height):]:
+            clean = False
+            for item in self._iter_segment(path):
+                if isinstance(item, bool):
+                    clean = item
+                    break
+                rec = item
+                if rec.get("#") == "endheight":
+                    if rec["h"] == height:
+                        found = True
+                        out = []
+                    elif rec["h"] > height and not found:
+                        raise WALError(
+                            f"WAL jumped past height {height} "
+                            f"(saw {rec['h']})")
+                    continue
+                if found or height == 0:
+                    out.append(rec)
+            if not clean:
+                break                 # same stop-at-corruption semantics
         return out
 
     def close(self) -> None:

@@ -104,8 +104,12 @@ class Client:
         verified = await self._verify_light_block(trusted, target, now_ns)
         # cross-check BEFORE anything is persisted: a divergent target must
         # never enter the trusted store (it would short-circuit future
-        # calls via the cache above and skew the detector's common height)
-        await self._cross_check(target, now_ns)
+        # calls via the cache above and skew the detector's common height).
+        # The verification trace (trusted root + every newly verified
+        # block, ascending) lets the detector walk to the true fork height.
+        await self._cross_check(target, now_ns,
+                                trace=[trusted] + sorted(
+                                    verified, key=lambda b: b.height))
         for lb in verified:
             self.store.save(lb)
         if self.pruning_size:        # one pass after the batch, not per save
@@ -201,6 +205,7 @@ class Client:
 
     # ---------------------------------------------------------- detector
 
-    async def _cross_check(self, lb: LightBlock, now_ns: int) -> None:
+    async def _cross_check(self, lb: LightBlock, now_ns: int,
+                           trace: list[LightBlock] | None = None) -> None:
         if self.witnesses:
-            await detect_divergence(self, lb, now_ns)
+            await detect_divergence(self, lb, now_ns, trace=trace)

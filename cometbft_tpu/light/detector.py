@@ -1,76 +1,191 @@
 """Attack detection: compare the primary's newly verified header against
-every witness (reference: ``light/detector.go:28,121``).
+every witness (reference: ``light/detector.go:28`` detectDivergence,
+``:121`` handleConflictingHeaders, ``:285``
+examineConflictingHeaderAgainstTrace).
 
-A witness that serves a DIFFERENT validly-signed header at the same height
-means either the primary or the witness is attacking: the divergence is
-surfaced as DivergenceError carrying LightClientAttackEvidence for both
-sides (the reference sends evidence to the respective honest parties)."""
+All witnesses are queried CONCURRENTLY (the reference fans out a
+goroutine per witness, ``light/client.go:1046-1067``; here one asyncio
+gather).  A witness that serves a different validly-signed header at the
+same height means either the primary or the witness is attacking: the
+detector walks the primary's verification trace against the witness to
+find the true common (fork) height, builds LightClientAttackEvidence
+against BOTH sides, submits each to the respective honest party (the
+witness gets the evidence incriminating the primary, the primary gets
+the evidence incriminating the witness), and raises DivergenceError.
+
+Witness hygiene: replies that fail basic validation or signature
+verification mark the witness bad and drop it (a broken witness must not
+DoS the client with fabricated headers); a witness that persistently
+answers ErrLightBlockNotFound (lagging) is dropped after
+``MAX_WITNESS_LAG_STRIKES`` consecutive misses — the reference tracks
+and replaces such witnesses rather than retrying them forever."""
 
 from __future__ import annotations
+
+import asyncio
 
 from ..types.evidence import LightClientAttackEvidence
 from .provider import ErrLightBlockNotFound
 from .types import LightBlock, LightClientError
 
+# consecutive not-found replies before a lagging witness is dropped
+MAX_WITNESS_LAG_STRIKES = 3
+
 
 class DivergenceError(LightClientError):
     def __init__(self, witness_id: str, primary_block: LightBlock,
-                 witness_block: LightBlock, evidence):
+                 witness_block: LightBlock, evidence,
+                 evidence_against_witness=None, common_height: int = 0):
         self.witness_id = witness_id
         self.primary_block = primary_block
         self.witness_block = witness_block
+        # evidence incriminating the primary (named ``evidence`` for the
+        # original one-sided API); its twin incriminates the witness
         self.evidence = evidence
+        self.evidence_against_primary = evidence
+        self.evidence_against_witness = evidence_against_witness
+        self.common_height = common_height
         super().__init__(
             f"witness {witness_id} diverges at height "
-            f"{primary_block.height}: primary "
-            f"{primary_block.header.hash().hex()[:12]} vs witness "
+            f"{primary_block.height} (common height {common_height}): "
+            f"primary {primary_block.header.hash().hex()[:12]} vs witness "
             f"{witness_block.header.hash().hex()[:12]}")
 
 
-async def detect_divergence(client, lb: LightBlock, now_ns: int) -> None:
-    """detector.go:28 detectDivergence: every witness must agree on the
-    header hash at lb.height.
-
-    A witness reply is only treated as a conflict if it is itself a
-    validly signed light block (detector.go compareNewHeaderWithWitness
-    verifies before examining) — otherwise one broken witness could DoS
-    the client with fabricated headers; such witnesses are dropped."""
+def _verify_witness_block(client, wlb: LightBlock) -> str | None:
+    """Basic + signature verification of a witness-served block: the
+    detector must never build evidence from (or be DoS'd by) an
+    unsigned fabrication (detector.go compareNewHeaderWithWitness)."""
     from ..types.validation import CommitVerificationError, VerifyCommitLight
 
-    bad_witnesses = []
+    err = wlb.validate_basic(client.chain_id)
+    if err is not None:
+        return err
     try:
-        for witness in client.witnesses:
-            try:
-                wlb = await witness.light_block(lb.height)
-            except ErrLightBlockNotFound:
-                continue             # witness lags; reference retries later
-            if wlb.header.hash() == lb.header.hash():
-                continue
-            err = wlb.validate_basic(client.chain_id)
-            if err is None:
-                try:
-                    VerifyCommitLight(client.chain_id, wlb.validators,
-                                      wlb.commit.block_id, wlb.height,
-                                      wlb.commit, backend=client.backend)
-                except CommitVerificationError as e:
-                    err = str(e)
+        VerifyCommitLight(client.chain_id, wlb.validators,
+                          wlb.commit.block_id, wlb.height, wlb.commit,
+                          backend=client.backend)
+    except CommitVerificationError as e:
+        return str(e)
+    return None
+
+
+async def _examine_against_trace(client, witness, trace: list[LightBlock]):
+    """Walk the primary's verification trace against the witness to
+    locate the fork (detector.go:285 examineConflictingHeaderAgainstTrace):
+    returns ``(common, primary_divergent, witness_divergent)`` where
+    ``common`` is the LAST trace block the witness agrees with and the
+    divergent pair sit at the first trace height where hashes split.
+    The witness's divergent block must itself verify — otherwise the
+    witness is lying rather than forked, and LightClientError names it."""
+    w0 = await witness.light_block(trace[0].height)
+    if w0.header.hash() != trace[0].header.hash():
+        raise LightClientError(
+            f"witness {witness.id()} disagrees with the trace root at "
+            f"height {trace[0].height}: no common header exists")
+    common = trace[0]
+    for tb in trace[1:]:
+        wb = await witness.light_block(tb.height)
+        if wb.header.hash() != tb.header.hash():
+            err = _verify_witness_block(client, wb)
             if err is not None:
-                # not a real signed fork, just a broken/lying witness
+                raise LightClientError(
+                    f"witness {witness.id()} served an invalid divergent "
+                    f"block at height {tb.height}: {err}")
+            return common, tb, wb
+        common = tb
+    raise LightClientError(
+        f"witness {witness.id()} agrees with the whole trace; "
+        f"no divergence to examine")
+
+
+def _attack_evidence(block: LightBlock, common: LightBlock
+                     ) -> LightClientAttackEvidence:
+    return LightClientAttackEvidence(
+        conflicting_header_hash=block.header.hash(),
+        conflicting_height=block.height,
+        common_height=common.height,
+        total_voting_power=block.validators.total_voting_power(),
+        timestamp_ns=block.header.time_ns,
+        conflicting_block=block)
+
+
+def _lag_strikes(client) -> dict:
+    if not hasattr(client, "_witness_lag_strikes"):
+        client._witness_lag_strikes = {}
+    return client._witness_lag_strikes
+
+
+async def detect_divergence(client, lb: LightBlock, now_ns: int,
+                            trace: list[LightBlock] | None = None) -> None:
+    """detector.go:28 detectDivergence: every witness must agree on the
+    header hash at lb.height; on a validly-signed conflict, examine the
+    trace, build two-sided evidence, dispatch it, and raise."""
+    if not client.witnesses:
+        return
+    if not trace:
+        latest = client.store.latest()
+        trace = [latest, lb] if latest is not None and \
+            latest.height < lb.height else [lb]
+    witnesses = list(client.witnesses)
+    replies = await asyncio.gather(
+        *(w.light_block(lb.height) for w in witnesses),
+        return_exceptions=True)
+
+    strikes = _lag_strikes(client)
+    bad_witnesses = []
+    conflicts = []                    # (witness, wlb), verified-signed
+    for witness, res in zip(witnesses, replies):
+        if isinstance(res, ErrLightBlockNotFound):
+            # lagging witness: tolerated a few times, then dropped — a
+            # witness that can never serve the height gives no attack
+            # coverage and would otherwise be retried forever
+            n = strikes.get(witness.id(), 0) + 1
+            strikes[witness.id()] = n
+            if n >= MAX_WITNESS_LAG_STRIKES:
                 bad_witnesses.append(witness)
-                continue
-            # validly signed conflicting header: an actual attack on one
-            # side (detector.go:121 handleConflictingHeaders)
-            trusted = client.store.latest()
-            common_height = trusted.height if trusted is not None \
-                else lb.height
-            ev = LightClientAttackEvidence(
-                conflicting_header_hash=wlb.header.hash(),
-                conflicting_height=wlb.height,
-                common_height=min(common_height, wlb.height),
-                total_voting_power=wlb.validators.total_voting_power(),
-                timestamp_ns=wlb.header.time_ns,
-                conflicting_block=wlb)
-            raise DivergenceError(witness.id(), lb, wlb, ev)
+            continue
+        if isinstance(res, BaseException):
+            bad_witnesses.append(witness)
+            continue
+        strikes.pop(witness.id(), None)
+        if res.header.hash() == lb.header.hash():
+            continue
+        if _verify_witness_block(client, res) is not None:
+            # not a real signed fork, just a broken/lying witness
+            bad_witnesses.append(witness)
+            continue
+        conflicts.append((witness, res))
+
+    try:
+        if not conflicts:
+            return
+        # a real fork on at least one side: walk the trace against the
+        # first conflicting witness (detector.go:121 examines each; one
+        # verified two-sided divergence is already fatal here)
+        witness, wlb = conflicts[0]
+        try:
+            common, primary_div, witness_div = await _examine_against_trace(
+                client, witness, trace)
+        except LightClientError:
+            bad_witnesses.append(witness)
+            raise
+        ev_against_primary = _attack_evidence(primary_div, common)
+        ev_against_witness = _attack_evidence(witness_div, common)
+        # evidence goes to whichever side is honest: the witness
+        # receives the case against the primary and vice versa
+        # (detector.go handleConflictingHeaders evidence dispatch)
+        for target, ev in ((witness, ev_against_primary),
+                           (client.primary, ev_against_witness)):
+            try:
+                await target.report_evidence(ev)
+            except Exception:
+                pass                  # best-effort, like the reference
+        raise DivergenceError(witness.id(), primary_div, witness_div,
+                              ev_against_primary, ev_against_witness,
+                              common.height)
     finally:
         for w in bad_witnesses:
-            client.witnesses.remove(w)
+            if w in client.witnesses:
+                client.witnesses.remove(w)
+            strikes.pop(w.id(), None)

@@ -27,18 +27,38 @@ class Provider(ABC):
         """Light block at height (0 = latest).  Raises
         ErrLightBlockNotFound."""
 
+    async def report_evidence(self, evidence) -> None:
+        """Deliver attack evidence to the peer behind this provider
+        (reference: ``light/provider/provider.go`` ReportEvidence — the
+        detector sends each side's incriminating evidence to the honest
+        party).  Default: no submission channel, drop."""
+
     def id(self) -> str:
         return type(self).__name__
 
 
 class LocalNodeProvider(Provider):
-    def __init__(self, block_store, state_store, name: str = "local"):
+    def __init__(self, block_store, state_store, name: str = "local",
+                 evidence_pool=None):
         self.block_store = block_store
         self.state_store = state_store
         self.name = name
+        self.evidence_pool = evidence_pool
+        self.received_evidence: list = []
 
     def id(self) -> str:
         return self.name
+
+    async def report_evidence(self, evidence) -> None:
+        """Record (and, when a pool is wired, submit) reported attack
+        evidence — the in-process stand-in for the RPC provider's
+        /broadcast_evidence round-trip."""
+        self.received_evidence.append(evidence)
+        if self.evidence_pool is not None:
+            try:
+                self.evidence_pool.add_evidence(evidence)
+            except Exception:
+                pass                  # submission is best-effort
 
     async def light_block(self, height: int) -> LightBlock:
         if height == 0:

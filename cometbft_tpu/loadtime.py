@@ -159,10 +159,21 @@ async def report(client, run_id: str | None = None,
     # throughput — when a starved node commits the whole run in two
     # giant blocks, that span is one block interval and the "throughput"
     # inflates ~50x.  Sends and header times come from different clocks
-    # (sender wall clock vs BFT median time), so guard the division.
+    # (sender wall clock vs BFT median time): cross-host clock skew adds
+    # directly to the mixed window and can even zero it, so the window
+    # of record is the MAX of the mixed span and two same-clock spans
+    # (send-clock span; header-time span anchored one block before the
+    # first tx block) — skew can only shrink a max, not inflate the
+    # number — and all three spans ship in the artifact for
+    # cross-machine comparison (ADVICE r4).
     send_min_ns = min(t for _, t in tx_send)
+    send_max_ns = max(t for _, t in tx_send)
     end_ns = block_time.get(last_h + 1, block_time[last_h])
-    window_s = (end_ns - send_min_ns) / 1e9
+    mixed_s = (end_ns - send_min_ns) / 1e9
+    send_span_s = (send_max_ns - send_min_ns) / 1e9
+    header_start_ns = block_time.get(first_h - 1, block_time[first_h])
+    header_span_s = (end_ns - header_start_ns) / 1e9
+    window_s = max(mixed_s, send_span_s, header_span_s)
     return {
         "txs": len(lat_s),
         "blocks": (last_h - first_h + 1) if first_h else 0,
@@ -175,4 +186,7 @@ async def report(client, run_id: str | None = None,
         "throughput_tx_s": round(len(lat_s) / window_s, 2)
         if window_s > 0 else None,
         "window_s": round(window_s, 3),
+        "window_mixed_s": round(mixed_s, 3),
+        "window_send_clock_s": round(send_span_s, 3),
+        "window_header_clock_s": round(header_span_s, 3),
     }

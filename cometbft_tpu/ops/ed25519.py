@@ -14,6 +14,22 @@ window, one niels addition from a precomputed 16-entry [j]B table (constant,
 gathered per lane) and one cached addition from a per-lane 16-entry [j](-A)
 table.  Everything is branch-free int32/uint32 — one jit compile per
 (batch, hash-blocks) bucket, embarrassingly parallel over lanes.
+
+Layout: the public interface stays batch-major byte matrices
+(``(B, 32)`` pubs/sig-halves, ``(B, NB, 32)`` hash blocks — what the
+host packers emit and what the lane-axis sharding specs in
+``parallel/mesh.py``/``crypto/batch.py`` shard on axis 0), but the curve
+arithmetic inside runs **limb-major** ``(20, B)`` (``ops/fe_lm.py``):
+the batch rides the TPU's 128-wide vector lane dimension instead of the
+20-limb axis (~16% utilization the other way), and the field multiply is
+a fusable shifted accumulation with no ``(B, 20, 39)`` Toeplitz
+intermediate (the measured large-batch HBM cliff of round 4 —
+docs/bench/r04-notes.md).  Measured on the full pipeline (CPU
+rehearsal): 1.26-1.63x over batch-major, growing with batch size.  The
+transposes at the boundary are free under jit relative to the ladder.
+The SHA-512 and mod-L scalar pipelines stay batch-major — their outputs
+feed the ladder purely as (B,) gather indices, which are
+layout-agnostic.
 """
 
 from __future__ import annotations
@@ -22,14 +38,14 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from . import fe, scalar, sha512
-from .edwards import (Cached, Ext, Niels, add_cached, add_niels, cache,
-                      dbl, decompress_zip215, identity, is_identity,
-                      mul_by_cofactor, neg_ext)
+from . import fe, fe_lm, scalar, sha512
+from .group import Cached, Ext, Niels, make_group
 from ..crypto import _ed25519_py as _ref
 
 __all__ = ["verify_padded", "verify_padded_gather",
-           "prepare_pubkey_tables", "BASE_NIELS"]
+           "prepare_pubkey_tables", "BASE_NIELS", "BASE_NIELS_T"]
+
+_g = make_group(fe_lm)
 
 
 def _base_niels_table() -> np.ndarray:
@@ -52,85 +68,103 @@ def _base_niels_table() -> np.ndarray:
 
 
 BASE_NIELS = _base_niels_table()
+# limb-major view for the kernel's constant-table gathers: (3, 20, 16)
+BASE_NIELS_T = np.transpose(BASE_NIELS, (1, 2, 0)).copy()
 
 
 def _build_neg_a_table(neg_a: Ext) -> Cached:
-    """Per-lane cached table of [j](-A), j = 0..15, stacked on axis -2."""
-    entries = [cache(identity(neg_a.x.shape[:-1])), cache(neg_a)]
-    p2 = dbl(neg_a)
-    entries.append(cache(p2))
-    pj = p2
-    for _ in range(3, 16):
-        pj = add_cached(pj, entries[1])
-        entries.append(cache(pj))
-    return Cached(*[jnp.stack([e[i] for e in entries], axis=-2)
-                    for i in range(4)])
+    """Per-lane cached table of [j](-A), j = 0..15: components (16, 20, B).
+
+    The [3]..[15] chain runs under ``lax.scan`` (one addition compiled,
+    13 executed): XLA compile time scales superlinearly with unrolled
+    graph size, and the unrolled 13-step chain alone cost ~30 s of
+    compile per bucket shape on the CPU backend."""
+    n = neg_a.x.shape[1]
+    c0 = _g.cache(_g.identity((n,)))
+    c1 = _g.cache(neg_a)
+    p2 = _g.dbl(neg_a)
+    c2 = _g.cache(p2)
+
+    def step(pj, _):
+        nxt = _g.add_cached(pj, c1)
+        return nxt, _g.cache(nxt)
+
+    _, rest = jax.lax.scan(step, p2, None, length=13)   # caches of [3..15]
+    head = [jnp.stack([a, b, c], axis=0)
+            for a, b, c in zip(c0, c1, c2)]             # (3, 20, B) each
+    return Cached(*[jnp.concatenate([h, r], axis=0)
+                    for h, r in zip(head, rest)])
 
 
-def _gather_niels(table, digit) -> Niels:
-    """Constant (16,3,20) table, (…,) digit -> per-lane Niels entry."""
-    ent = jnp.take(table, digit, axis=0)
-    return Niels(ent[..., 0, :], ent[..., 1, :], ent[..., 2, :])
+def _gather_niels(digit) -> Niels:
+    """(B,) digit -> constant [j]B entry over (20, B)."""
+    tab = jnp.asarray(BASE_NIELS_T)              # (3, 20, 16)
+    ent = jnp.take(tab, digit, axis=2)           # (3, 20, B)
+    return Niels(ent[0], ent[1], ent[2])
 
 
 def _gather_cached(tab: Cached, digit) -> Cached:
-    idx = digit[..., None, None]
-    return Cached(*[
-        jnp.take_along_axis(c, idx, axis=-2)[..., 0, :] for c in tab])
+    """Per-lane table (16, 20, B) + (B,) digit -> (20, B) entry."""
+    idx = digit[None, None, :]
+    return Cached(*[jnp.take_along_axis(c, idx, axis=0)[0] for c in tab])
 
 
 def prepare_pubkey_tables(pub):
     """Per-validator precomputation, cacheable across commits: decompress
     A and build the 16-entry [j](-A) cached table for every lane.
 
-    pub (N,32) int32 -> (Cached tables stacked on the lane axis, (N,)
-    ok mask).  Validator sets are ~static across heights, so a node
+    pub (N, 32) int32 -> (Cached table, components (16, 20, N); (N,) ok
+    mask).  Validator sets are ~static across heights, so a node
     verifying consecutive commits re-uses these device arrays and the
     verify kernel skips decompression + table building entirely
     (TPU-side analogue of the reference's expanded-pubkey cache,
     ``crypto/ed25519/ed25519.go:42-67`` — but for whole validator sets).
     """
-    a_pt, ok_a = decompress_zip215(pub)
-    return _build_neg_a_table(neg_ext(a_pt)), ok_a
+    a_pt, ok_a = _g.decompress_zip215(jnp.transpose(pub))
+    return _build_neg_a_table(_g.neg_ext(a_pt)), ok_a
 
 
-def _verify_core(neg_a_tab, ok_a, rb, sb, blocks, active, lane_shape):
-    """Shared Straus ladder over precomputed per-lane [j](-A) tables."""
-    r_pt, ok_r = decompress_zip215(rb)
+def _verify_core(neg_a_tab, ok_a, rb, sb, blocks, active, n: int):
+    """Shared Straus ladder over precomputed per-lane [j](-A) tables.
+    ``rb`` batch-major (B, 32); curve work limb-major over (20, B)."""
+    r_pt, ok_r = _g.decompress_zip215(jnp.transpose(rb))
+
+    # scalar + hash pipeline stays batch-major: outputs are (B,) digit
+    # vectors consumed only as gather indices
     s_limbs = scalar.bytes32_to_limbs(sb)
     ok_s = scalar.lt_l(s_limbs)
     s_dig = scalar.nibbles(s_limbs)
-    h_dig = scalar.nibbles(scalar.reduce512(sha512.sha512_blocks(blocks, active)))
-
-    base_tab = jnp.asarray(BASE_NIELS)
+    h_dig = scalar.nibbles(scalar.reduce512(
+        sha512.sha512_blocks(blocks, active)))
 
     def window(i, acc):
         w = 63 - i
-        acc = dbl(dbl(dbl(dbl(acc))))
+        # 4 doublings, rolled: compile one dbl body, run it 4x
+        acc = jax.lax.fori_loop(0, 4, lambda _, a: _g.dbl(a), acc)
         ds = jax.lax.dynamic_index_in_dim(s_dig, w, axis=s_dig.ndim - 1,
                                           keepdims=False)
-        acc = add_niels(acc, _gather_niels(base_tab, ds))
+        acc = _g.add_niels(acc, _gather_niels(ds))
         dh = jax.lax.dynamic_index_in_dim(h_dig, w, axis=h_dig.ndim - 1,
                                           keepdims=False)
-        acc = add_cached(acc, _gather_cached(neg_a_tab, dh))
+        acc = _g.add_cached(acc, _gather_cached(neg_a_tab, dh))
         return acc
 
-    acc = jax.lax.fori_loop(0, 64, window, identity(lane_shape))
-    acc = add_cached(acc, cache(neg_ext(r_pt)))
-    return ok_a & ok_r & ok_s & is_identity(mul_by_cofactor(acc))
+    acc = jax.lax.fori_loop(0, 64, window, _g.identity((n,)))
+    acc = _g.add_cached(acc, _g.cache(_g.neg_ext(r_pt)))
+    return ok_a & ok_r & ok_s & _g.is_identity(_g.mul_by_cofactor(acc))
 
 
 def verify_padded(pub, rb, sb, blocks, active):
     """Verify a padded batch of Ed25519 signatures on device.
 
-    pub/rb/sb: (…,32) int32 bytes (pubkey, sig[0:32], sig[32:64]);
-    blocks: (…,NB,32) uint32 prepadded SHA blocks of R||A||M (sha512.host_pad);
-    active: (…,) int32 per-lane active block count.
-    Returns (…,) bool.  Jit per (batch-shape, NB) bucket.
+    pub/rb/sb: (B, 32) int32 bytes (pubkey, sig[0:32], sig[32:64]);
+    blocks: (B, NB, 32) uint32 prepadded SHA blocks of R||A||M
+    (sha512.host_pad); active: (B,) int32 per-lane active block count.
+    Returns (B,) bool.  Jit per (batch, NB) bucket.
     """
     neg_a_tab, ok_a = prepare_pubkey_tables(pub)
     return _verify_core(neg_a_tab, ok_a, rb, sb, blocks, active,
-                        pub.shape[:-1])
+                        pub.shape[0])
 
 
 def verify_padded_gather(tab, ok_a, idx, rb, sb, blocks, active):
@@ -138,7 +172,7 @@ def verify_padded_gather(tab, ok_a, idx, rb, sb, blocks, active):
     are ``prepare_pubkey_tables`` output for all N validators; ``idx``
     (B,) int32 selects this batch's lanes (commit scope, padded to the
     lane bucket).  Skips per-call decompression and table building."""
-    lane_tab = Cached(*[jnp.take(c, idx, axis=0) for c in tab])
+    lane_tab = Cached(*[jnp.take(c, idx, axis=2) for c in tab])
     lane_ok = jnp.take(ok_a, idx, axis=0)
     return _verify_core(lane_tab, lane_ok, rb, sb, blocks, active,
-                        idx.shape)
+                        idx.shape[0])

@@ -1,119 +1,37 @@
-"""Batched twisted-Edwards (ed25519 curve) point operations on limb arrays.
+"""Batch-major instantiation of the Edwards point formulas.
 
-Extended homogeneous coordinates (X:Y:Z:T), a = -1, with the standard
-hwcd-2008 unified addition and doubling formulas — the same formula family
-curve25519-voi/ref10 use (reference: the curve math behind
-``crypto/ed25519/ed25519.go``), chosen here because they are branch-free and
-vmap cleanly over the signature batch.
-
-Point decompression implements **ZIP-215** (permissive) decoding: the
-y-encoding may be non-canonical (y >= p), x = 0 with sign bit 1 is accepted,
-and small/mixed-order points decode fine; the only failure is a non-square
-x^2 candidate.  This matches CometBFT's vote-signature semantics exactly.
-
-Representations (each component a (…,20) int32 limb array):
-- extended: ``(X, Y, Z, T)``  with x = X/Z, y = Y/Z, T = XY/Z
-- cached:   ``(Y+X, Y-X, 2Z, 2dT)``   (general addition operand)
-- niels:    ``(Y+X, Y-X, 2dXY)``      (affine table entry, Z = 1)
+The formulas themselves live once in ``ops/group.py`` (layout-generic);
+this module binds them to the batch-major field layout (``ops/fe``,
+elements ``(…, 20)``) and re-exports the classic names.  The PRODUCTION
+verify kernel (``ops/ed25519.py``) uses the limb-major instantiation
+instead — this one remains the differential-test surface (the
+oracle-comparison tests in ``tests/test_ed25519_kernel.py`` drive point
+ops in the oracle's natural batch-major shapes) plus the home of
+``compress`` (a host/test-only op: the verify kernels never compress).
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple
-
-import numpy as np
 import jax.numpy as jnp
 
 from . import fe
+from .group import Cached, Ext, Niels, make_group
 
+_g = make_group(fe)
 
-class Ext(NamedTuple):
-    x: jnp.ndarray
-    y: jnp.ndarray
-    z: jnp.ndarray
-    t: jnp.ndarray
+identity = _g.identity
+cache = _g.cache
+neg_ext = _g.neg_ext
+dbl = _g.dbl
+add_cached = _g.add_cached
+add_niels = _g.add_niels
+decompress_zip215 = _g.decompress_zip215
+mul_by_cofactor = _g.mul_by_cofactor
+is_identity = _g.is_identity
 
-
-class Cached(NamedTuple):
-    ypx: jnp.ndarray
-    ymx: jnp.ndarray
-    z2: jnp.ndarray
-    t2d: jnp.ndarray
-
-
-class Niels(NamedTuple):
-    ypx: jnp.ndarray
-    ymx: jnp.ndarray
-    t2d: jnp.ndarray
-
-
-def identity(shape=()) -> Ext:
-    zero = jnp.broadcast_to(jnp.asarray(fe.ZERO_LIMBS), shape + (fe.NLIMBS,))
-    one = jnp.broadcast_to(jnp.asarray(fe.ONE_LIMBS), shape + (fe.NLIMBS,))
-    return Ext(zero, one, one, zero)
-
-
-def cache(p: Ext) -> Cached:
-    return Cached(fe.add(p.y, p.x), fe.sub(p.y, p.x), fe.add(p.z, p.z),
-                  fe.mul(p.t, jnp.asarray(fe.D2_LIMBS)))
-
-
-def neg_ext(p: Ext) -> Ext:
-    return Ext(fe.neg(p.x), p.y, p.z, fe.neg(p.t))
-
-
-def dbl(p: Ext) -> Ext:
-    a = fe.square(p.x)
-    b = fe.square(p.y)
-    c = fe.add(fe.square(p.z), fe.square(p.z))
-    h = fe.add(a, b)
-    e = fe.sub(h, fe.square(fe.add(p.x, p.y)))
-    g = fe.sub(a, b)
-    f = fe.add(c, g)
-    return Ext(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
-
-
-def add_cached(p: Ext, q: Cached) -> Ext:
-    a = fe.mul(fe.sub(p.y, p.x), q.ymx)
-    b = fe.mul(fe.add(p.y, p.x), q.ypx)
-    c = fe.mul(p.t, q.t2d)
-    d = fe.mul(p.z, q.z2)
-    e = fe.sub(b, a)
-    f = fe.sub(d, c)
-    g = fe.add(d, c)
-    h = fe.add(b, a)
-    return Ext(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
-
-
-def add_niels(p: Ext, q: Niels) -> Ext:
-    a = fe.mul(fe.sub(p.y, p.x), q.ymx)
-    b = fe.mul(fe.add(p.y, p.x), q.ypx)
-    c = fe.mul(p.t, q.t2d)
-    d = fe.add(p.z, p.z)
-    e = fe.sub(b, a)
-    f = fe.sub(d, c)
-    g = fe.add(d, c)
-    h = fe.add(b, a)
-    return Ext(fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
-
-
-def decompress_zip215(enc_bytes):
-    """ZIP-215 point decoding.  enc_bytes (…,32) int32 in [0,256).
-
-    Returns ``(Ext, ok)``; for failed rows the point content is arbitrary but
-    arithmetic-safe (callers mask with ``ok``).
-    """
-    sign = (enc_bytes[..., 31].astype(jnp.int32) >> 7) & 1
-    y = fe.from_bytes32(enc_bytes, mask_bit255=True)   # value < 2^255, loose ok
-    yy = fe.square(y)
-    u = fe.sub(yy, jnp.asarray(fe.ONE_LIMBS))
-    v = fe.add(fe.mul(yy, jnp.asarray(fe.D_LIMBS)), jnp.asarray(fe.ONE_LIMBS))
-    x, ok = fe.sqrt_ratio(u, v)
-    x = fe.freeze(x)
-    flip = (x[..., 0] & 1) != sign
-    x = fe.select(flip, fe.neg(x), x)
-    return Ext(x, y, jnp.broadcast_to(jnp.asarray(fe.ONE_LIMBS), x.shape),
-               fe.mul(x, y)), ok
+__all__ = ["Ext", "Cached", "Niels", "identity", "cache", "neg_ext", "dbl",
+           "add_cached", "add_niels", "decompress_zip215", "compress",
+           "mul_by_cofactor", "is_identity"]
 
 
 def compress(p: Ext):
@@ -122,12 +40,3 @@ def compress(p: Ext):
     x = fe.freeze(fe.mul(p.x, zinv))
     y = fe.to_bytes32(fe.mul(p.y, zinv))
     return y.at[..., 31].set(y[..., 31] | ((x[..., 0] & 1) << 7))
-
-
-def mul_by_cofactor(p: Ext) -> Ext:
-    return dbl(dbl(dbl(p)))
-
-
-def is_identity(p: Ext):
-    """(…,) bool: projective identity check X == 0 and Y == Z (mod p)."""
-    return fe.is_zero(p.x) & fe.eq(p.y, p.z)

@@ -182,6 +182,29 @@ def select(mask, a, b):
     return jnp.where(mask[..., None], a, b)
 
 
+# Layout hooks consumed by ops/group.py (the layout-generic point
+# formulas); ops/fe_lm.py provides the limb-major counterparts.
+
+def const(x: int) -> jnp.ndarray:
+    """Python int -> (20,) int32 limb constant (broadcasts over lanes)."""
+    return jnp.asarray(limbs_from_int(x % P_INT))
+
+
+def bcast(c, lane_shape) -> jnp.ndarray:
+    """Broadcast a (20,) constant over a lane shape -> lane_shape + (20,)."""
+    return jnp.broadcast_to(c, tuple(lane_shape) + (NLIMBS,))
+
+
+def sign_bit(enc):
+    """(…, 32) encoded bytes -> (…,) Edwards sign bit."""
+    return (enc[..., 31].astype(jnp.int32) >> 7) & 1
+
+
+def limb0(x):
+    """Lowest limb, (…,) — parity source for frozen elements."""
+    return x[..., 0]
+
+
 def freeze(a):
     """Loose -> canonical representative in [0, p). Sequential exact carry."""
     # exact carry chain; value < 20 * LIMB_MAX * 2^247 < 2^261
@@ -289,11 +312,10 @@ def to_bytes32(a):
 
 
 def _sq_n(a, n: int):
-    """n successive squarings; rolled into fori_loop to keep graphs small."""
-    if n <= 4:
-        for _ in range(n):
-            a = square(a)
-        return a
+    """n successive squarings; rolled into fori_loop to keep graphs small
+    (compile time scales superlinearly with unrolled op count)."""
+    if n <= 1:
+        return square(a) if n else a
     return jax.lax.fori_loop(0, n, lambda _, x: square(x), a)
 
 

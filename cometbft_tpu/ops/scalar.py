@@ -93,14 +93,78 @@ def lt_l(x20):
     return lt
 
 
-def nibbles(x20):
-    """Canonical 20 limbs (< 2^256) -> (…,64) radix-16 digits, LSB first."""
+def nibbles_k(x, nlimbs: int, ndigits: int):
+    """Canonical 13-bit limbs (…,nlimbs) -> (…,ndigits) radix-16 digits,
+    LSB first (generalized digit extraction; the RLC coefficients are
+    10-limb/32-digit, full scalars 20-limb/64-digit)."""
     digs = []
-    for n in range(64):
+    for n in range(ndigits):
         bit0 = 4 * n
         j, s = divmod(bit0, RADIX)
-        d = x20[..., j] >> s
-        if s > RADIX - 4 and j + 1 < NL:
-            d = d | (x20[..., j + 1] << (RADIX - s))
+        d = x[..., j] >> s
+        if s > RADIX - 4 and j + 1 < nlimbs:
+            d = d | (x[..., j + 1] << (RADIX - s))
         digs.append(d & 15)
     return jnp.stack(digs, axis=-1)
+
+
+def nibbles(x20):
+    """Canonical 20 limbs (< 2^256) -> (…,64) radix-16 digits, LSB first."""
+    return nibbles_k(x20, NL, 64)
+
+
+# ----- RLC batch-verification scalar arithmetic (ops/rlc.py) -----------
+#
+# The random-linear-combination kernel needs two more mod-L ops, both
+# with the same "reduce to < 2^256, correct mod L" contract as reduce512
+# (sufficient under the cofactored check — see module docstring):
+# z·x products and batch sums.
+
+Z_NLIMBS = 10                    # 130 bits: holds a 128-bit coefficient
+
+
+def _fold_to_256(x20, c):
+    """Shared endgame: fold an exact-carry residue (x20 < 2^260 in 20
+    limbs, overflow carry c < 2^11) down to < 2^256 preserving mod L.
+    The 4+4 fold counts inherit reduce512's bounds (its carry after the
+    first fold is the larger: 2^11)."""
+    for _ in range(4):
+        cols = x20 + c[..., None] * jnp.asarray(M260)
+        x20, c = _carry_exact(cols, NL)
+    for _ in range(4):
+        x20 = _fold256(x20)
+    return x20
+
+
+def mul_mod_l(x20, z10):
+    """(…,20) canonical (< 2^256) x (…,10) canonical (< 2^130) ->
+    (…,20) canonical, < 2^256 and ≡ x·z (mod L).
+
+    Schoolbook columns: 29 columns, each ≤ 10·MASK² < 2^31 so the whole
+    product stays int32; the < 2^386 result folds its 10 high limbs
+    through TAB (2^(13·(20+j)) mod L) exactly like reduce512's matmul
+    fold, then rides the shared endgame."""
+    cols = jnp.zeros(jnp.broadcast_shapes(x20.shape[:-1], z10.shape[:-1])
+                     + (NL + Z_NLIMBS - 1,), jnp.int32)
+    for i in range(Z_NLIMBS):
+        cols = cols.at[..., i:i + NL].add(z10[..., i:i + 1] * x20)
+    x30, c = _carry_exact(cols, NL + Z_NLIMBS)
+    lo, hi = x30[..., :NL], x30[..., NL:]
+    cols2 = lo + jnp.einsum("...j,jk->...k", hi,
+                            jnp.asarray(TAB[:Z_NLIMBS]),
+                            preferred_element_type=jnp.int32)
+    x20_, c = _carry_exact(cols2, NL)
+    return _fold_to_256(x20_, c)
+
+
+def sum_mod_l(x, axis: int = 0):
+    """Sum canonical 20-limb values (< 2^256 each) over ``axis`` ->
+    (…,20) canonical, < 2^256 and ≡ the sum (mod L).  Column sums must
+    stay int32: requires at most 2^17 summands (the lane cap is 4096)."""
+    assert x.shape[axis] <= (1 << 17)
+    cols = jnp.sum(x, axis=axis)             # ≤ 2^17·MASK < 2^31 per col
+    x21, c = _carry_exact(cols, NL + 1)      # value < 2^274 -> c == 0
+    # fold limb 20 (≤ MASK) at the 2^260 boundary via M260
+    cols2 = x21[..., :NL] + x21[..., NL:] * jnp.asarray(M260)
+    x20, c = _carry_exact(cols2, NL)         # < 2^261 -> c ≤ 1 ≤ 2^11
+    return _fold_to_256(x20, c)

@@ -48,13 +48,19 @@ def make_light_chain(n_blocks: int, n_vals: int = 4, *,
                      chain_id: str = "light-chain", power: int = 10,
                      rotate_every: int = 0, seed: bytes = b"lc",
                      base_time_ns: int = 1_700_000_000_000_000_000,
-                     block_interval_ns: int = 1_000_000_000):
+                     block_interval_ns: int = 1_000_000_000,
+                     fork_at: int = 0, fork_skew_ns: int = 0):
     """Deterministic signed header chain for light-client tests/benches
     (role of the reference's ``light/helpers_test.go`` genLightBlocks).
 
     Returns ``list[LightBlock]`` for heights 1..n_blocks.  With
     ``rotate_every=k`` one validator is replaced every k blocks, so long
-    skips eventually lose 1/3 overlap and force bisection."""
+    skips eventually lose 1/3 overlap and force bisection.  With
+    ``fork_at=f`` (and a nonzero ``fork_skew_ns``), blocks above height
+    f get skewed timestamps: two calls differing only in these args
+    share an identical, validly-signed prefix through f and diverge
+    from f+1 — a real fork for detector tests (the same validator set
+    double-signs both branches)."""
     from .crypto.keys import Ed25519PrivKey
     from .light.types import LightBlock
     from .types.block_id import BlockID, PartSetHeader
@@ -85,7 +91,8 @@ def make_light_chain(n_blocks: int, n_vals: int = 4, *,
                  Validator(new_priv.pub_key(), power)])
         header = Header(
             chain_id=chain_id, height=h,
-            time_ns=base_time_ns + h * block_interval_ns,
+            time_ns=base_time_ns + h * block_interval_ns
+            + (fork_skew_ns if fork_at and h > fork_at else 0),
             last_block_id=prev_bid,
             validators_hash=vals.hash(),
             next_validators_hash=next_vals.hash(),

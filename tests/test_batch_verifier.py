@@ -2,6 +2,11 @@
 and the multi-chip sharded path on the virtual 8-device mesh."""
 
 import numpy as np
+import pytest
+
+# first run on a cold XLA cache compiles several mesh-sharded kernel
+# shapes at ~2 min each on this box; warm runs take seconds
+pytestmark = pytest.mark.timeout(1200)
 
 from cometbft_tpu.crypto import _ed25519_py as ref
 from cometbft_tpu.crypto.batch import (CpuBatchVerifier, TpuBatchVerifier,

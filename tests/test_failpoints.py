@@ -112,6 +112,68 @@ async def _assert_recovers_and_progresses(stage):
     assert info["response"]["last_block_height"] >= first_h - 1
 
 
+def test_app_ahead_crash_window_recovers_without_reexecution():
+    """ADVICE r4 (medium): crash between app Commit and state save
+    (exec:after-app-commit) leaves app_height == store_height ==
+    state + 1 for a PERSISTENT app.  The handshake must advance state
+    from the persisted finalize response — sending the app NOTHING (a
+    re-execution would double-apply the block) — mirroring the
+    reference's mock-app replayBlock case (replay.go ReplayBlocks)."""
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.consensus.replay import Handshaker
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.proxy.multi_app_conn import AppConns
+    from cometbft_tpu.sm.execution import BlockExecutor
+    from cometbft_tpu.storage.statestore import rollback_state
+    from cometbft_tpu.testing import make_inproc_network
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    async def main():
+        net = await make_inproc_network(1)
+        await net.start()
+        await net.wait_for_height(5)
+        await net.stop()
+        node = net.nodes[0]
+
+        # crash window: state back to H-1; block store AND the live
+        # persistent app both remain at H
+        rollback_state(node.state_store, node.block_store)
+        state = node.state_store.load()
+        store_h = node.block_store.height()
+        assert store_h == state.last_block_height + 1
+        app = node.app                 # persistent: already committed H
+        assert app.height == store_h
+        want_app_hash = app.app_hash
+
+        calls: list[str] = []
+        orig_fin, orig_commit = app.finalize_block, app.commit
+        app.finalize_block = lambda req: (
+            calls.append(f"finalize:{req.height}") or orig_fin(req))
+        app.commit = lambda: calls.append("commit") or orig_commit()
+
+        async def creator():
+            return LocalClient(app)
+
+        conns = AppConns(creator)
+        await conns.start()
+        execu = BlockExecutor(node.state_store, node.block_store,
+                              conns.consensus,
+                              CListMempool(LocalClient(app)),
+                              backend="cpu")
+        hs = Handshaker(node.state_store, node.block_store,
+                        GenesisDoc(chain_id="test-net", validators=[]))
+        new_state = await hs.handshake(state, conns, execu)
+
+        assert calls == [], f"app must not re-execute: {calls}"
+        assert new_state.last_block_height == store_h
+        assert new_state.app_hash == want_app_hash
+        # the persisted state matches the returned one (restart-safe)
+        assert node.state_store.load().last_block_height == store_h
+        return True
+
+    assert asyncio.run(main())
+
+
 def test_crash_window_replay_applies_each_block_exactly_once():
     """Regression for the recovery-ordering bug: with the block store one
     ahead of state (crash between SaveBlock and ApplyBlock) and the app

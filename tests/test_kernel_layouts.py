@@ -1,0 +1,86 @@
+"""Layout-promotion tests: the production kernel is limb-major (20,B)
+internally (ops/fe_lm.py via ops/group.py); the batch-major
+instantiation (ops/edwards.py over ops/fe.py) remains the test surface.
+These tests pin (a) the two group instantiations against each other on
+the point-op level and (b) the production kernel's verdicts on the
+ZIP-215 edge corpus against the pure-Python oracle lane by lane —
+covering what the deleted limb-major/batch-major twin comparison used
+to, but with the oracle as the single source of truth."""
+
+import numpy as np
+import jax
+import pytest
+
+pytestmark = pytest.mark.timeout(900)
+
+from cometbft_tpu.crypto import _ed25519_py as ref
+from cometbft_tpu.ops import ed25519, fe, fe_lm
+from cometbft_tpu.ops.group import make_group
+from cometbft_tpu.testing import dense_signature_batch
+
+_gbm = make_group(fe)
+_glm = make_group(fe_lm)
+
+
+def test_group_instantiations_agree_on_point_ops():
+    """dbl/add/decompress agree between the batch-major and limb-major
+    field layouts on random curve points (transposition at the edges)."""
+    rng = np.random.default_rng(5)
+    encs = []
+    while len(encs) < 16:
+        cand = rng.bytes(32)
+        if ref.pt_decompress_zip215(cand) is not None:
+            encs.append(cand)
+    arr = np.stack([np.frombuffer(e, np.uint8) for e in encs]).astype(np.int32)
+
+    def bm(enc):
+        p, ok = _gbm.decompress_zip215(enc)
+        d = _gbm.dbl(p)
+        s = _gbm.add_cached(d, _gbm.cache(p))      # 3P
+        return fe.freeze(fe.mul(s.x, fe.invert(s.z))), ok
+
+    def lm(enc_T):
+        p, ok = _glm.decompress_zip215(enc_T)
+        d = _glm.dbl(p)
+        s = _glm.add_cached(d, _glm.cache(p))
+        return fe_lm.freeze(fe_lm.mul(s.x, fe_lm.invert(s.z))), ok
+
+    x_bm, ok_bm = jax.jit(bm)(arr)
+    x_lm, ok_lm = jax.jit(lm)(arr.T)
+    assert np.asarray(ok_bm).all() and np.asarray(ok_lm).all()
+    assert (np.asarray(x_bm) == np.asarray(x_lm).T).all()
+
+
+def test_production_kernel_zip215_edge_corpus_vs_oracle():
+    """Edge encodings (sign-bit families, non-canonical y, S >= L) get
+    the oracle's verdict from the production (limb-major) kernel."""
+    args, items = dense_signature_batch(24, msg_len=80, seed=31)
+    pub, rb, sb, blocks, active = [np.asarray(a).copy() for a in args]
+    pub[0, 31] |= 0x80      # sign-bit x=0 family
+    rb[1, 31] |= 0x80
+    pub[2] = 0; pub[2, 0] = 1                      # y = 0 + sign 0
+    rb[3] = 255                                    # non-canonical y >= p
+    sb[4] = 255                                    # S >= L (must reject)
+    got = np.asarray(jax.jit(ed25519.verify_padded)(
+        pub, rb, sb, blocks, active))
+    assert not got[4]                              # sanity: S>=L rejected
+    for i, (pk, msg, sig) in enumerate(items):
+        pk2 = bytes(pub[i].astype(np.uint8))
+        sig2 = bytes(rb[i].astype(np.uint8)) + bytes(sb[i].astype(np.uint8))
+        want = ref.verify_zip215(pk2, msg, sig2)
+        assert bool(got[i]) == want, i
+
+
+def test_production_kernel_tampered_lanes_vs_oracle():
+    args, items = dense_signature_batch(24, msg_len=80, seed=7)
+    pub, rb, sb, blocks, active = [np.asarray(a).copy() for a in args]
+    sb[3, 0] ^= 1          # bad S
+    rb[7, 31] ^= 0x40      # bad R encoding
+    pub[11, 5] ^= 2        # bad A
+    got = np.asarray(jax.jit(ed25519.verify_padded)(
+        pub, rb, sb, blocks, active))
+    assert not got[3] and not got[7] and not got[11]
+    for i, (pk, msg, sig) in enumerate(items):
+        pk2 = bytes(pub[i].astype(np.uint8))
+        sig2 = bytes(rb[i].astype(np.uint8)) + bytes(sb[i].astype(np.uint8))
+        assert bool(got[i]) == ref.verify_zip215(pk2, msg, sig2), i

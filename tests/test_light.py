@@ -36,13 +36,15 @@ def _now(chain):
 
 
 class ChainProvider(Provider):
-    """Serves a pre-generated chain; counts fetches."""
+    """Serves a pre-generated chain; counts fetches, records reported
+    evidence (the detector's two-sided dispatch)."""
 
     def __init__(self, chain, name="prov"):
         self.by_height = {lb.height: lb for lb in chain}
         self.tip = max(self.by_height)
         self.name = name
         self.fetches = 0
+        self.reported = []
 
     def id(self):
         return self.name
@@ -55,6 +57,9 @@ class ChainProvider(Provider):
         if lb is None:
             raise ErrLightBlockNotFound(f"{self.name}: {height}")
         return lb
+
+    async def report_evidence(self, evidence):
+        self.reported.append(evidence)
 
 
 def test_verify_adjacent_ok_and_bad_linkage():
@@ -167,6 +172,79 @@ def test_client_detects_forked_witness():
             await client.verify_light_block_at_height(25)
         assert exc.value.witness_id == "witness"
         assert exc.value.evidence is not None
+        return True
+
+    assert run(main())
+
+
+def test_detector_trace_walk_two_sided_evidence():
+    """VERDICT r4 next 5: a fork at height H with divergence point H-k
+    must yield evidence whose common_height is the TRUE fork height
+    (trace examination, detector.go:285), two-sided evidence, and
+    delivery to both honest parties — the witness receives the case
+    against the primary, the primary the case against the witness."""
+    H, F = 30, 22                       # tip and fork heights
+    chain = make_light_chain(H, n_vals=4)
+    forked = make_light_chain(H, n_vals=4, fork_at=F, fork_skew_ns=777)
+    # sanity: shared validly-signed prefix, divergent suffix
+    assert chain[F - 1].header.hash() == forked[F - 1].header.hash()
+    assert chain[F].header.hash() != forked[F].header.hash()
+
+    primary = ChainProvider(chain, "primary")
+    witness = ChainProvider(forked, "witness")
+    client = Client(CHAIN, TrustOptions(PERIOD, 1, chain[0].header.hash()),
+                    primary, witnesses=[witness], mode=SEQUENTIAL,
+                    backend="cpu", now_ns=lambda: _now(chain))
+
+    async def main():
+        with pytest.raises(DivergenceError) as exc:
+            await client.verify_light_block_at_height(H)
+        e = exc.value
+        assert e.common_height == F
+        # primary's side of the fork at the first divergent height
+        assert e.evidence_against_primary.common_height == F
+        assert e.evidence_against_primary.conflicting_height == F + 1
+        assert e.evidence_against_primary.conflicting_header_hash == \
+            chain[F].header.hash()
+        # witness's side
+        assert e.evidence_against_witness.common_height == F
+        assert e.evidence_against_witness.conflicting_height == F + 1
+        assert e.evidence_against_witness.conflicting_header_hash == \
+            forked[F].header.hash()
+        # each honest party received the case against the other side
+        assert [ev.conflicting_header_hash for ev in witness.reported] == \
+            [chain[F].header.hash()]
+        assert [ev.conflicting_header_hash for ev in primary.reported] == \
+            [forked[F].header.hash()]
+        # nothing divergent was persisted as trusted
+        assert client.store.get(H) is None
+        return True
+
+    assert run(main())
+
+
+def test_detector_drops_persistently_lagging_witness():
+    """VERDICT r4 weak 7: a witness that can never serve the height is
+    struck out after MAX_WITNESS_LAG_STRIKES consecutive misses instead
+    of being retried forever; an agreeing witness survives."""
+    from cometbft_tpu.light.detector import (MAX_WITNESS_LAG_STRIKES,
+                                             detect_divergence)
+
+    chain = make_light_chain(10, n_vals=4)
+    primary = ChainProvider(chain, "primary")
+    laggard = ChainProvider(chain[:2], "laggard")     # tip stuck at 2
+    healthy = ChainProvider(chain, "healthy")
+    client = Client(CHAIN, TrustOptions(PERIOD, 1, chain[0].header.hash()),
+                    primary, witnesses=[laggard, healthy], backend="cpu",
+                    now_ns=lambda: _now(chain))
+
+    async def main():
+        client.store.save(chain[0])
+        for i in range(MAX_WITNESS_LAG_STRIKES):
+            assert laggard in client.witnesses, f"dropped too early ({i})"
+            await detect_divergence(client, chain[7], _now(chain))
+        assert laggard not in client.witnesses
+        assert healthy in client.witnesses
         return True
 
     assert run(main())
