@@ -16,9 +16,9 @@
 # 3 = FORCE=1 rehearsal attempted under the canonical tpu TAG.
 set -u
 cd "$(dirname "$0")/.."
-ROUND="${ROUND:-04}"
+ROUND="${ROUND:-05}"
 TAG="${TAG:-tpu}"
-MODES="${MODES:-commit verifycommit light blocksync stress node}"
+MODES="${MODES:-commit verifycommit p50commit light blocksync stress node}"
 LOG=docs/bench/tpu_probe_log.txt
 STAMP=$(date -u +%Y-%m-%dT%H:%M)
 
@@ -71,9 +71,10 @@ run_mode () {  # $1 = mode name, rest = env pairs
     fi
 }
 
-# the five BASELINE modes at BASELINE shapes, plus end-to-end node mode
+# the BASELINE modes at BASELINE shapes, plus end-to-end node mode
 run_mode commit
 run_mode verifycommit BENCH_VALS=150
+run_mode p50commit    BENCH_VALS=10000
 run_mode light        BENCH_HEADERS=1000 BENCH_VALS=150
 run_mode blocksync    BENCH_BLOCKS=500 BENCH_VALS=1000
 run_mode stress       BENCH_VALS=10000 BENCH_SECP_PCT=10
