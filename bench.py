@@ -29,6 +29,8 @@ so the driver always records a result.
              internal/blocksync/reactor.go:495 redesign)
 - light:     1000-header sequential light sync on the batched verifier
              (BASELINE configs[3], light/client.go:609 redesign)
+- merkle:    10k-leaf root+proofs + part-set proof build through the
+             level-order dispatch vs the recursive hashlib reference
 """
 
 from __future__ import annotations
@@ -301,6 +303,79 @@ def _child_stress(backend: str, n_vals: int, secp_pct: int) -> None:
     }), flush=True)
 
 
+def _child_merkle(backend: str, n_leaves: int, block_kb: int) -> None:
+    """Merkle subsystem bench: 10k-leaf root+proofs build and a part-set
+    proof build, production dispatch vs the recursive hashlib reference
+    (the seed implementation).  On an accelerator backend the level
+    kernel engages through the normal gate; on cpu the native/hashlib
+    engines serve (the kernel measured slower than hashlib on host)."""
+    import numpy as np
+
+    def note(msg):
+        print(f"[bench:merkle:{backend}] {msg}", file=sys.stderr, flush=True)
+
+    if backend == "cpu":
+        from cometbft_tpu.jaxenv import force_cpu_backend
+
+        force_cpu_backend()
+    else:
+        from cometbft_tpu.jaxenv import enable_compile_cache
+
+        enable_compile_cache()
+        import jax
+
+        if jax.devices()[0].platform == "cpu":
+            raise RuntimeError("requested accelerator but got CPU backend")
+
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.types.part_set import PartSet
+
+    rng = np.random.default_rng(2024)
+    leaves = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+              for _ in range(n_leaves)]
+
+    def best(fn, reps=5):
+        t = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            t = min(t, time.perf_counter() - t0)
+        return t
+
+    note(f"{n_leaves}-leaf root+proofs: production dispatch vs recursive")
+    ref_root, _ = merkle.proofs_from_byte_slices_reference(leaves)
+    root, _ = merkle.proofs_from_byte_slices(leaves)
+    assert root == ref_root, "engine mismatch — dispatch is NOT bit-identical"
+    t_batched = best(lambda: merkle.proofs_from_byte_slices(leaves))
+    t_recursive = best(lambda: merkle.proofs_from_byte_slices_reference(
+        leaves))
+
+    note("root-only (app-hash shape)")
+    t_root = best(lambda: merkle.hash_from_byte_slices_fast(leaves))
+    t_root_ref = best(lambda: merkle.hash_from_byte_slices(leaves))
+
+    note(f"part-set proof build ({block_kb} kB block, 1 kB parts)")
+    data = rng.integers(0, 256, block_kb * 1024, dtype=np.uint8).tobytes()
+    chunks = [data[i:i + 1024] for i in range(0, len(data), 1024)]
+    t_ps = best(lambda: PartSet.from_data(data, part_size=1024))
+    t_ps_ref = best(lambda: merkle.proofs_from_byte_slices_reference(chunks))
+
+    print(json.dumps({
+        "metric": f"merkle {n_leaves}-leaf root+proofs build "
+                  "(level-order dispatch vs recursive hashlib)",
+        "value": round(t_batched * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(t_recursive / t_batched, 2),
+        "recursive_ms": round(t_recursive * 1e3, 3),
+        "root_only_ms": round(t_root * 1e3, 3),
+        "root_only_vs_recursive": round(t_root_ref / t_root, 2),
+        "partset_build_ms": round(t_ps * 1e3, 3),
+        "partset_vs_recursive": round(t_ps_ref / t_ps, 2),
+        "n_leaves": n_leaves,
+        "backend": backend,
+    }), flush=True)
+
+
 def _child_p50commit(backend: str, n_vals: int) -> None:
     """BASELINE's latency bar: p50 VerifyCommit @10k validators < 5 ms.
     Times the PRODUCTION dense dispatch (``crypto/batch.verify_dense``
@@ -539,6 +614,12 @@ def _child_main(backend: str, nsig: int) -> None:
     if mode == "p50commit":
         return _child_p50commit(backend,
                                 int(os.environ.get("BENCH_VALS", "10000")))
+    if mode == "merkle":
+        return _child_merkle(backend,
+                             int(os.environ.get("BENCH_MERKLE_LEAVES",
+                                                "10000")),
+                             int(os.environ.get("BENCH_MERKLE_BLOCK_KB",
+                                                "4096")))
 
     def note(msg):
         print(f"[bench:{backend}] {msg}", file=sys.stderr, flush=True)
@@ -795,7 +876,8 @@ def main() -> None:
         # vs_baseline against its OWN in-process single-loop run, which
         # box contention can skew across attempts.  verifycommit is a
         # latency (lower wins); every other mode is a rate.
-        if os.environ.get("BENCH_MODE") in ("verifycommit", "p50commit"):
+        if os.environ.get("BENCH_MODE") in ("verifycommit", "p50commit",
+                                            "merkle"):
             best = min(results,
                        key=lambda r: r.get("value") or float("inf"))
         else:
@@ -819,6 +901,7 @@ def main() -> None:
         "blocksync": ("blocksync replay, blocks/sec", "blocks/s"),
         "verifycommit": ("VerifyCommitLight latency", "ms"),
         "p50commit": ("p50 VerifyCommit latency @10k validators", "ms"),
+        "merkle": ("merkle 10k-leaf root+proofs build", "ms"),
         "stress": ("mixed-key extended-commit verify", "sigs/s"),
         "node": ("single-node end-to-end throughput", "tx/s"),
     }.get(mode, (mode, "ops/s"))

@@ -185,6 +185,9 @@ class BaseConfig:
     # before host fallback (crypto/batch._device_call); 0 = library default
     device_wait_s: float = 0.0
     device_warmup: bool = True
+    # leaf count before merkle tree hashing considers the batched device
+    # kernel (crypto/merkle; accelerator-gated either way)
+    merkle_kernel_min_leaves: int = 2048
 
 
 @dataclass
@@ -230,10 +233,18 @@ class Config:
 
     @classmethod
     def load(cls, path: str) -> "Config":
-        import tomllib
+        try:
+            import tomllib
+        except ImportError:      # Python < 3.11: no stdlib TOML reader.
+            tomllib = None       # The emitter below only writes flat
+            # [section] key=value forms, so the minimal parser covers
+            # every file this module can produce.
 
         with open(path, "rb") as f:
-            doc = tomllib.load(f)
+            if tomllib is not None:
+                doc = tomllib.load(f)
+            else:
+                doc = _parse_flat_toml(f.read().decode())
         cfg = cls()
         for section_name, values in doc.items():
             section = getattr(cfg, section_name, None)
@@ -291,3 +302,88 @@ def _toml_value(v) -> str:
     if isinstance(v, list):
         return "[" + ", ".join(_toml_value(x) for x in v) + "]"
     raise ConfigError(f"cannot emit TOML for {type(v).__name__}")
+
+
+def _parse_flat_toml(text: str) -> dict:
+    """Parser of last resort for the flat ``[section] key = value`` TOML
+    this repo emits (str/bool/int/float and flat lists): the stdlib
+    ``tomllib`` needs Python 3.11 and some images run 3.10.  Covers both
+    emitters — :meth:`Config.to_toml` (named sections only) and the e2e
+    ``manifest_to_toml`` (root-level keys first, dotted ``[node.v1]``
+    tables).  Anything else is a :class:`ConfigError`, same as an
+    unknown key."""
+    doc: dict = {}
+    section = doc               # root-level keys land in the document
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = doc
+            for part in line[1:-1].strip().split("."):
+                section = section.setdefault(part.strip(), {})
+                if not isinstance(section, dict):
+                    raise ConfigError(
+                        f"config line {ln}: table {line!r} collides with "
+                        f"an earlier key")
+            continue
+        key, eq, rest = line.partition("=")
+        if not eq:
+            raise ConfigError(f"malformed config line {ln}: {raw!r}")
+        rest = rest.strip()
+        val, end = _parse_toml_scalar(rest, 0)
+        tail = rest[end:].lstrip()
+        if tail and not tail.startswith("#"):
+            raise ConfigError(f"trailing data on config line {ln}: {raw!r}")
+        section[key.strip()] = val
+    return doc
+
+
+def _parse_toml_scalar(s: str, i: int):
+    """One value starting at ``s[i]``; returns (value, index-past-it)."""
+    if s.startswith('"', i):
+        out, i = [], i + 1
+        while i < len(s):
+            c = s[i]
+            if c == "\\":
+                nxt = s[i + 1] if i + 1 < len(s) else ""
+                if nxt not in ('"', "\\"):
+                    raise ConfigError(f"bad escape in config string: {s!r}")
+                out.append(nxt)
+                i += 2
+            elif c == '"':
+                return "".join(out), i + 1
+            else:
+                out.append(c)
+                i += 1
+        raise ConfigError(f"unterminated config string: {s!r}")
+    if s.startswith("[", i):
+        vals: list = []
+        i += 1
+        while True:
+            while i < len(s) and s[i] in " \t":
+                i += 1
+            if i >= len(s):
+                raise ConfigError(f"unterminated config list: {s!r}")
+            if s[i] == "]":
+                return vals, i + 1
+            v, i = _parse_toml_scalar(s, i)
+            vals.append(v)
+            while i < len(s) and s[i] in " \t":
+                i += 1
+            if i < len(s) and s[i] == ",":
+                i += 1
+    j = i
+    while j < len(s) and s[j] not in ",] \t#":
+        j += 1
+    tok = s[i:j]
+    if tok == "true":
+        return True, j
+    if tok == "false":
+        return False, j
+    for cast in (int, float):
+        try:
+            return cast(tok), j
+        except ValueError:
+            pass
+    raise ConfigError(f"bad config value {tok!r}")

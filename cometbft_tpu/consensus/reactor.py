@@ -565,4 +565,4 @@ def _part_from_wire(d: dict) -> Part:
     from ..crypto.merkle import Proof
 
     return Part(d["i"], d["b"],
-                Proof(d["pt"], d["pi"], d["pl"], list(d["pa"])))
+                Proof(d["pt"], d["pi"], d["pl"], tuple(d["pa"])))

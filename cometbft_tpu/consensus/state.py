@@ -911,6 +911,6 @@ def _msg_from_wire(kind: str, data):
 
         part = Part(data["i"], data["b"],
                     Proof(data["pt"], data["pi"], data["pl"],
-                          list(data["pa"])))
+                          tuple(data["pa"])))
         return (data["h"], data["r"], part)
     raise ValueError(kind)

@@ -45,6 +45,12 @@ def _lib():
             ctypes.c_char_p, ctypes.c_uint64,      # post
             _I64P, ctypes.c_char_p, ctypes.c_uint64,   # ts, flags, n
             _U8P, ctypes.c_uint64, _U64P]          # out, stride, lens
+        lib.ed25519_pubkey.restype = None
+        lib.ed25519_pubkey.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.ed25519_sign.restype = None
+        lib.ed25519_sign.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_char_p]
         return lib
     except Exception:
         return None
@@ -52,6 +58,30 @@ def _lib():
 
 def available() -> bool:
     return _lib() is not None
+
+
+def public_key(seed: bytes) -> bytes | None:
+    """RFC 8032 public key from a 32-byte seed; None without the lib.
+    The host fallback for images without the ``cryptography`` wheel
+    (the pure-Python ladder is ~10 ms per key — unusable at valset
+    scale)."""
+    lib = _lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(32)
+    lib.ed25519_pubkey(seed, out)
+    return out.raw
+
+
+def sign(seed: bytes, msg: bytes) -> bytes | None:
+    """RFC 8032 deterministic signature from a 32-byte seed; None
+    without the lib."""
+    lib = _lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(64)
+    lib.ed25519_sign(seed, msg, len(msg), out)
+    return out.raw
 
 
 def verify(pub: bytes, msg: bytes, sig: bytes) -> bool | None:

@@ -21,8 +21,20 @@ import hashlib
 import os
 from abc import ABC, abstractmethod
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as _ossl
+except ImportError:              # no `cryptography` wheel on this image:
+    # sign/derive/verify fall back to the native C++ implementation
+    # (ed25519_sign/ed25519_pubkey/ed25519_verify), then the pure-Python
+    # oracle.  Never reintroduce these as unconditional imports.
+    # CAVEAT: unlike OpenSSL, the fallback scalar ladders are NOT
+    # constant-time (secret-indexed table lookups / data-dependent
+    # branches), so secret keys leak through timing/cache side channels.
+    # Fine for tests and development images; a production validator must
+    # run with the `cryptography` wheel installed.
+    InvalidSignature = None
+    _ossl = None
 
 from . import _ed25519_py as _ref
 
@@ -106,6 +118,18 @@ class PrivKey(ABC):
     def type(self) -> str: ...
 
 
+def _ed25519_pubkey_from_seed(seed: bytes) -> bytes:
+    """RFC 8032 public key derivation: OpenSSL when the ``cryptography``
+    wheel exists, else native C++, else the pure-Python oracle."""
+    if _ossl is not None:
+        return (_ossl.Ed25519PrivateKey.from_private_bytes(seed)
+                .public_key().public_bytes_raw())
+    from . import _native_ed25519 as _nat
+
+    pub = _nat.public_key(seed)
+    return pub if pub is not None else _ref.public_key_from_seed(seed)
+
+
 @functools.lru_cache(maxsize=4096)
 def _parsed_pubkey(pub: bytes):
     """Parsed OpenSSL key objects, cached per raw pubkey: validator sets
@@ -121,19 +145,22 @@ def verify_ed25519_zip215(pub: bytes, msg: bytes, sig: bytes) -> bool:
     OpenSSL fast path: its accepts are a subset of ZIP-215's, so a pass is
     final; only its (rare, adversarial-input) rejects re-check with the exact
     ZIP-215 verifier (native C++ when built, pure-Python otherwise).
+    Without the ``cryptography`` wheel the exact verifier IS the path.
     """
     if len(sig) != 64 or len(pub) != 32:
         return False
-    try:
-        _parsed_pubkey(pub).verify(sig, msg)
-        return True
-    except (InvalidSignature, ValueError):
-        from . import _native_ed25519 as _nat
+    if _ossl is not None:
+        try:
+            _parsed_pubkey(pub).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            pass
+    from . import _native_ed25519 as _nat
 
-        exact = _nat.verify(pub, msg, sig)
-        if exact is not None:
-            return exact
-        return _ref.verify_zip215(pub, msg, sig)
+    exact = _nat.verify(pub, msg, sig)
+    if exact is not None:
+        return exact
+    return _ref.verify_zip215(pub, msg, sig)
 
 
 class Ed25519PubKey(PubKey):
@@ -161,13 +188,12 @@ class Ed25519PrivKey(PrivKey):
 
     def __init__(self, raw: bytes):
         if len(raw) == 32:           # accept bare seeds
-            pub = (_ossl.Ed25519PrivateKey.from_private_bytes(raw)
-                   .public_key().public_bytes_raw())
-            raw = raw + pub
+            raw = raw + _ed25519_pubkey_from_seed(raw)
         if len(raw) != self.SIZE:
             raise ValueError(f"ed25519 privkey must be {self.SIZE} bytes")
         self._raw = bytes(raw)
-        self._sk = _ossl.Ed25519PrivateKey.from_private_bytes(raw[:32])
+        self._sk = (_ossl.Ed25519PrivateKey.from_private_bytes(raw[:32])
+                    if _ossl is not None else None)
 
     @classmethod
     def generate(cls) -> "Ed25519PrivKey":
@@ -185,7 +211,12 @@ class Ed25519PrivKey(PrivKey):
         return ED25519_KEY_TYPE
 
     def sign(self, msg: bytes) -> bytes:
-        return self._sk.sign(msg)
+        if self._sk is not None:
+            return self._sk.sign(msg)
+        from . import _native_ed25519 as _nat
+
+        sig = _nat.sign(self._raw[:32], msg)
+        return sig if sig is not None else _ref.sign(self._raw[:32], msg)
 
     def pub_key(self) -> Ed25519PubKey:
         return Ed25519PubKey(self._raw[32:])

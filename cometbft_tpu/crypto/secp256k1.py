@@ -19,14 +19,24 @@ import functools as _functools
 import hashlib
 import os
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature, encode_dss_signature)
-from cryptography.hazmat.primitives.serialization import (Encoding,
-                                                          PublicFormat)
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature, encode_dss_signature)
+    from cryptography.hazmat.primitives.serialization import (Encoding,
+                                                              PublicFormat)
+    from cryptography.exceptions import InvalidSignature
+except ImportError:              # no `cryptography` wheel on this image:
+    # signing/derivation fall back to the pure-Python RFC 6979 path
+    # (crypto/_secp256k1_py.py) — byte-identical output; verification
+    # keeps the native C++ fast path either way.  CAVEAT: the fallback
+    # scalar arithmetic is NOT constant-time (bit-branching multiply),
+    # so secret keys leak through timing side channels — tests and
+    # development only; production signing requires the wheel
+    ec = None
 
+from . import _secp256k1_py as _py
 from .keys import SECP256K1_KEY_TYPE, PrivKey, PubKey
 
 # curve order (SEC2 v2)
@@ -75,8 +85,12 @@ class Secp256k1PubKey(PubKey):
         if len(raw) != self.SIZE:
             raise ValueError(f"secp256k1 pubkey must be {self.SIZE} bytes")
         self._raw = bytes(raw)
-        self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
-            ec.SECP256K1(), self._raw)
+        if ec is not None:
+            self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self._raw)
+        else:
+            self._pk = None
+            _py.decompress(self._raw)    # same reject-on-construction
 
     def bytes(self) -> bytes:
         return self._raw
@@ -102,6 +116,8 @@ class Secp256k1PubKey(PubKey):
             return False
         if s > _HALF_N:
             return False            # reject malleable signatures
+        if self._pk is None:
+            return _py.verify(self._raw, msg, r, s)
         try:
             self._pk.verify(encode_dss_signature(r, s), msg,
                             ec.ECDSA(hashes.SHA256()))
@@ -117,8 +133,11 @@ class Secp256k1PrivKey(PrivKey):
         if len(raw) != self.SIZE:
             raise ValueError(f"secp256k1 privkey must be {self.SIZE} bytes")
         self._raw = bytes(raw)
-        self._sk = ec.derive_private_key(int.from_bytes(raw, "big"),
-                                         ec.SECP256K1())
+        self._d = int.from_bytes(raw, "big")
+        if not 1 <= self._d < _N:
+            raise ValueError("secp256k1 scalar out of range")
+        self._sk = (ec.derive_private_key(self._d, ec.SECP256K1())
+                    if ec is not None else None)
 
     @classmethod
     def generate(cls) -> "Secp256k1PrivKey":
@@ -149,6 +168,9 @@ class Secp256k1PrivKey(PrivKey):
         derivation and the scalar ladder run in OpenSSL's constant-time
         code; pinned to the published RFC 6979 secp256k1 vectors in
         tests/test_secp256k1.py."""
+        if self._sk is None:
+            r, s = _py.sign(self._d, msg)
+            return r.to_bytes(32, "big") + s.to_bytes(32, "big")
         from cryptography.exceptions import UnsupportedAlgorithm
 
         try:
@@ -165,5 +187,7 @@ class Secp256k1PrivKey(PrivKey):
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> Secp256k1PubKey:
+        if self._sk is None:
+            return Secp256k1PubKey(_py.pubkey_from_scalar(self._d))
         return Secp256k1PubKey(self._sk.public_key().public_bytes(
             Encoding.X962, PublicFormat.CompressedPoint))

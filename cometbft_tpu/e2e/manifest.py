@@ -185,11 +185,23 @@ def manifest_to_toml(m: Manifest) -> str:
     return "\n".join(lines) + "\n"
 
 
-def load_manifest(path: str) -> Manifest:
-    import tomllib
+def loads_toml(text: str) -> dict:
+    """Manifest TOML text -> dict, through stdlib ``tomllib`` when it
+    exists (Python >= 3.11) and the repo's flat-TOML parser otherwise —
+    ``manifest_to_toml`` only emits the flat grammar that parser covers,
+    so both paths agree on every generated manifest."""
+    try:
+        import tomllib
+    except ImportError:
+        from ..config import _parse_flat_toml
 
-    with open(path, "rb") as f:
-        doc = tomllib.load(f)
+        return _parse_flat_toml(text)
+    return tomllib.loads(text)
+
+
+def load_manifest(path: str) -> Manifest:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = loads_toml(f.read())
     return manifest_from_dict(doc)
 
 

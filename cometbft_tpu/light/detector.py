@@ -136,6 +136,12 @@ async def detect_divergence(client, lb: LightBlock, now_ns: int,
     bad_witnesses = []
     conflicts = []                    # (witness, wlb), verified-signed
     for witness, res in zip(witnesses, replies):
+        if isinstance(res, asyncio.CancelledError):
+            # gather(return_exceptions=True) swallows cancellation into
+            # the result list: a cancelled cross-check is the CALLER
+            # shutting down, not a broken witness — re-raise so the
+            # cancellation propagates instead of striking the witness
+            raise res
         if isinstance(res, ErrLightBlockNotFound):
             # lagging witness: tolerated a few times, then dropped — a
             # witness that can never serve the height gives no attack

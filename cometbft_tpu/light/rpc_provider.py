@@ -5,9 +5,10 @@ provider real light clients use in production)."""
 from __future__ import annotations
 
 from ..crypto.keys import pub_key_from_type_bytes
+from ..libs import log as _tmlog
 from ..rpc.client import HTTPClient
 from ..rpc.core import RPCError
-from ..rpc.json import from_jsonable
+from ..rpc.json import from_jsonable, jsonable
 from ..types.validator_set import Validator, ValidatorSet
 from .provider import ErrLightBlockNotFound, Provider
 from .types import LightBlock
@@ -24,6 +25,22 @@ class RPCProvider(Provider):
 
     def id(self) -> str:
         return self.name
+
+    async def report_evidence(self, evidence) -> None:
+        """Deliver attack evidence to the node behind this provider via a
+        ``/broadcast_evidence`` round-trip (light/provider/http
+        ReportEvidence) — the detector sends each side's incriminating
+        evidence to the honest party, and the base-class no-op silently
+        dropped it for RPC-backed witnesses.  Submission is best-effort:
+        a dead or rejecting node logs a warning (the divergence itself
+        still raises at the caller), it must not mask the fork."""
+        try:
+            await self.client.call("broadcast_evidence",
+                                   evidence=jsonable(evidence))
+        except Exception as e:
+            _tmlog.logger("light").warn(
+                "evidence report failed; the peer never received it",
+                provider=self.name, err=str(e))
 
     async def light_block(self, height: int) -> LightBlock:
         try:

@@ -3,12 +3,16 @@
 
 from __future__ import annotations
 
-import hashlib
 from abc import ABC, abstractmethod
+
+from ..crypto.tmhash import sum_sha256
 
 
 def TxKey(tx: bytes) -> bytes:
-    return hashlib.sha256(tx).digest()
+    """Mempool identity of a tx — the same SHA-256 the tx merkle tree
+    hashes, through the one crypto seam (``crypto/tmhash``) so a future
+    batched tx-key path upgrades every caller at once."""
+    return sum_sha256(tx)
 
 
 class Mempool(ABC):

@@ -976,7 +976,76 @@ static bool a_decompress_cached(ge& out, const u8* pub) {
 }
 
 
+// compress to the wire encoding: y with sign(x) in the top bit
+static void ge_compress(u8 out[32], const ge& p) {
+    fe zi, x, y;
+    fe_invert(zi, p.Z);
+    fe_mul(x, p.X, zi);
+    fe_mul(y, p.Y, zi);
+    fe_tobytes(out, y);
+    if (fe_isodd(x)) out[31] |= 0x80;
+}
+
+// expanded secret: a = clamp(SHA512(seed)[0:32]) mod L, prefix = [32:64].
+// Reduction mod L before the ladder is sound: B has order L.
+static void ed25519_expand_seed(const u8* seed, sc& a, u8 prefix[32],
+                                u8 pub[32]) {
+    u8 h[64];
+    Sha512 sh;
+    sh.init();
+    sh.update(seed, 32);
+    sh.final(h);
+    h[0] &= 248; h[31] &= 127; h[31] |= 64;
+    u8 wide[64] = {0};
+    memcpy(wide, h, 32);
+    sc_from_bytes64(a, wide);
+    memcpy(prefix, h + 32, 32);
+    ge A;
+    ge_scalarmul(A, a, BASE_POINT);
+    ge_compress(pub, A);
+}
+
 extern "C" {
+
+// public key from a 32-byte seed (RFC 8032 key generation) — the host
+// fallback for environments without the `cryptography` wheel
+void ed25519_pubkey(const u8* seed, u8* out32) {
+    sc a;
+    u8 prefix[32];
+    ed25519_expand_seed(seed, a, prefix, out32);
+}
+
+// RFC 8032 deterministic signature from a 32-byte seed
+void ed25519_sign(const u8* seed, const u8* msg, u64 msg_len, u8* sig64) {
+    sc a;
+    u8 prefix[32], pub[32];
+    ed25519_expand_seed(seed, a, prefix, pub);
+    u8 r64[64];
+    Sha512 s2;
+    s2.init();
+    s2.update(prefix, 32);
+    s2.update(msg, msg_len);
+    s2.final(r64);
+    sc r;
+    sc_from_bytes64(r, r64);
+    ge R;
+    ge_scalarmul(R, r, BASE_POINT);
+    ge_compress(sig64, R);
+    u8 k64[64];
+    Sha512 s3;
+    s3.init();
+    s3.update(sig64, 32);
+    s3.update(pub, 32);
+    s3.update(msg, msg_len);
+    s3.final(k64);
+    sc k, ka, S;
+    sc_from_bytes64(k, k64);
+    sc_mul(ka, k, a);
+    sc_add(S, r, ka);
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            sig64[32 + 8 * i + j] = (u8)(S.v[i] >> (8 * j));
+}
 
 // single ZIP-215 verification; returns 1 (valid) / 0 (invalid)
 int ed25519_verify(const u8* pub, const u8* sig, const u8* msg,

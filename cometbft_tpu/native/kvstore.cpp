@@ -338,4 +338,35 @@ void kv_merkle_root(const uint8_t *buf, const u64k *offs, u64k n,
     merkle_node(buf, offs, 0, n, out32);
 }
 
+// Level-order tree build (crypto/merkle.py batched-proof path): every
+// tree level is written to `out` as 32-byte nodes, leaf hashes first,
+// root last.  Pairing is adjacent-left-to-right with an odd tail node
+// PROMOTED unchanged to the next level — bit-identical to the recursive
+// largest-power-of-two split above (same invariant the Python level
+// builder relies on; pinned by the golden-vector tests).  `out` must
+// hold sum over levels of ceil-halved widths (n + ceil(n/2) + ... + 1)
+// nodes.  Returns the node count written.
+u64k kv_merkle_levels(const uint8_t *buf, const u64k *offs, u64k n,
+                      uint8_t *out) {
+    static const uint8_t LEAF = 0x00, INNER = 0x01;
+    if (n == 0) return 0;
+    for (u64k i = 0; i < n; i++)
+        sha256i::oneshot3(&LEAF, 1, buf + offs[i], offs[i + 1] - offs[i],
+                          nullptr, 0, out + 32 * i);
+    uint8_t *prev = out;
+    u64k w = n, total = n;
+    while (w > 1) {
+        uint8_t *cur = out + 32 * total;
+        u64k m = w / 2;
+        for (u64k i = 0; i < m; i++)
+            sha256i::oneshot3(&INNER, 1, prev + 64 * i, 32,
+                              prev + 64 * i + 32, 32, cur + 32 * i);
+        if (w & 1) memcpy(cur + 32 * m, prev + 32 * (w - 1), 32);
+        w = m + (w & 1);
+        prev = cur;
+        total += w;
+    }
+    return total;
+}
+
 }  // extern "C"

@@ -14,6 +14,11 @@
 #include <cstdint>
 #include <cstring>
 
+#if defined(__SHA__) && defined(__SSE4_1__) && defined(__x86_64__)
+#include <immintrin.h>
+#define COMETBFT_TPU_SHA256_SHANI 1
+#endif
+
 namespace sha256i {
 
 static const uint32_t K[64] = {
@@ -33,7 +38,93 @@ static inline uint32_t rotr(uint32_t x, int n) {
     return (x >> n) | (x << (32 - n));
 }
 
-static inline void compress(uint32_t h[8], const uint8_t blk[64]) {
+#ifdef COMETBFT_TPU_SHA256_SHANI
+// SHA-NI compress (Intel SHA extensions): ~6x the portable loop per
+// block.  Compiled only when -march=native reports the extension (the
+// __init__.py build retries without -march=native, which drops back to
+// the portable path below).  Layout per the ISA: state rides as the
+// (ABEF, CDGH) pair, message words load big-endian via PSHUFB.
+static inline void compress_shani(uint32_t h[8], const uint8_t blk[64]) {
+    const __m128i MASK = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                        0x0405060700010203ULL);
+    __m128i TMP = _mm_loadu_si128((const __m128i *)&h[0]);
+    __m128i STATE1 = _mm_loadu_si128((const __m128i *)&h[4]);
+    TMP = _mm_shuffle_epi32(TMP, 0xB1);            // CDAB
+    STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);      // EFGH
+    __m128i STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);     // ABEF
+    STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);   // CDGH
+    const __m128i ABEF_SAVE = STATE0, CDGH_SAVE = STATE1;
+    __m128i MSG, MSG0, MSG1, MSG2, MSG3;
+
+#define SHA_RND(Ki_hi, Ki_lo, Wi)                                      \
+    MSG = _mm_add_epi32(Wi, _mm_set_epi64x(Ki_hi, Ki_lo));             \
+    STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);               \
+    MSG = _mm_shuffle_epi32(MSG, 0x0E);                                \
+    STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG)
+#define SHA_EXT(Wa, Wb, Wc, Wd)                                        \
+    TMP = _mm_alignr_epi8(Wd, Wc, 4);                                  \
+    Wa = _mm_add_epi32(Wa, TMP);                                       \
+    Wa = _mm_sha256msg2_epu32(Wa, Wd);                                 \
+    Wb = _mm_sha256msg1_epu32(Wb, Wd)
+
+    MSG0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(blk + 0)),
+                            MASK);
+    SHA_RND(0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL, MSG0);
+    MSG1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(blk + 16)),
+                            MASK);
+    SHA_RND(0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL, MSG1);
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+    MSG2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(blk + 32)),
+                            MASK);
+    SHA_RND(0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL, MSG2);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+    MSG3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i *)(blk + 48)),
+                            MASK);
+    SHA_RND(0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL, MSG3);
+    SHA_EXT(MSG0, MSG2, MSG2, MSG3);   // extend W16..19, prep next msg1
+    SHA_RND(0x240CA1CC0FC19DC6ULL, 0xEFBE4786E49B69C1ULL, MSG0);
+    SHA_EXT(MSG1, MSG3, MSG3, MSG0);
+    SHA_RND(0x76F988DA5CB0A9DCULL, 0x4A7484AA2DE92C6FULL, MSG1);
+    SHA_EXT(MSG2, MSG0, MSG0, MSG1);
+    SHA_RND(0xBF597FC7B00327C8ULL, 0xA831C66D983E5152ULL, MSG2);
+    SHA_EXT(MSG3, MSG1, MSG1, MSG2);
+    SHA_RND(0x1429296706CA6351ULL, 0xD5A79147C6E00BF3ULL, MSG3);
+    SHA_EXT(MSG0, MSG2, MSG2, MSG3);
+    SHA_RND(0x53380D134D2C6DFCULL, 0x2E1B213827B70A85ULL, MSG0);
+    SHA_EXT(MSG1, MSG3, MSG3, MSG0);
+    SHA_RND(0x92722C8581C2C92EULL, 0x766A0ABB650A7354ULL, MSG1);
+    SHA_EXT(MSG2, MSG0, MSG0, MSG1);
+    SHA_RND(0xC76C51A3C24B8B70ULL, 0xA81A664BA2BFE8A1ULL, MSG2);
+    SHA_EXT(MSG3, MSG1, MSG1, MSG2);
+    SHA_RND(0x106AA070F40E3585ULL, 0xD6990624D192E819ULL, MSG3);
+    SHA_EXT(MSG0, MSG2, MSG2, MSG3);
+    SHA_RND(0x34B0BCB52748774CULL, 0x1E376C0819A4C116ULL, MSG0);
+    SHA_EXT(MSG1, MSG3, MSG3, MSG0);
+    SHA_RND(0x682E6FF35B9CCA4FULL, 0x4ED8AA4A391C0CB3ULL, MSG1);
+    // W52..55: msg2 extension only (no further msg1 needed)
+    TMP = _mm_alignr_epi8(MSG1, MSG0, 4);
+    MSG2 = _mm_add_epi32(MSG2, TMP);
+    MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+    SHA_RND(0x8CC7020884C87814ULL, 0x78A5636F748F82EEULL, MSG2);
+    TMP = _mm_alignr_epi8(MSG2, MSG1, 4);
+    MSG3 = _mm_add_epi32(MSG3, TMP);
+    MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+    SHA_RND(0xC67178F2BEF9A3F7ULL, 0xA4506CEB90BEFFFAULL, MSG3);
+#undef SHA_RND
+#undef SHA_EXT
+
+    STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+    STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+    TMP = _mm_shuffle_epi32(STATE0, 0x1B);         // FEBA
+    STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);      // DCHG
+    STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);   // DCBA
+    STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);      // HGFE
+    _mm_storeu_si128((__m128i *)&h[0], STATE0);
+    _mm_storeu_si128((__m128i *)&h[4], STATE1);
+}
+#endif  // COMETBFT_TPU_SHA256_SHANI
+
+static inline void compress_portable(uint32_t h[8], const uint8_t blk[64]) {
     uint32_t w[64];
     for (int i = 0; i < 16; i++)
         w[i] = (uint32_t)blk[4 * i] << 24 | (uint32_t)blk[4 * i + 1] << 16 |
@@ -58,6 +149,14 @@ static inline void compress(uint32_t h[8], const uint8_t blk[64]) {
     }
     h[0] += a; h[1] += b; h[2] += c; h[3] += d;
     h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static inline void compress(uint32_t h[8], const uint8_t blk[64]) {
+#ifdef COMETBFT_TPU_SHA256_SHANI
+    compress_shani(h, blk);
+#else
+    compress_portable(h, blk);
+#endif
 }
 
 struct ctx {

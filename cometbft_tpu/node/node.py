@@ -398,6 +398,10 @@ class Node:
         cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
         if self.config.base.device_wait_s > 0:
             cryptobatch.set_device_wait(self.config.base.device_wait_s)
+        from ..crypto import merkle as cryptomerkle
+
+        cryptomerkle.set_merkle_kernel_min(
+            self.config.base.merkle_kernel_min_leaves)
 
         def _warm_native():
             # build/load the C++ verifiers off the event loop so a fresh

@@ -157,7 +157,13 @@ class MConnection:
 
     async def _send_routine(self) -> None:
         try:
-            while True:
+            # the loop re-checks _stopped rather than running until
+            # cancelled: on Python < 3.12 asyncio.wait_for (the idle wait
+            # below) can swallow a cancellation that races with the
+            # wakeup event (bpo-42130), leaving this task alive after
+            # stop() cancelled it — stop()'s `await t` then never
+            # returns and Node.stop wedges mid-shutdown
+            while not self._stopped:
                 self._send_wakeup.clear()
                 if self._pong_to_send:
                     self._pong_to_send = False

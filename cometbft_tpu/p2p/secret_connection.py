@@ -19,9 +19,16 @@ import asyncio
 import hashlib
 import struct
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:              # no `cryptography` wheel on this image:
+    # the pure-Python RFC 7748/8439 stand-ins keep the handshake and
+    # frame protocol byte-identical (MB/s-grade throughput — the test
+    # nets and small deployments; installs with the wheel get OpenSSL)
+    from ..crypto._sc_fallback import (ChaCha20Poly1305, X25519PrivateKey,
+                                       X25519PublicKey)
 
 from ..crypto.keys import Ed25519PrivKey, Ed25519PubKey
 

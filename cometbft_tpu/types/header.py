@@ -73,7 +73,7 @@ class Header:
             _bytes_value(self.evidence_hash),
             _bytes_value(self.proposer_address),
         ]
-        return merkle.hash_from_byte_slices(fields)
+        return merkle.hash_from_byte_slices_fast(fields)
 
     def validate_basic(self) -> str | None:
         if not self.chain_id or len(self.chain_id) > 50:

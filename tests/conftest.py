@@ -44,6 +44,17 @@ import pytest  # noqa: E402
 _DEFAULT_TEST_TIMEOUT = 180
 
 
+class TestTimeoutExit(SystemExit):
+    """Raised by the SIGALRM watchdog.  MUST derive from SystemExit: an
+    alarm that fires while the main thread is inside an asyncio callback
+    lands in ``Handle._run`` / ``Task.__step``, which swallow every
+    ordinary exception (they log-and-continue), so a ``TimeoutError``
+    there never fails the test and a stuck event loop eats the whole
+    tier-1 budget.  SystemExit (and KeyboardInterrupt) are the only
+    classes those frames re-raise; pytest records SystemExit as a plain
+    test failure and moves on to the next test."""
+
+
 @pytest.hookimpl(wrapper=True)
 def pytest_runtest_call(item):
     marker = item.get_closest_marker("timeout")
@@ -51,7 +62,7 @@ def pytest_runtest_call(item):
         else _DEFAULT_TEST_TIMEOUT
 
     def _on_alarm(signum, frame):
-        raise TimeoutError(
+        raise TestTimeoutExit(
             f"test exceeded {seconds}s timeout (conftest SIGALRM)")
 
     old = signal.signal(signal.SIGALRM, _on_alarm)

@@ -14,7 +14,7 @@ import time
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.timeout(1800)
+pytestmark = [pytest.mark.timeout(1800), pytest.mark.slow]
 
 N_VALS = 10_000
 

@@ -1,0 +1,68 @@
+"""ChaCha20-Poly1305 fallback engines: RFC 8439 vectors + native parity.
+
+The p2p SecretConnection's no-`cryptography` fallback has two engines
+(native C via the on-demand g++ build, pure Python as last resort);
+both must produce RFC 8439 output bit-exactly, and the class must
+route through the native one when it builds.
+"""
+
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import _sc_fallback as sc
+
+KEY = bytes(range(0x80, 0xA0))
+NONCE = bytes.fromhex("070000004041424344454647")
+AAD = bytes.fromhex("50515253c0c1c2c3c4c5c6c7")
+PT = (b"Ladies and Gentlemen of the class of '99: If I could offer you "
+      b"only one tip for the future, sunscreen would be it.")
+CT = bytes.fromhex(
+    "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+    "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+    "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+    "3ff4def08e4b7a9de576d26586cec64b6116"
+    "1ae10b594f09e26a7e902ecbd0600691")           # ciphertext || tag
+
+
+def _py_only(key):
+    """The pure-Python engine regardless of the native build."""
+    a = sc.ChaCha20Poly1305(key)
+    a._lib = None
+    return a
+
+
+def test_rfc8439_vector_both_engines():
+    for aead in (sc.ChaCha20Poly1305(KEY), _py_only(KEY)):
+        assert aead.encrypt(NONCE, PT, AAD) == CT
+        assert aead.decrypt(NONCE, CT, AAD) == PT
+        bad = bytearray(CT)
+        bad[5] ^= 1
+        with pytest.raises(sc.InvalidTag):
+            aead.decrypt(NONCE, bytes(bad), AAD)
+
+
+def test_native_engine_builds_and_is_preferred():
+    assert sc._native_aead() is not None, \
+        "on-demand g++ AEAD build must work on this image"
+    assert sc.ChaCha20Poly1305(KEY)._lib is not None
+
+
+def test_native_matches_python_across_sizes():
+    rng = random.Random(7)
+    nat, py = sc.ChaCha20Poly1305(KEY), _py_only(KEY)
+    if nat._lib is None:
+        pytest.skip("native AEAD unavailable")
+    for n in [0, 1, 15, 16, 17, 63, 64, 65, 255, 1024, 1040]:
+        msg = bytes(rng.randrange(256) for _ in range(n))
+        nonce = bytes(rng.randrange(256) for _ in range(12))
+        aad = bytes(rng.randrange(256)
+                    for _ in range(rng.choice([0, 5, 16, 33])))
+        ct = nat.encrypt(nonce, msg, aad)
+        assert ct == py.encrypt(nonce, msg, aad), n
+        assert nat.decrypt(nonce, ct, aad) == msg
+        assert py.decrypt(nonce, ct, aad) == msg
+        # aad participates in the tag
+        if aad:
+            with pytest.raises(sc.InvalidTag):
+                nat.decrypt(nonce, ct, aad[:-1])

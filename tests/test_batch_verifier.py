@@ -54,6 +54,7 @@ def test_cpu_batch_verifier():
     assert not ok and oks == [True, True, True, False, True, True, True]
 
 
+@pytest.mark.slow   # jitted device kernels, ~1 min each on CPU
 def test_device_batch_verifier_buckets():
     # odd batch size forces lane padding; verify padding lanes don't leak
     items = make_sigs(21, bad={0, 20})
@@ -100,6 +101,7 @@ def test_create_dispatch():
     assert isinstance(create_batch_verifier("auto"), CpuBatchVerifier)  # tests run CPU-only
 
 
+@pytest.mark.slow   # jitted device kernels, ~1 min each on CPU
 def test_dense_entry_empty_and_chunked(monkeypatch):
     assert device_verify_ed25519(
         np.zeros((0, 32), np.uint8), np.zeros((0, 32), np.uint8),
@@ -117,6 +119,7 @@ def test_dense_entry_empty_and_chunked(monkeypatch):
     assert not ok and oks == [i not in (0, 9, 20) for i in range(21)]
 
 
+@pytest.mark.slow   # jitted device kernels, ~1 min each on CPU
 def test_oversized_message_exact_bucket():
     # > 16 hash blocks (msg ~2KB) must verify, not crash on bucket overflow
     sk = Ed25519PrivKey.from_secret(b"big")
@@ -130,6 +133,7 @@ def test_oversized_message_exact_bucket():
     assert oks[0] is True and oks[1] is False
 
 
+@pytest.mark.slow   # jitted device kernels, ~1 min each on CPU
 def test_graft_entry_and_multichip():
     import jax
 
@@ -255,6 +259,7 @@ def _drain_device_worker():
             pass
 
 
+@pytest.mark.slow   # jitted device kernels, ~1 min each on CPU
 def test_production_verifier_shards_over_mesh(monkeypatch):
     """VERDICT r2 item 5: the PRODUCTION TpuBatchVerifier (not a demo)
     shards over a multi-device mesh and agrees with single-device
@@ -427,3 +432,130 @@ def test_warmup_covers_valset_table_shapes():
                            valset_sizes=(20,))
     assert done == 1
     assert not B._VALSET_TABLES          # cleared after warmup
+
+
+# ------------------------------------------------ RLC routing regression
+# Measured-routing pins for the sharded-RLC gate (ISSUE 3 satellite):
+# every verdict jit is mocked so no kernel compiles — only the DISPATCH
+# decisions in _device_verify_chunk / device_verify_ed25519_cached are
+# under test.  The sharded RLC's own correctness is covered by the
+# slow-tier differential (compile-heavy); these pins keep the gate's
+# shape honest in tier-1.
+
+def _fake_verdict_fns(monkeypatch, rlc_verdict=True):
+    """Mock every compiled-verdict factory in crypto.batch; returns the
+    call log {name: [devices_or_(), ...]}."""
+    import cometbft_tpu.crypto.batch as B
+
+    calls = {}
+
+    def factory(name, fn):
+        def make(*key):
+            calls.setdefault(name, []).append(key[0] if key else ())
+            return fn
+        return make
+
+    ones = lambda *a: np.ones(np.asarray(a[0]).shape[0], bool)  # noqa: E731
+    verdict = lambda *a: np.bool_(rlc_verdict)                  # noqa: E731
+    monkeypatch.setattr(B, "_compiled_rlc_sharded", factory(
+        "rlc_sharded", verdict))
+    monkeypatch.setattr(B, "_compiled_rlc", factory("rlc", verdict))
+    monkeypatch.setattr(B, "_compiled_verify_sharded", factory(
+        "verify_sharded", ones))
+    monkeypatch.setattr(B, "_compiled_verify", factory("verify", ones))
+    monkeypatch.setattr(B, "_compiled_rlc_gather_sharded", factory(
+        "rlc_gather_sharded", verdict))
+    monkeypatch.setattr(B, "_compiled_rlc_gather", factory(
+        "rlc_gather", verdict))
+    monkeypatch.setattr(
+        B, "_compiled_verify_gather",
+        factory("verify_gather", lambda tab, ok, *a:
+                np.ones(np.asarray(a[0]).shape[0], bool)))
+    return calls
+
+
+def _dense_rows(b, width=40):
+    r = np.random.default_rng(b)
+    return (r.integers(0, 256, (b, 32), np.uint8),
+            r.integers(0, 256, (b, 32), np.uint8),
+            r.integers(0, 256, (b, 32), np.uint8),
+            r.integers(0, 256, (b, width), np.uint8),
+            np.full((b,), width, np.int64))
+
+
+def test_rlc_sharded_gate_routing(monkeypatch):
+    """Multi-device + >= _RLC_MIN_LANES lanes must try the lane-sharded
+    RLC verdict FIRST (the gate the old code forbade); a reject falls
+    through to the per-lane sharded jit for localization; sub-threshold
+    batches keep the per-lane path with no RLC attempt."""
+    import jax
+
+    import cometbft_tpu.crypto.batch as B
+
+    devs = tuple(jax.devices()[:8])
+    assert len(devs) == 8, "conftest must provide the 8-device CPU mesh"
+    pubs, rs, ss, msgs, lens = _dense_rows(130)
+
+    calls = _fake_verdict_fns(monkeypatch)
+    out = B._device_verify_chunk(pubs, rs, ss, msgs, lens, None)
+    # single default device: plain RLC, never the sharded variants
+    assert list(calls) == ["rlc"] and out.all() and out.shape == (130,)
+
+    B.set_devices(devs)
+    try:
+        calls = _fake_verdict_fns(monkeypatch)
+        out = B._device_verify_chunk(pubs, rs, ss, msgs, lens, None)
+        assert list(calls) == ["rlc_sharded"], \
+            f"accepted big batch must stop at the sharded RLC: {calls}"
+        assert calls["rlc_sharded"] == [devs]
+        assert out.all() and out.shape == (130,)
+
+        # a sharded-RLC reject must localize through the per-lane jit
+        calls = _fake_verdict_fns(monkeypatch, rlc_verdict=False)
+        out = B._device_verify_chunk(pubs, rs, ss, msgs, lens, None)
+        assert list(calls) == ["rlc_sharded", "verify_sharded"]
+        assert out.shape == (130,)
+
+        # below the gate: straight to the per-lane sharded jit
+        calls = _fake_verdict_fns(monkeypatch)
+        small = _dense_rows(24)
+        out = B._device_verify_chunk(*small, None)
+        assert list(calls) == ["verify_sharded"] and out.shape == (24,)
+    finally:
+        B.set_devices(None)
+
+
+def test_rlc_sharded_gate_routing_cached(monkeypatch):
+    """The cached-valset route rides the gather-sharded RLC on a mesh
+    and the plain gather RLC on one device, same gate threshold."""
+    import jax
+
+    import cometbft_tpu.crypto.batch as B
+
+    devs = tuple(jax.devices()[:8])
+    monkeypatch.setattr(B, "_valset_tables",
+                        lambda pubs_full, devices: (object(), object(), 256))
+    valset, rs, ss, msgs, lens = _dense_rows(130)
+    scope = np.arange(130, dtype=np.int64)
+
+    calls = _fake_verdict_fns(monkeypatch)
+    out = B.device_verify_ed25519_cached(valset, scope, valset, rs, ss,
+                                         msgs, lens, None)
+    assert list(calls) == ["rlc_gather"] and out.all()
+
+    B.set_devices(devs)
+    try:
+        calls = _fake_verdict_fns(monkeypatch)
+        out = B.device_verify_ed25519_cached(valset, scope, valset, rs, ss,
+                                             msgs, lens, None)
+        assert list(calls) == ["rlc_gather_sharded"]
+        assert calls["rlc_gather_sharded"] == [devs]
+        assert out.all() and out.shape == (130,)
+
+        # reject: localization through the gather per-lane jit
+        calls = _fake_verdict_fns(monkeypatch, rlc_verdict=False)
+        out = B.device_verify_ed25519_cached(valset, scope, valset, rs, ss,
+                                             msgs, lens, None)
+        assert list(calls) == ["rlc_gather_sharded", "verify_gather"]
+    finally:
+        B.set_devices(None)

@@ -18,6 +18,11 @@ import time
 
 import pytest
 
+# Multi-node nets with live perturbations: minutes of wall clock on a
+# small CPU box and timing-sensitive under load — tier-2 (the tier-1
+# `-m 'not slow'` gate keeps the single-node + unit consensus coverage).
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE_PORT = 28860
 

@@ -23,6 +23,7 @@ def test_manifest_validation():
     assert m.validator_powers() == {"a": 100}      # manifest.go:28 default
 
 
+@pytest.mark.slow   # live multi-node run
 def test_e2e_validator_updates(tmp_path):
     """Manifest validator_update (manifest.go:34): a full node is voted
     in as a validator mid-run and another validator's power changes; the
@@ -53,6 +54,7 @@ def test_e2e_validator_updates(tmp_path):
     assert all(h >= 10 for h in report["heights"].values())
 
 
+@pytest.mark.slow   # live multi-node run
 def test_e2e_seed_discovery(tmp_path):
     """Seed topology: validators have NO persistent peers — they learn
     the network through the seed via PEX (manifest.go seed semantics),
@@ -83,6 +85,7 @@ def test_e2e_seed_discovery(tmp_path):
     assert all(h >= 4 for h in report["heights"].values())
 
 
+@pytest.mark.slow   # live multi-node run
 def test_e2e_manifest_network(tmp_path):
     m = manifest_from_dict({
         "chain_id": "e2e-pytest",
@@ -116,16 +119,14 @@ def test_generator_determinism_and_round_trip():
     """The same seed always produces byte-identical TOML, and parsing it
     back yields the same manifest (generator.go's reproducibility
     contract: a CI failure reproduces from the seed alone)."""
-    import tomllib
-
     from cometbft_tpu.e2e.generator import generate_manifest
-    from cometbft_tpu.e2e.manifest import manifest_to_toml
+    from cometbft_tpu.e2e.manifest import loads_toml, manifest_to_toml
 
     for seed in range(1, 30):
         m = generate_manifest(seed, compact=True)
         s = manifest_to_toml(m)
         assert manifest_to_toml(generate_manifest(seed, compact=True)) == s
-        m2 = manifest_from_dict(tomllib.loads(s))
+        m2 = manifest_from_dict(loads_toml(s))
         assert manifest_to_toml(m2) == s
     # the sweep actually varies the axes across seeds
     axes = set()
@@ -140,6 +141,7 @@ def test_generator_determinism_and_round_trip():
             ("key", "secp256k1")} <= axes
 
 
+@pytest.mark.slow   # live multi-node run
 @pytest.mark.parametrize("seed", [2, 4])
 def test_e2e_generated_seed_runs_green(tmp_path, seed):
     """Two generated seeds run end-to-end: seed 2 sweeps memdb + socket

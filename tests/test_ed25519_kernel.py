@@ -9,9 +9,39 @@ import hashlib
 import jax
 import numpy as np
 import pytest
-from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
 
 from cometbft_tpu.crypto import _ed25519_py as ref
+
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+except ImportError:
+    class Ed25519PrivateKey:
+        """Image has no `cryptography`: same tiny API over the pure-Python
+        RFC-8032 oracle, which stays independent of the kernel under
+        test (it shares no code with ops/)."""
+
+        def __init__(self, seed: bytes):
+            self._seed = seed
+
+        @classmethod
+        def generate(cls) -> "Ed25519PrivateKey":
+            return cls(rng.bytes(32))
+
+        def public_key(self) -> "Ed25519PrivateKey":
+            return self
+
+        def public_bytes_raw(self) -> bytes:
+            return ref.public_key_from_seed(self._seed)
+
+        def sign(self, msg: bytes) -> bytes:
+            return ref.sign(self._seed, msg)
+
+
+# Full kernel execution over many shapes (~3 min on a small CPU box) —
+# tier-2 with the other kernel suites (test_kernel_layouts, test_rlc);
+# tier-1 keeps the kernel golden/routing pins in test_batch_verifier.
+pytestmark = pytest.mark.slow
 from cometbft_tpu.ops import ed25519, edwards, fe, scalar, sha512
 
 rng = np.random.default_rng(42)

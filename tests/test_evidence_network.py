@@ -20,7 +20,10 @@ from cometbft_tpu.types.part_set import PartSetHeader
 from cometbft_tpu.types.priv_validator import MockPV
 from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
 
-pytestmark = pytest.mark.timeout(120)
+# 4-validator TCP net per test: minutes of wall clock on a small CPU box
+# and timing-sensitive under load — tier-2 alongside the e2e suites (the
+# in-proc evidence-pool logic stays tier-1 in test_evidence.py).
+pytestmark = [pytest.mark.timeout(120), pytest.mark.slow]
 
 
 def run(coro):

@@ -13,7 +13,9 @@ import time
 
 import pytest
 
-pytestmark = pytest.mark.timeout(400)
+# crash/restart matrix over every commit failpoint: ~2 min of node
+# restarts — tier-2 on the small CPU image.
+pytestmark = [pytest.mark.timeout(400), pytest.mark.slow]
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASE_PORT = 28760

@@ -173,8 +173,8 @@ def test_fuzz_jsonrpc_server():
                 await w.drain()
                 try:
                     await asyncio.wait_for(r.read(4096), 2)
-                except TimeoutError:
-                    pass
+                except asyncio.TimeoutError:   # != builtin TimeoutError
+                    pass                       # until Python 3.11
                 w.close()
             except ConnectionError:
                 pass

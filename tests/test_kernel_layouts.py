@@ -11,7 +11,7 @@ import numpy as np
 import jax
 import pytest
 
-pytestmark = pytest.mark.timeout(900)
+pytestmark = [pytest.mark.timeout(900), pytest.mark.slow]
 
 from cometbft_tpu.crypto import _ed25519_py as ref
 from cometbft_tpu.ops import ed25519, fe, fe_lm

@@ -17,7 +17,9 @@ from cometbft_tpu.rpc import HTTPClient
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 from cometbft_tpu.types.priv_validator import MockPV
 
-pytestmark = pytest.mark.timeout(150)
+# spawns a full node + light client over live RPC — tier-2 with the
+# other net suites.
+pytestmark = [pytest.mark.timeout(150), pytest.mark.slow]
 
 PERIOD = 3600 * 1_000_000_000
 

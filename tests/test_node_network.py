@@ -16,7 +16,8 @@ from cometbft_tpu.p2p import NodeKey
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 from cometbft_tpu.types.priv_validator import MockPV
 
-pytestmark = pytest.mark.timeout(150)
+# live multi-node TCP nets — tier-2 with the other net suites.
+pytestmark = [pytest.mark.timeout(150), pytest.mark.slow]
 
 
 def run(coro):

@@ -183,6 +183,7 @@ def test_block_indexer_search():
     assert bi.search("block.height='4'")["heights"] == [4]
 
 
+@pytest.mark.slow   # live node over RPC
 def test_node_indexes_and_serves_tx_routes():
     """Live node: a committed tx becomes queryable via tx / tx_search /
     block_search, and /metrics exposes consensus gauges."""

@@ -10,7 +10,7 @@ import numpy as np
 import jax
 import pytest
 
-pytestmark = pytest.mark.timeout(900)
+pytestmark = [pytest.mark.timeout(900), pytest.mark.slow]
 
 from cometbft_tpu.crypto import _ed25519_py as ref
 from cometbft_tpu.ops import ed25519, rlc, scalar, fe
@@ -80,6 +80,30 @@ def test_rlc_padding_lanes_do_not_contribute():
     ok = jax.jit(rlc.verify_batch_rlc)(pub, rb, sb, blocks, active, z)
     assert bool(np.asarray(ok))
     sb[5, 0] ^= 1                          # tamper an ACTIVE lane
+    ok2 = jax.jit(rlc.verify_batch_rlc)(pub, rb, sb, blocks, active, z)
+    assert not bool(np.asarray(ok2))
+
+
+def test_rlc_invalid_padding_lane_cannot_veto():
+    """Regression (ADVICE r5): the per-lane ok_a/ok_r/ok_s bits must be
+    masked to ACTIVE lanes before the all-reduce.  A padding lane whose
+    pubkey/R fail decompression or whose s is non-canonical contributes
+    identity to every sum (z = 0), but its ok bits are False — pre-fix
+    that forced a whole-batch false reject."""
+    args, _ = dense_signature_batch(16, msg_len=80, seed=45)
+    pub, rb, sb, blocks, active = [np.asarray(a).copy() for a in args]
+    mask = np.ones(16, bool)
+    mask[12:] = False                      # lanes 12..15 are padding
+    z = rlc.host_rlc_coeffs(16, active_mask=mask,
+                            rng_bytes=np.random.default_rng(2).bytes(256))
+    pub[12] = 0xFF                         # not a curve point: ok_a False
+    rb[13] = 0xFF                          # not a curve point: ok_r False
+    sb[14] = 0xFF                          # s >= L: ok_s False
+    ok = jax.jit(rlc.verify_batch_rlc)(pub, rb, sb, blocks, active, z)
+    assert bool(np.asarray(ok)), \
+        "garbage padding lane vetoed a fully-valid batch"
+    # the same garbage on an ACTIVE lane must still reject
+    pub[3] = 0xFF
     ok2 = jax.jit(rlc.verify_batch_rlc)(pub, rb, sb, blocks, active, z)
     assert not bool(np.asarray(ok2))
 

@@ -16,9 +16,19 @@ class _FakeDevice:
 
 @pytest.fixture(autouse=True)
 def clean_router():
+    # node-spawning tests earlier in the suite raise the process-wide
+    # device-lane threshold (node.py applies config.base.min_device_lanes,
+    # default 64) and can leave an abandoned in-flight device future; both
+    # would silently force these 8-lane batches onto the host path
+    saved_min = B.TpuBatchVerifier.MIN_DEVICE_LANES
+    saved_inflight = B._DEVICE_INFLIGHT
+    B.TpuBatchVerifier.MIN_DEVICE_LANES = 1
+    B._DEVICE_INFLIGHT = None
     B._ROUTER.reset()
     yield
     B._ROUTER.reset()
+    B.TpuBatchVerifier.MIN_DEVICE_LANES = saved_min
+    B._DEVICE_INFLIGHT = saved_inflight
 
 
 def test_router_optimistic_until_measured():

@@ -29,11 +29,30 @@ def run(coro):
 
 
 def _self_signed(tmp_path):
-    """Self-signed localhost cert via the cryptography package."""
-    from cryptography import x509
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.x509.oid import NameOID
+    """Self-signed localhost cert via the cryptography package, falling
+    back to the openssl CLI on images without it (the TLS round-trip
+    only needs a cert the client can pin, not any particular issuer)."""
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError:
+        import shutil
+        import subprocess
+
+        if shutil.which("openssl") is None:
+            pytest.skip("needs the cryptography package or openssl CLI")
+        cert_path = tmp_path / "rpc.crt"
+        key_path = tmp_path / "rpc.key"
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "ec", "-pkeyopt",
+             "ec_paramgen_curve:prime256v1", "-keyout", str(key_path),
+             "-out", str(cert_path), "-days", "1", "-nodes",
+             "-subj", "/CN=localhost",
+             "-addext", "subjectAltName=DNS:localhost,IP:127.0.0.1"],
+            check=True, capture_output=True)
+        return str(cert_path), str(key_path)
 
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "localhost")])

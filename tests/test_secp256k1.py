@@ -17,7 +17,10 @@ from cometbft_tpu.types.validator_set import Validator, ValidatorSet
 
 from test_types import CHAIN_ID, make_commit
 
-pytestmark = pytest.mark.timeout(120)
+# ~90 s of pure-Python EC arithmetic on this image (no `cryptography`
+# backend) — tier-2; tier-1 keeps secp coverage via the mixed-key
+# routing tests in test_batch_verifier.
+pytestmark = [pytest.mark.timeout(120), pytest.mark.slow]
 
 
 def test_sign_verify_roundtrip():

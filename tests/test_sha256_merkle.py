@@ -1,0 +1,225 @@
+"""Batched SHA-256 merkle subsystem: golden vectors against the hashlib
+reference.
+
+Every engine behind the size-based dispatch (pure-Python level builder,
+native C++ ``kv_merkle_levels``, batched JAX level kernel) must be
+bit-identical to the recursive RFC-6962 reference — roots AND full proof
+sets — including leaf/inner domain separation, the largest-power-of-two
+split point, and the promote-odd level-order equivalence the iterative
+builders rely on."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import merkle
+
+GOLDEN_NS = [0, 1, 2, 3, 10, 1000]
+EDGE_NS = [4, 5, 6, 7, 8, 9, 15, 16, 17, 63, 64, 65, 127, 128, 129, 255]
+
+
+def _items(n, seed=7, max_len=64):
+    rng = np.random.default_rng(seed)
+    return [bytes(rng.integers(0, 256, int(rng.integers(0, max_len + 1)),
+                               dtype=np.uint8)) for _ in range(n)]
+
+
+def _assert_proofs_equal(got, ref):
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        assert g.total == r.total and g.index == r.index
+        assert g.leaf_hash == r.leaf_hash
+        assert list(g.aunts) == list(r.aunts)
+
+
+# ------------------------------------------------------------ raw kernel
+
+def test_sha256_blocks_matches_hashlib():
+    import jax
+
+    from cometbft_tpu.ops import sha256 as s
+
+    rng = np.random.default_rng(0)
+    lens = rng.integers(0, 119, 16)
+    msgs = np.zeros((16, 120), np.uint8)
+    for i, ln in enumerate(lens):
+        msgs[i, :ln] = rng.integers(0, 256, ln)
+    blocks, active = s.host_pad(msgs, lens, 2)
+    out = np.asarray(jax.jit(s.sha256_blocks)(blocks, active), np.uint8)
+    for i in range(16):
+        want = hashlib.sha256(bytes(msgs[i, :lens[i]])).digest()
+        assert bytes(out[i]) == want
+
+
+def test_merkle_inner_level_matches_hashlib():
+    import jax
+
+    from cometbft_tpu.ops import sha256 as s
+
+    rng = np.random.default_rng(1)
+    left = rng.integers(0, 256, (16, 32), dtype=np.uint8)
+    right = rng.integers(0, 256, (16, 32), dtype=np.uint8)
+    out = s.words_to_bytes(np.asarray(jax.jit(s.merkle_inner_level)(
+        s.bytes_to_words(left), s.bytes_to_words(right))))
+    for i in range(16):
+        want = hashlib.sha256(
+            b"\x01" + bytes(left[i]) + bytes(right[i])).digest()
+        assert bytes(out[i]) == want
+
+
+def test_digest_word_roundtrip():
+    from cometbft_tpu.ops import sha256 as s
+
+    rng = np.random.default_rng(2)
+    d = rng.integers(0, 256, (7, 32), dtype=np.uint8)
+    assert np.array_equal(s.words_to_bytes(s.bytes_to_words(d)), d)
+
+
+# ------------------------------------------------- domain separation
+
+def test_domain_separation_and_empty():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    assert merkle.leaf_hash(b"abc") == hashlib.sha256(b"\x00abc").digest()
+    assert merkle.inner_hash(b"L" * 32, b"R" * 32) == hashlib.sha256(
+        b"\x01" + b"L" * 32 + b"R" * 32).digest()
+    # a leaf never collides with an inner node of the same bytes
+    assert merkle.leaf_hash(b"x") != hashlib.sha256(b"\x01x").digest()
+
+
+def test_rfc6962_split_point():
+    # split at the largest power of two STRICTLY below n: for n=6 the
+    # left subtree takes 4 leaves, not 3 (pinned explicitly — the
+    # balanced-split would produce a different root)
+    items = _items(6, seed=11)
+    left = merkle.hash_from_byte_slices(items[:4])
+    right = merkle.hash_from_byte_slices(items[4:])
+    assert merkle.hash_from_byte_slices(items) == \
+        merkle.inner_hash(left, right)
+
+
+# ------------------------------------------------------- golden vectors
+
+@pytest.mark.parametrize("n", GOLDEN_NS)
+def test_golden_roots_and_proofs(n):
+    items = _items(n)
+    ref_root, ref_proofs = merkle.proofs_from_byte_slices_reference(items)
+    assert ref_root == merkle.hash_from_byte_slices(items)
+
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == ref_root
+    _assert_proofs_equal(proofs, ref_proofs)
+    assert merkle.hash_from_byte_slices_fast(items) == ref_root
+
+    for i in (0, n // 2, n - 1) if n else ():
+        assert proofs[i].verify(root, items[i])
+        assert not proofs[i].verify(root, items[i] + b"x")
+
+
+@pytest.mark.parametrize("n", EDGE_NS)
+def test_level_order_equals_recursive(n):
+    """The promote-odd level-order build IS the recursive split tree."""
+    items = _items(n, seed=n)
+    ref_root, ref_proofs = merkle.proofs_from_byte_slices_reference(items)
+    levels = merkle._levels_hashlib(items)
+    assert levels[-1][0] == ref_root
+    root, proofs = merkle._proofs_from_levels(levels, n)
+    assert root == ref_root
+    _assert_proofs_equal(proofs, ref_proofs)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 10, 129, 1000])
+def test_native_levels_engine(n):
+    items = _items(n, seed=n + 100)
+    levels = merkle._levels_native(items)
+    if levels is None:
+        pytest.skip("native kvstore lib unavailable")
+    assert levels == merkle._levels_hashlib(items)
+
+
+@pytest.mark.parametrize("n", [2, 3, 10, 1000])
+def test_kernel_levels_engine(n):
+    """The batched JAX level kernel, forced on the CPU backend."""
+    items = _items(n, seed=n + 200)
+    levels = merkle._levels_kernel(items)
+    if levels is None:
+        pytest.skip("jax unavailable for the merkle kernel")
+    assert levels == merkle._levels_hashlib(items)
+    ref_root, ref_proofs = merkle.proofs_from_byte_slices_reference(items)
+    root, proofs = merkle._proofs_from_levels(levels, n)
+    assert root == ref_root
+    _assert_proofs_equal(proofs, ref_proofs)
+
+
+def test_kernel_root_only():
+    items = _items(1000, seed=42)
+    root = merkle._root_kernel(items)
+    if root is None:
+        pytest.skip("jax unavailable for the merkle kernel")
+    assert root == merkle.hash_from_byte_slices(items)
+
+
+def test_kernel_big_leaves_route():
+    """Items past the leaf-kernel bucket hash through hashlib but the
+    levels still ride the kernel."""
+    items = _items(200, seed=43, max_len=300)
+    levels = merkle._levels_kernel(items)
+    if levels is None:
+        pytest.skip("jax unavailable for the merkle kernel")
+    assert levels == merkle._levels_hashlib(items)
+
+
+def test_kernel_dispatch_env_force(monkeypatch):
+    monkeypatch.setenv("TPU_BFT_MERKLE_KERNEL", "1")
+    items = _items(4096, seed=44, max_len=40)
+    ref_root, ref_proofs = merkle.proofs_from_byte_slices_reference(items)
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == ref_root
+    _assert_proofs_equal(proofs, ref_proofs)
+    assert merkle.hash_from_byte_slices_fast(items) == ref_root
+    monkeypatch.setenv("TPU_BFT_MERKLE_KERNEL", "0")
+    root2, proofs2 = merkle.proofs_from_byte_slices(items)
+    assert root2 == ref_root
+    _assert_proofs_equal(proofs2, ref_proofs)
+
+
+# ------------------------------------------------------------- consumers
+
+def test_part_set_proofs_through_dispatch():
+    from cometbft_tpu.types.part_set import PartSet
+
+    rng = np.random.default_rng(9)
+    data = bytes(rng.integers(0, 256, 100 * 1024, dtype=np.uint8))
+    ps = PartSet.from_data(data, part_size=1024)    # 100 parts: level path
+    assert ps.is_complete()
+    header = ps.header()
+    # every proof must verify against the header hash on a fresh set
+    fresh = PartSet(header)
+    for i in range(ps.total):
+        assert fresh.add_part(ps.get_part(i))
+    assert fresh.get_data() == data
+
+
+def test_value_op_roundtrip_with_levelorder_proofs():
+    """ProofOps serialize/verify with proofs from the batched builder
+    (tuple aunt paths must survive msgpack)."""
+    from cometbft_tpu.crypto.merkle import (ProofOperators, ValueOp,
+                                            kv_leaf, leaf_hash)
+
+    keys = [b"k%03d" % i for i in range(80)]
+    vals = [b"v%03d" % i for i in range(80)]
+    leaves = [kv_leaf(k, v) for k, v in zip(keys, vals)]
+    root, proofs = merkle.proofs_from_byte_slices(leaves)
+    op = ValueOp(keys[17], proofs[17])
+    assert proofs[17].leaf_hash == leaf_hash(leaves[17])
+    decoded = ValueOp.decode(op.proof_op())
+    ops = ProofOperators([decoded])
+    ops.verify(root, [keys[17]], vals[17])          # raises on mismatch
+
+
+def test_data_hash_matches_reference():
+    from cometbft_tpu.types.header import Data, tx_hash
+
+    txs = _items(300, seed=13, max_len=200)
+    want = merkle.hash_from_byte_slices([tx_hash(t) for t in txs])
+    assert Data(txs=list(txs)).hash() == want

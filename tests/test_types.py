@@ -52,6 +52,10 @@ message CanonicalVote {
 @pytest.fixture(scope="module")
 def pb():
     """Compile the canonical schema with protoc into a temp module."""
+    import shutil
+
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not installed on this image")
     with tempfile.TemporaryDirectory() as td:
         proto = Path(td) / "ct.proto"
         proto.write_text(CANONICAL_PROTO)
