@@ -188,6 +188,21 @@ class BaseConfig:
     # leaf count before merkle tree hashing considers the batched device
     # kernel (crypto/merkle; accelerator-gated either way)
     merkle_kernel_min_leaves: int = 2048
+    # coalescing vote-verification scheduler (crypto/scheduler): gossiped
+    # votes micro-batch through the batched verifier and seed a
+    # verified-signature dedup cache that VerifyCommit* consults
+    vote_sched_enable: bool = True
+    # latency bound of one coalescing window, ms (the first request of a
+    # window waits at most this long before its batch dispatches)
+    vote_sched_max_wait_ms: float = 2.0
+    # lanes that force an immediate (size) flush; values between compile
+    # buckets snap DOWN to one (a full batch never needs a new XLA
+    # shape); values below the smallest bucket (16) are honored exactly,
+    # since any such batch pads into the 16-lane shape anyway
+    vote_sched_max_lanes: int = 256
+    # verified-signature LRU entries; 0 disables caching AND the gossip
+    # prefetch that feeds it (coalescing still serves async callers)
+    vote_sched_cache_size: int = 65536
 
 
 @dataclass
@@ -272,6 +287,12 @@ class Config:
                 raise ConfigError(f"consensus.{name} must be positive")
         if self.mempool.size <= 0:
             raise ConfigError("mempool.size must be positive")
+        if self.base.vote_sched_max_wait_ms < 0:
+            raise ConfigError("base.vote_sched_max_wait_ms must be >= 0")
+        if self.base.vote_sched_max_lanes < 1:
+            raise ConfigError("base.vote_sched_max_lanes must be >= 1")
+        if self.base.vote_sched_cache_size < 0:
+            raise ConfigError("base.vote_sched_cache_size must be >= 0")
         if self.storage.db_backend not in ("logdb", "native", "memdb"):
             raise ConfigError(
                 f"storage.db_backend must be logdb|native|memdb, "

@@ -13,6 +13,7 @@ event loop, so PeerState needs no locks (single-writer discipline).
 from __future__ import annotations
 
 import asyncio
+import functools
 import random
 
 import msgpack
@@ -35,6 +36,15 @@ VOTE_SET_BITS_CHANNEL = 0x23
 
 GOSSIP_SLEEP = 0.01                 # config PeerGossipSleepDuration analog
 QUERY_MAJ23_SLEEP = 2.0
+
+
+@functools.cache
+def _dup_votes_metric():
+    from ..libs import metrics as _m
+
+    return _m.counter(
+        "consensus_gossip_duplicate_votes_total",
+        "re-gossiped votes dropped at the reactor (already in a vote set)")
 
 
 # ------------------------------------------------------------- wire helpers
@@ -331,6 +341,12 @@ class ConsensusReactor(Reactor):
                 vote = codec.from_dict(d["v"])
                 ps.set_has_vote(vote.height, vote.round, vote.type,
                                 vote.validator_index, n_vals)
+                if self.cs.has_exact_vote(vote):
+                    # re-gossip of a vote we already hold: the peer
+                    # bookkeeping above is all it was worth — don't buy
+                    # a WAL write, a queue slot and a dup-check pass
+                    _dup_votes_metric().inc()
+                    return
                 self.cs.feed_vote(vote, peer.id)
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if tag == "vsb":

@@ -139,6 +139,33 @@ class Handshaker:
                 f"app height {height} ahead of state "
                 f"{state.last_block_height} and no stored block/response "
                 f"to recover from")
+        # Cross-check the stored artifacts against each other and against
+        # the state lineage BEFORE persisting anything: this path runs
+        # exactly once after a crash, on data a partial write (or a
+        # corrupted store) could have mangled — silently advancing state
+        # over a block whose header doesn't match its own meta would fork
+        # this node from the network at the next commit.
+        block_hash = block.hash()
+        if meta.block_id.hash != block_hash:
+            raise HandshakeError(
+                f"recovery block {height} header hash "
+                f"{block_hash.hex()} does not match stored meta block_id "
+                f"{meta.block_id.hash.hex()}: blockstore corrupt")
+        if block.header.height != height:
+            raise HandshakeError(
+                f"recovery block at store height {height} claims header "
+                f"height {block.header.height}: blockstore corrupt")
+        if height != state.last_block_height + 1:
+            raise HandshakeError(
+                f"recovery block {height} does not extend state height "
+                f"{state.last_block_height}")
+        if state.last_block_height > 0 and \
+                block.header.app_hash != state.app_hash:
+            raise HandshakeError(
+                f"recovery block {height} app_hash "
+                f"{block.header.app_hash.hex()} breaks lineage: state at "
+                f"{state.last_block_height} expects "
+                f"{state.app_hash.hex()}")
         resp = unpack_finalize_response(raw)
         state = executor._update_state(state, meta.block_id, block, resp)
         self.state_store.save(state)

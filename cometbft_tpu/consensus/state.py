@@ -143,7 +143,102 @@ class ConsensusState:
         self.queue.put_nowait(("part", (height, round_, part), peer_id))
 
     def feed_vote(self, vote: Vote, peer_id: str = "") -> None:
+        """Peer votes detour through the verification scheduler when one
+        is running: the per-peer receive tasks are concurrent, so k peers'
+        votes coalesce into one micro-batch and seed the verified-sig
+        cache BEFORE the single-writer handler reaches ``VoteSet._verify``
+        — the handler then pays a dict hit instead of a scalar
+        multiplication.  Own votes (peer_id == "") and sync contexts
+        (no running loop: tests, tooling) keep the direct enqueue."""
+        if peer_id:
+            from ..crypto import scheduler as _vsched
+
+            sched = _vsched.get_scheduler()
+            # with the cache disabled (max_size == 0) the prefetch verdict
+            # can never reach VoteSet._verify — the detour would verify
+            # every vote TWICE, so skip it entirely
+            if sched is not None and sched.is_running \
+                    and sched.cache.max_size > 0 \
+                    and self._submit_prefetch(sched, vote, peer_id):
+                return
         self.queue.put_nowait(("vote", vote, peer_id))
+
+    def _submit_prefetch(self, sched, vote: Vote, peer_id: str) -> bool:
+        """Fire-and-forget pre-verification of one gossiped vote; the
+        vote enters the state queue once the verdict lands (a cache hit
+        enqueues synchronously).  Only POSITIVE verdicts are cached — an
+        invalid signature re-verifies inside ``VoteSet._verify`` and
+        raises there, keeping the peer punishment path byte-identical.
+        Returns False (caller enqueues directly) when the signer can't
+        be resolved."""
+        try:
+            pub = self._vote_pub_key(vote)
+            if pub is None or self.state is None:
+                return False
+            chain_id = self.state.chain_id
+            items = [(vote.sign_bytes(chain_id), vote.signature)]
+            if vote.extension_signature:
+                items.append((vote.extension_sign_bytes(chain_id),
+                              vote.extension_signature))
+        except Exception:
+            return False
+        remaining = len(items)
+
+        def _done(_ok: bool) -> None:
+            nonlocal remaining
+            remaining -= 1
+            if remaining == 0:
+                self.queue.put_nowait(("vote", vote, peer_id))
+
+        for msg, sig in items:
+            sched.submit_nowait(pub, msg, sig, on_done=_done)
+        return True
+
+    def _vote_pub_key(self, vote: Vote):
+        """Resolve the signer for prefetch: current-height votes in the
+        round validator set, previous-height precommits in
+        last_validators.  Returns None when unresolvable (wrong height,
+        bad index, address mismatch) — the state machine is the
+        authority; prefetch just declines to warm the cache."""
+        rs = self.rs
+        if vote.height == rs.height:
+            vals = rs.validators
+        elif vote.height + 1 == rs.height and vote.type == PRECOMMIT_TYPE:
+            vals = rs.last_validators
+        else:
+            return None
+        if vals is None or not 0 <= vote.validator_index < vals.size():
+            return None
+        val = vals.get_by_index(vote.validator_index)
+        if val is None or val.address != vote.validator_address:
+            return None
+        return val.pub_key
+
+    def has_exact_vote(self, vote: Vote) -> bool:
+        """True iff the matching vote set already holds this exact vote
+        (same index, block and signature) — the reactor drops re-gossiped
+        duplicates on this check before they buy a WAL write and a queue
+        slot.  Conservative: any doubt returns False and the vote takes
+        the full path."""
+        rs = self.rs
+        try:
+            if vote.height == rs.height and rs.votes is not None:
+                vs = (rs.votes.prevotes(vote.round)
+                      if vote.type == PREVOTE_TYPE
+                      else rs.votes.precommits(vote.round))
+            elif vote.height + 1 == rs.height and \
+                    vote.type == PRECOMMIT_TYPE:
+                vs = rs.last_commit
+            else:
+                return False
+            if vs is None:
+                return False
+            existing = vs.get_by_index(vote.validator_index)
+            return (existing is not None
+                    and existing.block_id == vote.block_id
+                    and existing.signature == vote.signature)
+        except Exception:
+            return False
 
     def notify_txs_available(self) -> None:
         self.queue.put_nowait(("txs_available", None, ""))

@@ -1,0 +1,597 @@
+"""VerificationScheduler: node-wide micro-batching front end for single
+signature verifications, plus the verified-signature dedup cache.
+
+The batched verifier (``crypto/batch.py``) is the paper's engine, but it
+is only reachable from call sites that already HOLD a batch — commits,
+blocksync windows, light-client traces.  Live consensus gossip arrives as
+single votes on concurrent per-peer tasks and used to verify one scalar
+multiplication at a time through ``types/vote_set.py``.  This module
+closes that gap with the classic dynamic-batching move from
+committee-based consensus and inference serving alike:
+
+- ``verify()`` (async) parks each ``(pub, msg, sig)`` request behind a
+  future; requests coalesce until either the oldest has waited
+  ``max_wait_ms`` (window flush) or ``max_lanes`` lanes are pending
+  (size flush — the cap is snapped DOWN to a ``crypto/batch`` compile
+  bucket so a full batch pads to a shape XLA has already compiled).
+- one dispatch runs the whole micro-batch through the routed
+  ``BatchVerifier`` (native SHA-NI RLC on host, device kernel when the
+  ``_ThroughputRouter`` prefers it) on a single worker thread, then
+  demultiplexes per-item verdicts back to the awaiting callers.  The
+  backends already localize failures (a refused batch re-verifies per
+  item), so one bad signature can never poison or reject its batchmates.
+- a bounded LRU **verified-signature cache** keyed by
+  ``(pubkey bytes, sha256(msg), sig)`` remembers POSITIVE verdicts only.
+  It is consulted and seeded by this scheduler, by ``VoteSet._verify``
+  (sync, via :func:`verify_cached`) and by the ``VerifyCommit*`` family —
+  so a vote re-gossiped by k peers and then re-checked inside the commit
+  costs one scalar multiplication instead of k+1.  Failed verdicts are
+  NEVER cached: a signature that fails verification cannot be served
+  from the cache as valid.  Requests for a key already in flight attach
+  to the pending future instead of occupying another lane.
+
+Trust boundaries: the equivocation/evidence paths
+(``VoteSet.add_vote``'s conflicting-vote branch, the
+``VerifyCommit*AllSignatures`` variants) bypass the cache entirely via
+:func:`verify_uncached` — evidence that slashes a validator must rest on
+a fresh verification, not a cache entry.
+
+Lifecycle: one process-wide scheduler shared by every in-proc node
+(verdicts are universal; cross-node batching is free concurrency),
+refcounted through :func:`acquire_scheduler`/:func:`release_scheduler`
+from node start/stop.  With no scheduler registered every helper
+degrades to a direct ``pub.verify_signature`` call with zero overhead —
+no hashing, no locks on the common path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import time
+
+from ..libs import metrics
+from ..libs.service import BaseService
+from .keys import PubKey
+
+# ---------------------------------------------------------------- metrics
+
+
+def _sched_metrics():
+    """Registered once (libs.metrics dedups by name); grouped so the hot
+    path pays one tuple unpack."""
+    return (
+        metrics.histogram(
+            "crypto_sched_batch_lanes",
+            "micro-batch occupancy at dispatch (lanes per flush)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)),
+        metrics.histogram(
+            "crypto_sched_wait_seconds",
+            "time a request waited in the coalescing window"),
+        metrics.histogram(
+            "crypto_sched_latency_seconds",
+            "end-to-end single-verification latency through the scheduler"),
+        metrics.counter(
+            "crypto_sched_cache_hits_total",
+            "verified-signature cache hits, by consulting subsystem"),
+        metrics.counter(
+            "crypto_sched_cache_misses_total",
+            "verified-signature cache misses, by consulting subsystem"),
+        metrics.counter(
+            "crypto_sched_dedup_inflight_total",
+            "requests coalesced onto an identical in-flight verification"),
+        metrics.counter(
+            "crypto_sched_flush_total",
+            "micro-batch flushes, by trigger (window/size/stop/sync)"),
+        metrics.counter(
+            "crypto_sched_lanes_total",
+            "scheduler-verified lanes, by verdict"),
+    )
+
+
+# ------------------------------------------------------------------ cache
+
+
+def cache_key(pub_bytes: bytes, msg: bytes, sig: bytes) -> tuple:
+    """Cache key for one verification: the message is folded through
+    sha256 so keys stay bounded regardless of message size (vote sign
+    bytes are ~120 B, but evidence/commit messages need not be)."""
+    return (pub_bytes, hashlib.sha256(msg).digest(), sig)
+
+
+class VerifiedSigCache:
+    """Bounded LRU of POSITIVELY verified signatures.
+
+    Thread-safe: consulted from the event loop (scheduler, vote sets)
+    and from executor threads (dispatch seeding, bench drivers).  Only
+    ``True`` verdicts are ever stored — there is deliberately no API to
+    record a failure, so a bug cannot turn this into a
+    forged-signature oracle.  Eviction is plain LRU via dict ordering.
+    """
+
+    def __init__(self, max_size: int = 65536):
+        self.max_size = max(0, int(max_size))
+        self._entries: dict[tuple, None] = {}
+        import threading
+
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def hit(self, key: tuple) -> bool:
+        """True iff ``key`` was verified before; refreshes recency."""
+        if self.max_size == 0:
+            return False
+        with self._lock:
+            if key not in self._entries:
+                return False
+            # move-to-end: dicts preserve insertion order
+            del self._entries[key]
+            self._entries[key] = None
+            return True
+
+    def seed(self, key: tuple) -> None:
+        if self.max_size == 0:
+            return
+        with self._lock:
+            if key in self._entries:
+                del self._entries[key]
+            self._entries[key] = None
+            while len(self._entries) > self.max_size:
+                del self._entries[next(iter(self._entries))]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# -------------------------------------------------------------- scheduler
+
+
+class _Request:
+    __slots__ = ("key", "pub", "msg", "sig", "future", "callbacks",
+                 "t_enqueue")
+
+    def __init__(self, key, pub, msg, sig):
+        self.key = key
+        self.pub = pub
+        self.msg = msg
+        self.sig = sig
+        # ONE shared future for every awaiting caller (asyncio futures
+        # support multiple awaiters) plus plain callbacks for the
+        # fire-and-forget path — a 384-arrival gossip burst must not pay
+        # a future per arrival
+        self.future: asyncio.Future | None = None
+        self.callbacks: list = []
+        self.t_enqueue = time.perf_counter()
+
+
+class VerificationScheduler(BaseService):
+    """Latency-bounded micro-batching over the routed BatchVerifier.
+
+    ``max_lanes`` is snapped down to a ``crypto/batch`` lane bucket so a
+    size-flushed batch exactly fills a compiled shape; ``max_wait_ms``
+    bounds how long the FIRST request of a window can wait (the paper's
+    latency/throughput knob).  Dispatch runs on a single worker thread:
+    the native RLC batch is CPU-bound and the device path serializes in
+    ``crypto/batch`` anyway, so one thread avoids oversubscribing the
+    host while keeping the event loop free.
+    """
+
+    def __init__(self, backend: str = "auto", max_wait_ms: float = 2.0,
+                 max_lanes: int = 256, cache_size: int = 65536,
+                 name: str = "vote-sched"):
+        super().__init__(name=name)
+        self.backend = backend
+        self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
+        self.max_lanes = snap_lane_cap(max_lanes)
+        self.cache = VerifiedSigCache(cache_size)
+        self._pending: dict[tuple, _Request] = {}
+        # dispatched but not yet demuxed: identical requests arriving
+        # while a batch is on the worker attach here instead of buying
+        # another lane (the "never verify the same signature twice"
+        # guarantee covers the dispatch window too)
+        self._inflight: dict[tuple, _Request] = {}
+        self._timer: asyncio.TimerHandle | None = None
+        self._dispatches: set[asyncio.Task] = set()
+        self._pool = None            # lazy ThreadPoolExecutor(1)
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._m = _sched_metrics()
+        # hot-path counters pre-bound to their label sets (per-event
+        # label sorting costs real time in a gossip storm)
+        (_, _, _, hits, misses, dedup, _, lanes) = self._m
+        self._bound: dict[str, tuple] = {
+            s: (hits.bind(source=s), misses.bind(source=s))
+            for s in ("scheduler", "votes", "commit", "sync")}
+        self._dedup_b = dedup.bind()
+        self._lanes_ok = lanes.bind(verdict="ok")
+        self._lanes_bad = lanes.bind(verdict="bad")
+        # per-INSTANCE tallies for stats(): the libs.metrics registry is
+        # process-global (a restarted node's fresh scheduler would report
+        # its predecessor's totals), so the operator/bench surface reads
+        # these and only Prometheus reads the global counters
+        self._t_hits = 0
+        self._t_misses = 0
+        self._t_dedup = 0
+        self._t_ok = 0
+        self._t_bad = 0
+        self._t_batches = 0
+        self._t_lanes_sum = 0
+
+    # ----------------------------------------------------------- lifecycle
+
+    async def on_start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+
+    def _abandon(self) -> None:
+        """Synchronous teardown for an instance whose event loop is gone
+        (a crashed node that never released): the async stop() path can
+        never run, but the worker thread and timer must not leak.  Parked
+        requests are dropped — their futures/callbacks belong to the dead
+        loop and nothing can consume them."""
+        self._running = False
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._pending.clear()
+        self._inflight.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    async def on_stop(self) -> None:
+        """Flush everything still pending so no caller is left hanging,
+        then wait for in-flight dispatches to demux their verdicts."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if self._pending:
+            self._flush("stop")
+        # snapshot: dispatch tasks remove themselves on completion
+        for t in list(self._dispatches):
+            try:
+                await t
+            except Exception:       # demux already logged; don't wedge stop
+                pass
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    # -------------------------------------------------------------- verify
+
+    def _enqueue(self, pub, msg, sig, key) -> "_Request | None":
+        """Shared enqueue core: returns the (possibly pre-existing)
+        request to attach to, or None when the verdict was served
+        directly (cache hit handled by callers)."""
+        req = self._pending.get(key) or self._inflight.get(key)
+        if req is not None:
+            self._dedup_b.inc()
+            self._t_dedup += 1
+            return req
+        req = _Request(key, pub, bytes(msg), bytes(sig))
+        self._pending[key] = req
+        if len(self._pending) >= self.max_lanes:
+            self._flush("size")
+        elif self._timer is None:
+            self._timer = (self._loop or asyncio.get_event_loop()) \
+                .call_later(self.max_wait_s, self._flush, "window")
+        return req
+
+    async def verify(self, pub: PubKey, msg: bytes, sig: bytes) -> bool:
+        """Coalescing single-verification entry point (async callers:
+        RPC, tests, tooling).  Falls back to a direct check when the
+        service is not running."""
+        t0 = time.perf_counter()
+        key = cache_key(pub.bytes(), msg, sig)
+        lat_h = self._m[2]
+        hit_b, miss_b = self._bound["scheduler"]
+        if self.cache.hit(key):
+            hit_b.inc()
+            self._t_hits += 1
+            return True
+        miss_b.inc()
+        self._t_misses += 1
+        if not self.is_running:
+            ok = bool(pub.verify_signature(msg, sig))
+            if ok:
+                self.cache.seed(key)
+            lat_h.observe(time.perf_counter() - t0)
+            return ok
+        req = self._enqueue(pub, msg, sig, key)
+        if req.future is None:
+            req.future = asyncio.get_running_loop().create_future()
+        try:
+            ok = await req.future
+        finally:
+            lat_h.observe(time.perf_counter() - t0)
+        return ok
+
+    def submit_nowait(self, pub: PubKey, msg: bytes, sig: bytes,
+                      on_done=None) -> None:
+        """Fire-and-forget coalescing submission — the consensus reactor's
+        entry point: no future, no task, no await.  ``on_done(ok)`` (if
+        given) runs on the event loop after the verdict lands; cache hits
+        and the not-running fallback invoke it synchronously.  Exceptions
+        from ``on_done`` are swallowed after logging: a broken callback
+        must not poison its batchmates' demux."""
+        key = cache_key(pub.bytes(), msg, sig)
+        hit_b, miss_b = self._bound["scheduler"]
+        if self.cache.hit(key):
+            hit_b.inc()
+            self._t_hits += 1
+            if on_done is not None:
+                on_done(True)
+            return
+        miss_b.inc()
+        self._t_misses += 1
+        if not self.is_running:
+            ok = bool(pub.verify_signature(msg, sig))
+            if ok:
+                self.cache.seed(key)
+            if on_done is not None:
+                on_done(ok)
+            return
+        req = self._enqueue(pub, msg, sig, key)
+        if on_done is not None:
+            req.callbacks.append(on_done)
+
+    def verify_sync(self, pub: PubKey, msg: bytes, sig: bytes,
+                    source: str = "sync") -> bool:
+        """Synchronous cached verification: the fallback for callers that
+        cannot await (``VoteSet._verify`` runs inside the single-writer
+        consensus handler; tooling may have no loop at all).  Cache hit
+        or one direct verification; positive verdicts seed the cache."""
+        key = cache_key(pub.bytes(), msg, sig)
+        bound = self._bound.get(source)
+        if bound is None:
+            bound = (self._m[3].bind(source=source),
+                     self._m[4].bind(source=source))
+            self._bound[source] = bound
+        if self.cache.hit(key):
+            bound[0].inc()
+            self._t_hits += 1
+            return True
+        bound[1].inc()
+        self._t_misses += 1
+        ok = bool(pub.verify_signature(msg, sig))
+        if ok:
+            self.cache.seed(key)
+        return ok
+
+    # ------------------------------------------------------------ dispatch
+
+    def _flush(self, reason: str) -> None:
+        """Move the pending window into one dispatch task.  Runs on the
+        event loop (call_later callback or inline from verify/stop)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        if not self._pending:
+            return
+        batch = list(self._pending.values())
+        self._pending.clear()
+        for req in batch:
+            self._inflight[req.key] = req
+        self._m[6].inc(reason=reason)                       # flushes
+        self._t_batches += 1
+        self._t_lanes_sum += len(batch)
+        now = time.perf_counter()
+        self._m[0].observe(len(batch))                      # occupancy
+        for req in batch:
+            self._m[1].observe(now - req.t_enqueue)         # wait time
+        loop = self._loop or asyncio.get_running_loop()
+        task = loop.create_task(self._dispatch(batch))
+        self._dispatches.add(task)
+        task.add_done_callback(self._dispatches.discard)
+
+    async def _dispatch(self, batch: list[_Request]) -> None:
+        loop = asyncio.get_running_loop()
+        if self._pool is None:
+            import concurrent.futures as cf
+
+            self._pool = cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="vote-sched")
+        try:
+            oks = await loop.run_in_executor(
+                self._pool, self._verify_batch, batch)
+        except Exception as e:                    # infra failure, not a
+            self.log.error("batch dispatch failed; failing batch closed",
+                           err=repr(e))           # signature verdict
+            oks = [False] * len(batch)
+        for req, ok in zip(batch, oks):
+            ok = bool(ok)
+            self._inflight.pop(req.key, None)
+            if ok:
+                self.cache.seed(req.key)
+            (self._lanes_ok if ok else self._lanes_bad).inc()
+            if ok:
+                self._t_ok += 1
+            else:
+                self._t_bad += 1
+            if req.future is not None and not req.future.done():
+                req.future.set_result(ok)
+            for cb in req.callbacks:
+                try:
+                    cb(ok)
+                except Exception as e:
+                    self.log.error("submit_nowait callback failed",
+                                   err=repr(e))
+
+    def _verify_batch(self, batch: list[_Request]) -> list[bool]:
+        """Worker-thread body: one routed BatchVerifier pass.  The
+        backends localize failures internally (native RLC and the device
+        RLC both fall back to per-item verification on a refused batch),
+        so the returned verdicts are per-item safe.  A batch of one skips
+        the batch machinery — there is nothing to amortize."""
+        from . import batch as cryptobatch
+
+        if len(batch) == 1:
+            r = batch[0]
+            return [bool(r.pub.verify_signature(r.msg, r.sig))]
+        bv = cryptobatch.create_batch_verifier(self.backend)
+        for r in batch:
+            bv.add(r.pub, r.msg, r.sig)
+        _, oks = bv.verify()
+        return oks
+
+    # ------------------------------------------------------------- surface
+
+    def stats(self) -> dict:
+        """Operator/bench surface: THIS instance's cache + coalescing
+        tallies (the global Prometheus counters outlive instances; these
+        reset with every scheduler)."""
+        lookups = self._t_hits + self._t_misses
+        return {
+            "cache_size": len(self.cache),
+            "cache_hits": self._t_hits,
+            "cache_misses": self._t_misses,
+            "cache_hit_rate": (self._t_hits / lookups) if lookups else 0.0,
+            "dedup_inflight": self._t_dedup,
+            "batches": self._t_batches,
+            "mean_batch_lanes": (self._t_lanes_sum / self._t_batches)
+            if self._t_batches else 0.0,
+            "lanes_ok": self._t_ok,
+            "lanes_bad": self._t_bad,
+        }
+
+
+def snap_lane_cap(n: int) -> int:
+    """Largest ``crypto/batch`` lane bucket <= n (cap 4096): a
+    size-flushed batch must exactly fill a shape the kernel already
+    compiles, never force a new one.  Values BELOW the smallest bucket
+    are honored exactly — any batch that small pads into the 16-lane
+    shape regardless, so the operator's latency intent wins."""
+    from .batch import _LANE_BUCKETS
+
+    n = max(1, int(n))
+    if n <= _LANE_BUCKETS[0]:
+        return n
+    snapped = _LANE_BUCKETS[0]
+    for b in _LANE_BUCKETS:
+        if b <= n:
+            snapped = b
+    return snapped
+
+
+# ------------------------------------------------- process-wide registry
+
+_GLOBAL: VerificationScheduler | None = None
+_REFS = 0
+
+
+def get_scheduler() -> VerificationScheduler | None:
+    return _GLOBAL
+
+
+def set_scheduler(sched: VerificationScheduler | None) -> None:
+    """Test/tooling hook: install (or clear) the process-wide scheduler
+    directly, bypassing the refcount."""
+    global _GLOBAL, _REFS
+    _GLOBAL = sched
+    _REFS = 0 if sched is None else max(_REFS, 1)
+
+
+async def acquire_scheduler(backend: str = "auto", max_wait_ms: float = 2.0,
+                            max_lanes: int = 256, cache_size: int = 65536
+                            ) -> VerificationScheduler:
+    """Start (or share) the process-wide scheduler.  In-proc ensembles
+    call this once per node: the first caller's knobs win — verdicts are
+    universal, so sharing one cache and one coalescing window across
+    nodes only improves occupancy.  A scheduler left over from a
+    different (dead) event loop is discarded, not reused: its timer and
+    dispatch tasks are bound to that loop."""
+    global _GLOBAL, _REFS
+    loop = asyncio.get_running_loop()
+    if _GLOBAL is not None and (_GLOBAL._loop is not loop
+                                or not _GLOBAL.is_running):
+        _GLOBAL._abandon()          # reclaim the worker thread + timer
+        _GLOBAL = None
+        _REFS = 0
+    if _GLOBAL is None:
+        sched = VerificationScheduler(
+            backend=backend, max_wait_ms=max_wait_ms, max_lanes=max_lanes,
+            cache_size=cache_size)
+        await sched.start()
+        _GLOBAL = sched
+    _REFS += 1
+    return _GLOBAL
+
+
+async def release_scheduler() -> None:
+    """Drop one node's reference; the last release stops the service."""
+    global _GLOBAL, _REFS
+    if _GLOBAL is None:
+        return
+    _REFS -= 1
+    if _REFS <= 0:
+        sched, _GLOBAL, _REFS = _GLOBAL, None, 0
+        await sched.stop()
+
+
+# ----------------------------------------------- sync helpers (hot path)
+
+
+def cache_active() -> bool:
+    """True when a scheduler (hence a cache) is registered.  Callers use
+    this to skip key hashing entirely when there is nothing to consult —
+    the no-scheduler configuration must cost zero."""
+    return _GLOBAL is not None
+
+
+def dense_cache_active() -> bool:
+    """Gate for the DENSE commit paths: a cache that exists but is EMPTY
+    cannot hit, and the per-lane key build (tobytes + sha256 + lock) is
+    ~45 ms at 10k lanes — pure overhead on a node whose gossip never
+    seeded anything (cold start, catch-up).  Live nodes always have
+    scheduler-seeded entries, so this gate only spares the cold case."""
+    return _GLOBAL is not None and len(_GLOBAL.cache) > 0
+
+
+def verify_cached(pub: PubKey, msg: bytes, sig: bytes,
+                  source: str = "votes") -> bool:
+    """Cached single verification for sync call sites
+    (``VoteSet._verify``): cache hit, else direct verify + seed.  With no
+    scheduler registered this is exactly ``pub.verify_signature``."""
+    sched = _GLOBAL
+    if sched is None:
+        return bool(pub.verify_signature(msg, sig))
+    return sched.verify_sync(pub, msg, sig, source=source)
+
+
+def verify_uncached(pub: PubKey, msg: bytes, sig: bytes) -> bool:
+    """Evidence-grade verification: never reads OR seeds the cache.  The
+    conflicting-vote branch of ``VoteSet.add_vote`` and the
+    ``VerifyCommit*AllSignatures`` evidence paths use this — an
+    equivocation proof must rest on a fresh scalar multiplication."""
+    return bool(pub.verify_signature(msg, sig))
+
+
+def cache_lookup(pub_bytes: bytes, msg: bytes, sig: bytes,
+                 source: str = "commit") -> bool:
+    """Dense-path cache consult (``types/validation.py``): True iff this
+    exact (pub, msg, sig) was positively verified before."""
+    sched = _GLOBAL
+    if sched is None:
+        return False
+    key = cache_key(pub_bytes, msg, sig)
+    bound = sched._bound.get(source)
+    if bound is None:
+        bound = (sched._m[3].bind(source=source),
+                 sched._m[4].bind(source=source))
+        sched._bound[source] = bound
+    if sched.cache.hit(key):
+        bound[0].inc()
+        return True
+    bound[1].inc()
+    return False
+
+
+def cache_seed(pub_bytes: bytes, msg: bytes, sig: bytes) -> None:
+    """Record a POSITIVE verdict obtained outside the scheduler (a
+    successful ``VerifyCommit*`` batch seeds its lanes so later
+    re-checks of the same votes are free)."""
+    sched = _GLOBAL
+    if sched is None:
+        return
+    sched.cache.seed(cache_key(pub_bytes, msg, sig))

@@ -78,6 +78,12 @@ class Counter(_Metric):
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def bind(self, **labels) -> "_BoundCounter":
+        """Pre-resolve a label set for hot paths: ``bind(...)`` once,
+        then ``.inc()`` skips the per-call label sort (worth ~3us per
+        event on the vote-gossip path)."""
+        return _BoundCounter(self, tuple(sorted(labels.items())))
+
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
@@ -85,6 +91,21 @@ class Counter(_Metric):
         with self._lock:
             return [f"{self.name}{_label_str(dict(k))} {v}"
                     for k, v in sorted(self._values.items())]
+
+
+class _BoundCounter:
+    """A counter pre-bound to one label set (see :meth:`Counter.bind`)."""
+
+    __slots__ = ("_c", "_key")
+
+    def __init__(self, counter: Counter, key: tuple):
+        self._c = counter
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        c = self._c
+        with c._lock:
+            c._values[self._key] = c._values.get(self._key, 0.0) + amount
 
 
 class Gauge(_Metric):
@@ -142,6 +163,15 @@ class Histogram(_Metric):
     def time(self, **labels):
         """Context manager measuring seconds."""
         return _Timer(self, labels)
+
+    def count(self, **labels) -> int:
+        """Total observations for a label set (programmatic consumers:
+        bench output, scheduler occupancy stats)."""
+        return self._totals.get(tuple(sorted(labels.items())), 0)
+
+    def sum(self, **labels) -> float:
+        """Sum of observed values for a label set."""
+        return self._sums.get(tuple(sorted(labels.items())), 0.0)
 
     def percentile(self, q: float, **labels) -> float:
         """Approximate percentile from bucket midpoints (tests/metrics)."""

@@ -64,7 +64,7 @@ def _verify_witness_block(client, wlb: LightBlock) -> str | None:
     try:
         VerifyCommitLight(client.chain_id, wlb.validators,
                           wlb.commit.block_id, wlb.height, wlb.commit,
-                          backend=client.backend)
+                          backend=client.backend, use_cache=False)
     except CommitVerificationError as e:
         return str(e)
     return None
@@ -166,16 +166,33 @@ async def detect_divergence(client, lb: LightBlock, now_ns: int,
     try:
         if not conflicts:
             return
-        # a real fork on at least one side: walk the trace against the
-        # first conflicting witness (detector.go:121 examines each; one
-        # verified two-sided divergence is already fatal here)
-        witness, wlb = conflicts[0]
-        try:
-            common, primary_div, witness_div = await _examine_against_trace(
-                client, witness, trace)
-        except LightClientError:
-            bad_witnesses.append(witness)
-            raise
+        # a real fork on at least one side: walk the trace against EVERY
+        # conflicting witness until one yields a verified two-sided
+        # divergence (detector.go:121 examines each conflict).  A trace
+        # walk that fails — the witness served an invalid or missing
+        # intermediate block — marks THAT witness bad and moves on: one
+        # broken witness must not mask a real attack another conflicting
+        # witness can still prove.
+        last_err: Exception | None = None
+        witness = wlb = None
+        common = primary_div = witness_div = None
+        for cand, cand_wlb in conflicts:
+            try:
+                common, primary_div, witness_div = \
+                    await _examine_against_trace(client, cand, trace)
+            except (LightClientError, ErrLightBlockNotFound) as e:
+                bad_witnesses.append(cand)
+                last_err = e
+                continue
+            witness, wlb = cand, cand_wlb
+            break
+        if witness is None:
+            # every conflicting witness failed the walk: surface the
+            # last failure (callers treat it as witness misbehavior)
+            raise last_err if isinstance(last_err, LightClientError) \
+                else LightClientError(
+                    f"all conflicting witnesses failed the trace walk: "
+                    f"{last_err}")
         ev_against_primary = _attack_evidence(primary_div, common)
         ev_against_witness = _attack_evidence(witness_div, common)
         # evidence goes to whichever side is honest: the witness

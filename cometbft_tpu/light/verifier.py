@@ -69,7 +69,7 @@ def verify_adjacent(chain_id: str, trusted: LightBlock,
             "header validators_hash != trusted next_validators_hash")
     VerifyCommitLight(chain_id, untrusted.validators,
                       untrusted.commit.block_id, untrusted.height,
-                      untrusted.commit, backend=backend)
+                      untrusted.commit, backend=backend, use_cache=False)
 
 
 def verify_non_adjacent(chain_id: str, trusted: LightBlock,
@@ -97,7 +97,7 @@ def verify_non_adjacent(chain_id: str, trusted: LightBlock,
     # and the NEW set must have signed its own header with > 2/3 (:71)
     VerifyCommitLight(chain_id, untrusted.validators,
                       untrusted.commit.block_id, untrusted.height,
-                      untrusted.commit, backend=backend)
+                      untrusted.commit, backend=backend, use_cache=False)
 
 
 def verify(chain_id: str, trusted: LightBlock, untrusted: LightBlock,

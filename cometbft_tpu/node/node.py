@@ -109,6 +109,7 @@ class Node:
         self.name = "node"
         self._started = False
         self._data_lock = None
+        self._vote_sched = None
 
     # ------------------------------------------------------------- create
 
@@ -402,6 +403,18 @@ class Node:
 
         cryptomerkle.set_merkle_kernel_min(
             self.config.base.merkle_kernel_min_leaves)
+        if self.config.base.vote_sched_enable:
+            # process-wide coalescing vote-verification scheduler:
+            # in-proc ensembles share one (refcounted) instance — the
+            # verified-signature cache holds universal verdicts and
+            # cross-node coalescing only improves batch occupancy
+            from ..crypto import scheduler as vsched
+
+            self._vote_sched = await vsched.acquire_scheduler(
+                backend=self.config.base.signature_backend,
+                max_wait_ms=self.config.base.vote_sched_max_wait_ms,
+                max_lanes=self.config.base.vote_sched_max_lanes,
+                cache_size=self.config.base.vote_sched_cache_size)
 
         def _warm_native():
             # build/load the C++ verifiers off the event loop so a fresh
@@ -481,6 +494,11 @@ class Node:
             await self.blocksync_reactor.stop()
         if self.consensus is not None:
             await self.consensus.stop()
+        if self._vote_sched is not None:
+            from ..crypto import scheduler as vsched
+
+            self._vote_sched = None
+            await vsched.release_scheduler()
         if self.switch is not None:
             await self.switch.stop()
         if self.app_conns is not None:

@@ -73,26 +73,38 @@ def _check_commit_basics(vals: ValidatorSet, commit: Commit, height: int,
 def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             voting_power_needed: int, *, count_all: bool,
             verify_nil_sigs: bool, lookup_by_address: bool,
-            backend: str | None) -> None:
+            backend: str | None, use_cache: bool = True) -> None:
     """Shared tally+verify core (types/validation.go verifyCommitBatch).
 
     count_all=False allows early exit once the tally clears the threshold
     (remaining signatures are NOT verified — VerifyCommitLight semantics).
+
+    use_cache consults (and seeds) the verified-signature cache
+    (``crypto/scheduler``): a commit signature already verified as a
+    gossiped vote costs a dict hit instead of a scalar multiplication.
+    The evidence-path ``*AllSignatures`` variants pass False — evidence
+    verification never trusts the cache.
     """
+    from ..crypto import scheduler as _vsched
+
     if not lookup_by_address:
         if _dense_verify(chain_id, vals, commit, voting_power_needed,
                          count_all=count_all,
                          verify_nil_sigs=verify_nil_sigs,
-                         backend=backend or _DEFAULT_BACKEND):
+                         backend=backend or _DEFAULT_BACKEND,
+                         use_cache=use_cache):
             return
     elif not verify_nil_sigs:
         if _dense_verify_trusting(chain_id, vals, commit,
                                   voting_power_needed,
                                   count_all=count_all,
-                                  backend=backend or _DEFAULT_BACKEND):
+                                  backend=backend or _DEFAULT_BACKEND,
+                                  use_cache=use_cache):
             return
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
     lanes: list[int] = []          # commit-sig indices added to the batch
+    seeds: list[tuple] = []        # lanes to seed into the cache on success
+    cache_on = use_cache and _vsched.cache_active()
     tally = 0
     seen: set[bytes] = set()
 
@@ -114,9 +126,15 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             seen.add(cs.validator_address)
         else:
             val = vals.get_by_index(idx)
-        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-               cs.signature)
-        lanes.append(idx)
+        msg = commit.vote_sign_bytes(chain_id, idx)
+        if cache_on and _vsched.cache_lookup(val.pub_key.bytes(), msg,
+                                             cs.signature):
+            pass            # verified before (gossip/scheduler): free lane
+        else:
+            bv.add(val.pub_key, msg, cs.signature)
+            lanes.append(idx)
+            if cache_on:
+                seeds.append((val.pub_key.bytes(), msg, cs.signature))
         if cs.is_commit():
             tally += val.voting_power
         if not count_all and tally > voting_power_needed:
@@ -127,14 +145,37 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
         if not ok:
             first_bad = lanes[oks.index(False)]
             raise ErrInvalidSignature(first_bad)
+        for s in seeds:
+            _vsched.cache_seed(*s)
     if tally <= voting_power_needed:
         raise ErrNotEnoughVotingPower(
             f"tallied {tally} <= needed {voting_power_needed}")
 
 
+def _cache_split(pubs_sel, sigs_sel, msgs, lens):
+    """Per-lane verified-signature cache consult for dense rows: returns
+    ``(hit mask, keys)`` where keys feed :func:`cache_seed` after a
+    successful verification.  Key material matches the object path
+    exactly — raw 32-byte pubkey, exact sign bytes, 64-byte signature —
+    so gossip-time seeds hit commit-time lookups."""
+    import numpy as np
+
+    from ..crypto import scheduler as _vsched
+
+    k = pubs_sel.shape[0]
+    mask = np.zeros((k,), bool)
+    keys: list[tuple] = []
+    for i in range(k):
+        key = (pubs_sel[i].tobytes(), msgs[i, :int(lens[i])].tobytes(),
+               sigs_sel[i].tobytes())
+        keys.append(key)
+        mask[i] = _vsched.cache_lookup(*key)
+    return mask, keys
+
+
 def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
                   needed: int, *, count_all: bool, verify_nil_sigs: bool,
-                  backend: str) -> bool:
+                  backend: str, use_cache: bool = True) -> bool:
     """Vectorized VerifyCommit core: columnar valset/commit views + the
     native sign-bytes builder + one dense batch dispatch.  At 10k
     validators this cuts the host side from ~60 ms of per-lane Python to
@@ -179,15 +220,31 @@ def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
         if built is None:
             return False
         msgs, lens = built
-        res = cryptobatch.verify_dense(
-            backend, np.ascontiguousarray(pubs[scope]),
-            np.ascontiguousarray(sigmat[scope]), msgs, lens,
-            valset_pubs=pubs, scope=scope)
-        if res is None:
-            return False
-        ok, oks = res
-        if not ok:
-            raise ErrInvalidSignature(int(scope[np.nonzero(~oks)[0][0]]))
+        pubs_sel = np.ascontiguousarray(pubs[scope])
+        sigs_sel = np.ascontiguousarray(sigmat[scope])
+        from ..crypto import scheduler as _vsched
+
+        if use_cache and _vsched.dense_cache_active():
+            mask, keys = _cache_split(pubs_sel, sigs_sel, msgs, lens)
+            live = np.nonzero(~mask)[0]
+        else:
+            keys = None
+            live = np.arange(scope.size)
+        if live.size:
+            res = cryptobatch.verify_dense(
+                backend, np.ascontiguousarray(pubs_sel[live]),
+                np.ascontiguousarray(sigs_sel[live]),
+                np.ascontiguousarray(msgs[live]), lens[live],
+                valset_pubs=pubs, scope=scope[live])
+            if res is None:
+                return False
+            ok, oks = res
+            if not ok:
+                raise ErrInvalidSignature(
+                    int(scope[live[np.nonzero(~oks)[0][0]]]))
+            if keys is not None:
+                for j in live:
+                    _vsched.cache_seed(*keys[j])
     if tally <= needed:
         raise ErrNotEnoughVotingPower(
             f"tallied {tally} <= needed {needed}")
@@ -196,7 +253,8 @@ def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
 
 def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
                            commit: Commit, needed: int, *,
-                           count_all: bool, backend: str) -> bool:
+                           count_all: bool, backend: str,
+                           use_cache: bool = True) -> bool:
     """Dense core of VerifyCommitLightTrusting: commit sigs resolve BY
     ADDRESS into a (possibly different) trusted set.  Lane selection
     stays a (cheap) Python loop — dict lookups, duplicate detection and
@@ -248,15 +306,31 @@ def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
             return False
         msgs, lens = built
         rows_arr = np.asarray(rows)
-        res = cryptobatch.verify_dense(
-            backend, np.ascontiguousarray(pubs[rows_arr]),
-            np.ascontiguousarray(sigmat[scope_arr]), msgs, lens,
-            valset_pubs=pubs, scope=rows_arr)
-        if res is None:
-            return False
-        ok, oks = res
-        if not ok:
-            raise ErrInvalidSignature(scope[int(np.nonzero(~oks)[0][0])])
+        pubs_sel = np.ascontiguousarray(pubs[rows_arr])
+        sigs_sel = np.ascontiguousarray(sigmat[scope_arr])
+        from ..crypto import scheduler as _vsched
+
+        if use_cache and _vsched.dense_cache_active():
+            mask, keys = _cache_split(pubs_sel, sigs_sel, msgs, lens)
+            live = np.nonzero(~mask)[0]
+        else:
+            keys = None
+            live = np.arange(scope_arr.size)
+        if live.size:
+            res = cryptobatch.verify_dense(
+                backend, np.ascontiguousarray(pubs_sel[live]),
+                np.ascontiguousarray(sigs_sel[live]),
+                np.ascontiguousarray(msgs[live]), lens[live],
+                valset_pubs=pubs, scope=rows_arr[live])
+            if res is None:
+                return False
+            ok, oks = res
+            if not ok:
+                raise ErrInvalidSignature(
+                    scope[int(live[np.nonzero(~oks)[0][0]])])
+            if keys is not None:
+                for j in live:
+                    _vsched.cache_seed(*keys[j])
     if tally <= needed:
         raise ErrNotEnoughVotingPower(
             f"tallied {tally} <= needed {needed}")
@@ -303,30 +377,40 @@ def VerifyCommit(chain_id: str, vals: ValidatorSet, block_id, height: int,
 
 def VerifyCommitLight(chain_id: str, vals: ValidatorSet, block_id,
                       height: int, commit: Commit,
-                      backend: str | None = None) -> None:
+                      backend: str | None = None,
+                      use_cache: bool = True) -> None:
     """Commit-flag signatures only, early exit at > 2/3
-    (types/validation.go:63 — blocksync/light-client hot path)."""
+    (types/validation.go:63 — blocksync/light-client hot path).
+
+    Callers verifying commits that were never gossiped to this node
+    (light-client backfill, blocksync fallbacks) pass use_cache=False:
+    with zero possible hits, the per-lane cache consult is pure
+    overhead."""
     _check_commit_basics(vals, commit, height, block_id)
     needed = vals.total_voting_power() * 2 // 3
     _verify(chain_id, vals, commit, needed, count_all=False,
-            verify_nil_sigs=False, lookup_by_address=False, backend=backend)
+            verify_nil_sigs=False, lookup_by_address=False, backend=backend,
+            use_cache=use_cache)
 
 
 def VerifyCommitLightAllSignatures(chain_id: str, vals: ValidatorSet,
                                    block_id, height: int, commit: Commit,
                                    backend: str | None = None) -> None:
-    """types/validation.go:96 (evidence path: no early exit)."""
+    """types/validation.go:96 (evidence path: no early exit, and no
+    verified-signature cache — evidence rests on fresh verification)."""
     _check_commit_basics(vals, commit, height, block_id)
     needed = vals.total_voting_power() * 2 // 3
     _verify(chain_id, vals, commit, needed, count_all=True,
-            verify_nil_sigs=False, lookup_by_address=False, backend=backend)
+            verify_nil_sigs=False, lookup_by_address=False, backend=backend,
+            use_cache=False)
 
 
 def VerifyCommitLightTrusting(chain_id: str, vals: ValidatorSet,
                               commit: Commit,
                               trust_level: Fraction = Fraction(1, 3),
                               backend: str | None = None,
-                              count_all: bool = False) -> None:
+                              count_all: bool = False,
+                              use_cache: bool = True) -> None:
     """Trust-level verification against a possibly different validator set,
     lookup by address (types/validation.go:127 — light-client skipping
     verification)."""
@@ -335,7 +419,8 @@ def VerifyCommitLightTrusting(chain_id: str, vals: ValidatorSet,
     needed = (vals.total_voting_power() * trust_level.numerator
               // trust_level.denominator)
     _verify(chain_id, vals, commit, needed, count_all=count_all,
-            verify_nil_sigs=False, lookup_by_address=True, backend=backend)
+            verify_nil_sigs=False, lookup_by_address=True, backend=backend,
+            use_cache=use_cache)
 
 
 class ErrBatchItemInvalid(CommitVerificationError):
@@ -467,6 +552,7 @@ def VerifyCommitLightTrustingAllSignatures(chain_id: str, vals: ValidatorSet,
                                            commit: Commit,
                                            trust_level: Fraction = Fraction(1, 3),
                                            backend: str | None = None) -> None:
-    """types/validation.go:182 (evidence path)."""
+    """types/validation.go:182 (evidence path: no cache, see above)."""
     VerifyCommitLightTrusting(chain_id, vals, commit, trust_level,
-                              backend=backend, count_all=True)
+                              backend=backend, count_all=True,
+                              use_cache=False)

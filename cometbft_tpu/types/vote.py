@@ -33,11 +33,25 @@ class Vote:
     signature: bytes = b""
     extension: bytes = b""
     extension_signature: bytes = b""
+    # sign-bytes memo: one vote is encoded up to three times on the hot
+    # path (reactor prefetch, VoteSet._verify, evidence).  The guard
+    # tuple revalidates every field the encoding reads, so mutating a
+    # vote (privval timestamp adjustment, WAL decode reuse) can never
+    # serve stale bytes.  Excluded from equality/repr.
+    _sb_memo: tuple | None = field(default=None, compare=False, repr=False)
 
     def sign_bytes(self, chain_id: str) -> bytes:
-        return canonical.canonical_vote_sign_bytes(
+        guard = (chain_id, self.type, self.height, self.round,
+                 self.block_id, self.timestamp_ns)
+        memo = self._sb_memo
+        if memo is not None and memo[0] == guard:
+            return memo[1]
+        sb = canonical.canonical_vote_sign_bytes(
             chain_id, self.type, self.height, self.round, self.block_id,
             self.timestamp_ns)
+        # plain attribute write: dataclass is not frozen
+        object.__setattr__(self, "_sb_memo", (guard, sb))
+        return sb
 
     def extension_sign_bytes(self, chain_id: str) -> bytes:
         return canonical.canonical_vote_extension_sign_bytes(

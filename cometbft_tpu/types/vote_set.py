@@ -98,8 +98,11 @@ class VoteSet:
                 if existing.signature == vote.signature:
                     return False              # duplicate
                 raise VoteSetError("same block, different signature")
-            # conflicting vote — verify, maybe track, raise for evidence
-            if not self._verify(vote, val):
+            # conflicting vote — verify, maybe track, raise for evidence.
+            # The verification deliberately bypasses the verified-sig
+            # cache: an equivocation proof that slashes a validator must
+            # rest on a fresh scalar multiplication, never a cache entry.
+            if not self._verify(vote, val, use_cache=False):
                 raise VoteSetError("invalid signature on conflicting vote")
             self._maybe_track_conflict(vote, val)
             raise ConflictingVoteError(existing, vote)
@@ -115,13 +118,33 @@ class VoteSet:
         self._maybe_promote_maj23(vote.block_id, bv)
         return True
 
-    def _verify(self, vote: Vote, val) -> bool:
+    def _verify(self, vote: Vote, val, *, use_cache: bool = True) -> bool:
+        """Signature check for one gossiped vote — the steady-state hot
+        path.  Routed through the verified-signature cache
+        (``crypto/scheduler``): the consensus reactor pre-verifies
+        gossiped votes in coalesced micro-batches, so by the time the
+        single-writer handler gets here the verdict is usually a cache
+        hit.  With no scheduler registered this is a plain direct
+        verification, byte-for-byte the old behavior."""
+        from ..crypto import scheduler as _vsched
+
+        check = _vsched.verify_cached if use_cache \
+            else _vsched.verify_uncached
         if self.extensions_enabled and vote.type == PRECOMMIT_TYPE:
-            return vote.verify_vote_and_extension(
-                self.chain_id, val.pub_key, require_extension=True)
+            if not check(val.pub_key, vote.sign_bytes(self.chain_id),
+                         vote.signature):
+                return False
+            if vote.block_id.is_nil():
+                # nil precommits carry no extension to require
+                # (vote.go VerifyVoteAndExtension skips the check)
+                return True
+            return check(val.pub_key,
+                         vote.extension_sign_bytes(self.chain_id),
+                         vote.extension_signature)
         if vote.extension_signature and not self.extensions_enabled:
             return False
-        return vote.verify(self.chain_id, val.pub_key)
+        return check(val.pub_key, vote.sign_bytes(self.chain_id),
+                     vote.signature)
 
     def _get_or_make_block_votes(self, block_id: BlockID) -> _BlockVotes:
         key = block_id.key()

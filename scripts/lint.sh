@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Style/syntax gate for cometbft_tpu/ + tests/ — catches rot BEFORE the
+# 870 s tier-1 budget is spent on it.
+#
+# Linter resolution order (the container bakes no linters, CI may):
+#   1. ruff            (fast, superset of pyflakes)
+#   2. pyflakes        (undefined names, unused imports, syntax)
+#   3. compileall      (always available: pure syntax pass)
+# The fallback is weaker but never silently green on a syntax error.
+set -u
+cd "$(dirname "$0")/.."
+
+TARGETS=(cometbft_tpu tests bench.py)
+rc=0
+
+if command -v ruff >/dev/null 2>&1; then
+    echo "[lint] ruff check ${TARGETS[*]}"
+    # E9/F = syntax errors + pyflakes classes; style classes stay off so
+    # the gate matches what pyflakes-only environments enforce
+    ruff check --select E9,F --no-cache "${TARGETS[@]}" || rc=1
+elif python -c 'import pyflakes' >/dev/null 2>&1; then
+    echo "[lint] pyflakes ${TARGETS[*]}"
+    python -m pyflakes "${TARGETS[@]}" || rc=1
+else
+    echo "[lint] no ruff/pyflakes in this environment; syntax-only pass"
+    python -m compileall -q "${TARGETS[@]}" || rc=1
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "[lint] FAILED"
+else
+    echo "[lint] clean"
+fi
+exit "$rc"

@@ -1,0 +1,479 @@
+"""Coalescing vote-verification scheduler (crypto/scheduler.py): flush
+ordering, per-item demux, cache safety, dedup, lifecycle, and the
+VoteSet/VerifyCommit cache integrations.  All tier-1-fast, CPU backend.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from cometbft_tpu.crypto import scheduler as vsched
+from cometbft_tpu.crypto.keys import gen_priv_key
+from cometbft_tpu.crypto.scheduler import (VerificationScheduler,
+                                           VerifiedSigCache, cache_key,
+                                           snap_lane_cap)
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+from cometbft_tpu.types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Vote
+from cometbft_tpu.types.vote_set import (ConflictingVoteError, VoteSet,
+                                         VoteSetError)
+
+CHAIN = "sched-test"
+
+
+@pytest.fixture(autouse=True)
+def _no_global_scheduler():
+    """Tests manage the process-global scheduler explicitly; never leak
+    one into (or out of) a test."""
+    vsched.set_scheduler(None)
+    yield
+    vsched.set_scheduler(None)
+
+
+def _signed(n=4, msg_len=64, seed=1):
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        priv = gen_priv_key()
+        msg = bytes(rng.randrange(256) for _ in range(msg_len))
+        out.append((priv.pub_key(), msg, priv.sign(msg)))
+    return out
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+def _flushes(sched, reason):
+    return sched._m[6].value(reason=reason)
+
+
+# ------------------------------------------------------------- unit: cache
+
+def test_cache_lru_bound_and_positive_only():
+    c = VerifiedSigCache(max_size=3)
+    keys = [cache_key(bytes([i]) * 32, b"m%d" % i, b"s" * 64)
+            for i in range(5)]
+    for k in keys:
+        c.seed(k)
+    assert len(c) == 3
+    assert not c.hit(keys[0]) and not c.hit(keys[1])   # evicted, oldest
+    assert c.hit(keys[2]) and c.hit(keys[3]) and c.hit(keys[4])
+    # hit refreshes recency: 2 is now newest, seeding 2 more evicts 3
+    c.hit(keys[2])
+    c.seed(keys[0])
+    c.seed(keys[1])
+    assert c.hit(keys[2])
+    assert not c.hit(keys[3])
+
+
+def test_cache_size_zero_disables():
+    c = VerifiedSigCache(max_size=0)
+    k = cache_key(b"p" * 32, b"m", b"s" * 64)
+    c.seed(k)
+    assert not c.hit(k)
+
+
+def test_snap_lane_cap_buckets():
+    assert snap_lane_cap(256) == 256
+    assert snap_lane_cap(300) == 256          # down, never up
+    assert snap_lane_cap(4) == 4              # below 16: honored exactly
+    assert snap_lane_cap(17) == 16            # between buckets: down
+    assert snap_lane_cap(100000) == 4096      # lane cap
+
+
+# --------------------------------------------------------- flush ordering
+
+def test_window_flush_fires_without_filling_lanes():
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=20,
+                                  max_lanes=256)
+        await s.start()
+        try:
+            items = _signed(3)
+            t0 = asyncio.get_event_loop().time()
+            oks = await asyncio.gather(
+                *(s.verify(p, m, sig) for p, m, sig in items))
+            dt = asyncio.get_event_loop().time() - t0
+            assert oks == [True, True, True]
+            # resolved by the WINDOW (3 lanes never reach the 256 cap),
+            # after >= the window bound but well under a second
+            assert _flushes(s, "window") >= 1
+            assert _flushes(s, "size") == 0
+            assert 0.015 <= dt < 2.0
+        finally:
+            await s.stop()
+    _run(main())
+
+
+def test_size_flush_preempts_window():
+    async def main():
+        # max_wait absurdly long: only the size trigger can resolve the
+        # batch quickly — proves cap-filling flushes immediately
+        s = VerificationScheduler(backend="cpu", max_wait_ms=30_000,
+                                  max_lanes=16)
+        assert s.max_lanes == 16
+        await s.start()
+        try:
+            items = _signed(16)
+            occ = s._m[0]                      # process-global histogram:
+            c0, sum0 = occ.count(), occ.sum()  # assert on the DELTA
+            t0 = asyncio.get_event_loop().time()
+            oks = await asyncio.wait_for(asyncio.gather(
+                *(s.verify(p, m, sig) for p, m, sig in items)), timeout=10)
+            dt = asyncio.get_event_loop().time() - t0
+            assert all(oks)
+            assert _flushes(s, "size") >= 1
+            assert dt < 5.0                    # nowhere near 30 s
+            # occupancy histogram saw exactly one full 16-lane bucket
+            assert occ.count() - c0 == 1
+            assert occ.sum() - sum0 == 16
+        finally:
+            await s.stop()
+    _run(main())
+
+
+# ------------------------------------------------- demux + cache safety
+
+def test_mixed_batch_matches_per_item_verdicts():
+    """Property test: a mixed good/bad batch demuxes per-item verdicts
+    identical to per-item verification — one bad signature never poisons
+    or rejects its batchmates."""
+    rng = random.Random(42)
+    items = _signed(24, seed=7)
+    corrupted = set(rng.sample(range(24), 6))
+    batch = []
+    for i, (pub, msg, sig) in enumerate(items):
+        if i in corrupted:
+            sig = bytes([sig[0] ^ 0x5A]) + sig[1:]
+        batch.append((pub, msg, sig))
+    expect = [pub.verify_signature(m, s) for pub, m, s in batch]
+    assert [i for i, ok in enumerate(expect) if not ok] == sorted(corrupted)
+
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=5,
+                                  max_lanes=256)
+        await s.start()
+        try:
+            got = await asyncio.gather(
+                *(s.verify(p, m, sig) for p, m, sig in batch))
+            assert got == expect
+            # NEGATIVE verdicts were not cached: resubmitting a bad sig
+            # re-verifies and re-fails (cache holds only the good lanes)
+            assert len(s.cache) == 24 - len(corrupted)
+            for i in corrupted:
+                pub, msg, sig = batch[i]
+                assert not s.cache.hit(cache_key(pub.bytes(), msg, sig))
+                assert not await s.verify(pub, msg, sig)
+        finally:
+            await s.stop()
+    _run(main())
+
+
+def test_duplicate_suppression_counts():
+    """k concurrent requests for one signature verify once: one lane,
+    k-1 in-flight dedup hits; later repeats are cache hits."""
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=5,
+                                  max_lanes=256)
+        await s.start()
+        try:
+            (pub, msg, sig), = _signed(1)
+            dedup0 = s.stats()["dedup_inflight"]
+            lanes0 = s.stats()["lanes_ok"]
+            oks = await asyncio.gather(
+                *(s.verify(pub, msg, sig) for _ in range(9)))
+            assert oks == [True] * 9
+            st = s.stats()
+            assert st["dedup_inflight"] - dedup0 == 8
+            assert st["lanes_ok"] - lanes0 == 1            # ONE scalar mul
+            hits0 = st["cache_hits"]
+            assert await s.verify(pub, msg, sig)           # now cached
+            assert s.stats()["cache_hits"] - hits0 == 1
+        finally:
+            await s.stop()
+    _run(main())
+
+
+def test_submit_nowait_callbacks_and_cache_hit_sync():
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=5,
+                                  max_lanes=256)
+        await s.start()
+        try:
+            (pub, msg, sig), = _signed(1)
+            got: list[bool] = []
+            fut = asyncio.get_running_loop().create_future()
+            s.submit_nowait(pub, msg, sig,
+                            on_done=lambda ok: (got.append(ok),
+                                                fut.set_result(None)))
+            await asyncio.wait_for(fut, 5)
+            assert got == [True]
+            # cache hit path invokes the callback synchronously
+            s.submit_nowait(pub, msg, sig, on_done=got.append)
+            assert got == [True, True]
+        finally:
+            await s.stop()
+    _run(main())
+
+
+def test_clean_stop_resolves_inflight_requests():
+    """stop() with requests parked in an unexpired window: every caller
+    gets a real verdict, nothing hangs, nothing leaks."""
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=60_000,
+                                  max_lanes=256)
+        await s.start()
+        items = _signed(5)
+        tasks = [asyncio.create_task(s.verify(p, m, sig))
+                 for p, m, sig in items]
+        await asyncio.sleep(0.05)          # parked: window is a minute out
+        assert not any(t.done() for t in tasks)
+        await asyncio.wait_for(s.stop(), timeout=10)
+        oks = await asyncio.wait_for(asyncio.gather(*tasks), timeout=5)
+        assert oks == [True] * 5
+        assert _flushes(s, "stop") >= 1
+        assert not s._pending and not s._inflight
+        # post-stop verification degrades to the direct path
+        pub, msg, sig = items[0]
+        assert await s.verify(pub, msg, sig)
+    _run(main())
+
+
+# ------------------------------------------------------ VoteSet integration
+
+def _valset(n):
+    privs = [gen_priv_key() for _ in range(n)]
+    vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+    by_addr = {p.pub_key().address(): p for p in privs}
+    return vals, by_addr
+
+
+def _vote(vals, by_addr, i, bid, typ=PREVOTE_TYPE, height=3):
+    v = vals.get_by_index(i)
+    vote = Vote(type=typ, height=height, round=0, block_id=bid,
+                timestamp_ns=5_000 + i, validator_address=v.address,
+                validator_index=i)
+    vote.signature = by_addr[v.address].sign(vote.sign_bytes(CHAIN))
+    return vote
+
+
+def test_vote_set_rides_scheduler_cache():
+    """Votes pre-verified through the scheduler hit the cache inside
+    VoteSet._verify — zero direct verifications on the add_vote path."""
+    async def main():
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            vals, by_addr = _valset(4)
+            bid = BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32))
+            votes = [_vote(vals, by_addr, i, bid) for i in range(4)]
+            await asyncio.gather(*(
+                s.verify(vals.get_by_index(v.validator_index).pub_key,
+                         v.sign_bytes(CHAIN), v.signature) for v in votes))
+            hits0 = s._m[3].value(source="votes")
+            vs = VoteSet(CHAIN, 3, 0, PREVOTE_TYPE, vals)
+            for v in votes:
+                assert vs.add_vote(v)
+            assert s._m[3].value(source="votes") - hits0 == 4
+            assert vs.has_two_thirds_majority()
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+def test_conflicting_vote_never_trusts_cache():
+    """Equivocation path: a conflicting vote with an INVALID signature
+    must be rejected even when a (hypothetically poisoned) cache entry
+    claims it valid — the evidence path bypasses the cache."""
+    async def main():
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            vals, by_addr = _valset(4)
+            bid_a = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+            bid_b = BlockID(b"\x03" * 32, PartSetHeader(1, b"\x04" * 32))
+            vs = VoteSet(CHAIN, 3, 0, PREVOTE_TYPE, vals)
+            assert vs.add_vote(_vote(vals, by_addr, 0, bid_a))
+            # conflicting vote for a different block, signature INVALID
+            bad = _vote(vals, by_addr, 0, bid_b)
+            bad.signature = bytes([bad.signature[0] ^ 0xFF]) \
+                + bad.signature[1:]
+            pub = vals.get_by_index(0).pub_key
+            s.cache.seed(cache_key(pub.bytes(), bad.sign_bytes(CHAIN),
+                                   bad.signature))       # poison attempt
+            with pytest.raises(VoteSetError):
+                vs.add_vote(bad)
+            # the SAME conflicting vote validly signed still raises
+            # ConflictingVoteError (the evidence hook), proving only the
+            # cache-trusting shortcut was bypassed, not the logic
+            good = _vote(vals, by_addr, 0, bid_b)
+            with pytest.raises(ConflictingVoteError):
+                vs.add_vote(good)
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+# -------------------------------------------------- VerifyCommit integration
+
+def test_verify_commit_consults_and_seeds_cache():
+    from cometbft_tpu.types.validation import VerifyCommit
+
+    async def main():
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            vals, by_addr = _valset(4)
+            bid = BlockID(b"\x05" * 32, PartSetHeader(1, b"\x06" * 32))
+            vs = VoteSet(CHAIN, 7, 0, PRECOMMIT_TYPE, vals)
+            votes = [_vote(vals, by_addr, i, bid, typ=PRECOMMIT_TYPE,
+                           height=7) for i in range(4)]
+            # gossip first: precommits verify through the scheduler
+            await asyncio.gather(*(
+                s.verify(vals.get_by_index(v.validator_index).pub_key,
+                         v.sign_bytes(CHAIN), v.signature) for v in votes))
+            for v in votes:
+                vs.add_vote(v)
+            commit = vs.make_commit()
+            hits0 = s._m[3].value(source="commit")
+            miss0 = s._m[4].value(source="commit")
+            VerifyCommit(CHAIN, vals, bid, 7, commit, backend="cpu")
+            hits = s._m[3].value(source="commit") - hits0
+            miss = s._m[4].value(source="commit") - miss0
+            # every commit signature was already verified as a gossiped
+            # vote: all cache hits, zero new scalar multiplications
+            assert hits == 4 and miss == 0
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+def test_verify_commit_seeds_then_second_pass_free():
+    from cometbft_tpu.types.validation import VerifyCommit
+
+    async def main():
+        # fixtures built with NO scheduler registered: nothing seeds the
+        # cache, modeling a commit whose signatures this node never saw
+        # as gossip (cold start / catch-up)
+        vals, by_addr = _valset(4)
+        bid = BlockID(b"\x09" * 32, PartSetHeader(1, b"\x0a" * 32))
+        vs = VoteSet(CHAIN, 9, 0, PRECOMMIT_TYPE, vals)
+        votes = [_vote(vals, by_addr, i, bid, typ=PRECOMMIT_TYPE,
+                       height=9) for i in range(4)]
+        for v in votes:
+            vs.add_vote(v)
+        commit = vs.make_commit()
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            # an EMPTY cache is skipped by the dense paths entirely (a
+            # cold-start node must not pay per-lane key building for
+            # guaranteed misses): no cache traffic, no seeding
+            miss0 = s._m[4].value(source="commit")
+            VerifyCommit(CHAIN, vals, bid, 9, commit, backend="cpu")
+            assert s._m[4].value(source="commit") - miss0 == 0
+            assert len(s.cache) == 0
+            # one gossiped vote warms the cache; the next VerifyCommit
+            # consults, hits that lane, verifies + SEEDS the other three
+            v0 = votes[0]
+            assert await s.verify(
+                vals.get_by_index(0).pub_key, v0.sign_bytes(CHAIN),
+                v0.signature)
+            VerifyCommit(CHAIN, vals, bid, 9, commit, backend="cpu")
+            hits0 = s._m[3].value(source="commit")
+            VerifyCommit(CHAIN, vals, bid, 9, commit, backend="cpu")
+            assert s._m[3].value(source="commit") - hits0 == 4
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+def test_evidence_variant_bypasses_poisoned_cache():
+    """VerifyCommitLightAllSignatures (evidence path) must re-verify and
+    reject a corrupted signature even when the cache claims it valid."""
+    from cometbft_tpu.types.validation import (ErrInvalidSignature,
+                                               VerifyCommitLightAllSignatures)
+
+    async def main():
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            vals, by_addr = _valset(4)
+            bid = BlockID(b"\x0b" * 32, PartSetHeader(1, b"\x0c" * 32))
+            vs = VoteSet(CHAIN, 11, 0, PRECOMMIT_TYPE, vals)
+            for i in range(4):
+                vs.add_vote(_vote(vals, by_addr, i, bid,
+                                  typ=PRECOMMIT_TYPE, height=11))
+            commit = vs.make_commit()
+            # corrupt one signature post-commit, then poison the cache
+            # with the corrupted triple
+            cs0 = commit.signatures[0]
+            cs0.signature = bytes([cs0.signature[0] ^ 0x80]) \
+                + cs0.signature[1:]
+            s.cache.seed(cache_key(
+                vals.get_by_index(0).pub_key.bytes(),
+                commit.vote_sign_bytes(CHAIN, 0), cs0.signature))
+            with pytest.raises(ErrInvalidSignature):
+                VerifyCommitLightAllSignatures(CHAIN, vals, bid, 11,
+                                               commit, backend="cpu")
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+# ----------------------------------------------------------- feed_vote path
+
+def test_feed_vote_prefetch_enqueues_after_verdict():
+    """ConsensusState.feed_vote with a running scheduler: the vote lands
+    in the state queue exactly once, post-verification, and the cache is
+    warm for add_vote."""
+    from cometbft_tpu.consensus.state import ConsensusState
+
+    async def main():
+        s = await vsched.acquire_scheduler(backend="cpu", max_wait_ms=2,
+                                           max_lanes=64)
+        try:
+            vals, by_addr = _valset(4)
+            bid = BlockID(b"\x0d" * 32, PartSetHeader(1, b"\x0e" * 32))
+            vote = _vote(vals, by_addr, 1, bid, height=1)
+
+            # minimal stand-in: only the attributes feed_vote touches
+            cs = ConsensusState.__new__(ConsensusState)
+            cs.queue = asyncio.Queue()
+            cs.rs = type("RS", (), {})()
+            cs.rs.height = 1
+            cs.rs.validators = vals
+            cs.rs.last_validators = None
+            cs.state = type("S", (), {"chain_id": CHAIN})()
+
+            cs.feed_vote(vote, "peer1")
+            kind, payload, peer = await asyncio.wait_for(cs.queue.get(), 5)
+            assert (kind, peer) == ("vote", "peer1") and payload is vote
+            assert cs.queue.empty()
+            pub = vals.get_by_index(1).pub_key
+            assert s.cache.hit(cache_key(pub.bytes(),
+                                         vote.sign_bytes(CHAIN),
+                                         vote.signature))
+            # own votes (peer "") skip the scheduler: enqueued directly
+            own = _vote(vals, by_addr, 2, bid, height=1)
+            cs.feed_vote(own, "")
+            kind2, payload2, peer2 = cs.queue.get_nowait()
+            assert payload2 is own and peer2 == ""
+        finally:
+            await vsched.release_scheduler()
+    _run(main())
+
+
+def test_acquire_release_refcount():
+    async def main():
+        s1 = await vsched.acquire_scheduler(backend="cpu")
+        s2 = await vsched.acquire_scheduler(backend="cpu")
+        assert s1 is s2 and vsched.get_scheduler() is s1
+        await vsched.release_scheduler()
+        assert vsched.get_scheduler() is s1 and s1.is_running
+        await vsched.release_scheduler()
+        assert vsched.get_scheduler() is None and not s1.is_running
+    _run(main())
