@@ -160,6 +160,12 @@ class InstrumentationConfig:
     # event-loop stall watchdog (libs/loopwatch — the asyncio analogue of
     # the reference's deadlock-detecting mutex build); 0 disables
     loop_stall_threshold_s: float = 1.0
+    # flight-recorder tracing (libs/tracing): span/event ring buffer
+    # dumped via GET /dump_trace.  Off by default — disabled tracing is
+    # compiled down to a no-op on every instrumented path
+    tracing: bool = False
+    # bounded ring capacity (records); old records fall off the back
+    tracing_ring_size: int = 8192
 
 
 @dataclass
@@ -293,6 +299,9 @@ class Config:
             raise ConfigError("base.vote_sched_max_lanes must be >= 1")
         if self.base.vote_sched_cache_size < 0:
             raise ConfigError("base.vote_sched_cache_size must be >= 0")
+        if self.instrumentation.tracing_ring_size < 16:
+            raise ConfigError(
+                "instrumentation.tracing_ring_size must be >= 16")
         if self.storage.db_backend not in ("logdb", "native", "memdb"):
             raise ConfigError(
                 f"storage.db_backend must be logdb|native|memdb, "

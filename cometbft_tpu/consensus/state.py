@@ -25,6 +25,7 @@ from typing import Callable
 from ..config import ConsensusConfig
 from ..libs import log as tmlog
 from ..libs import metrics
+from ..libs import tracing
 from ..libs.pubsub import EventBus
 from ..sm.execution import BlockExecutor
 from ..sm.validation import BlockValidationError
@@ -75,6 +76,15 @@ class ConsensusState:
             buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 30))
         self.m_errors = metrics.counter(
             "consensus_handler_errors_total", "recovered handler errors")
+        self.m_step = metrics.histogram(
+            "consensus_step_seconds",
+            "wall time spent in each consensus step, by step name",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1, 2.5, 5, 10, 30))
+        self.m_assembly = metrics.histogram(
+            "consensus_block_assembly_seconds",
+            "gossip block-part assembly time (first part -> complete)",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5))
 
         self.rs = RoundState()
         self.state: State | None = None
@@ -100,7 +110,50 @@ class ConsensusState:
         # (the reference's EventValidBlock -> NewValidBlockMessage)
         self.on_valid_block: Callable[[], None] = lambda: None
 
+        # timeline bookkeeping: the open flight-recorder span for the
+        # current step, its (name, start) for the step-duration metric,
+        # and the first-part arrival time of the assembling block
+        self._step_span = None
+        self._step_info: tuple[str, float] | None = None
+        self._step_mono = time.monotonic()
+        self._assembly_t0: float | None = None
+
         self._update_to_state(state)
+
+    def _note_round_step(self) -> None:
+        """Every ``rs.step`` transition funnels through here: close the
+        previous step's metric + trace span, open the next one, then run
+        the reactor's ``on_round_step`` hook."""
+        now = time.monotonic()
+        rs = self.rs
+        if self._replaying:
+            # WAL catch-up drives hundreds of transitions in
+            # milliseconds: recording them would flood
+            # consensus_step_seconds with ~0s samples and evict the real
+            # pre-restart timeline from the flight-recorder ring (same
+            # reason replayed commits skip stats below)
+            tracing.finish(self._step_span, replay_interrupted=True)
+            self._step_span = None
+            self._step_info = None
+            self._step_mono = now
+            self.on_round_step()
+            return
+        if self._step_info is not None:
+            name, t0 = self._step_info
+            self.m_step.observe(now - t0, step=name, node=self.name)
+        tracing.finish(self._step_span)
+        self._step_info = (rs.step_name(), now)
+        self._step_mono = now
+        self._step_span = tracing.begin(
+            "consensus", "step", node=self.name, height=rs.height,
+            round=rs.round, step=rs.step_name())
+        self.on_round_step()
+
+    def step_age_s(self) -> float:
+        """Seconds the state machine has sat in the current step (the
+        enriched ``/status`` surface: a large Propose/Prevote age on a
+        live node means a stalled round)."""
+        return max(0.0, time.monotonic() - self._step_mono)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -132,6 +185,11 @@ class ConsensusState:
             self._task = None
         if self.wal is not None:
             self.wal.flush_and_sync()
+        # close the open step span so the flight recorder shows the
+        # final step of a stopped node instead of dropping it
+        tracing.finish(self._step_span, stopped=True)
+        self._step_span = None
+        self._step_info = None
 
     # --------------------------------------------------------- public feeds
 
@@ -364,7 +422,7 @@ class ConsensusState:
         )
         self.rs.start_time_ns = self.rs.commit_time_ns + \
             self.cfg.commit_timeout()
-        self.on_round_step()
+        self._note_round_step()
 
     def _schedule_round0_now(self) -> None:
         delay = max(self.rs.start_time_ns - self.now_ns(), 1)
@@ -425,7 +483,7 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_)
         rs.triggered_timeout_precommit = False
-        self.on_round_step()
+        self._note_round_step()
         self.event_bus.publish(ev.EVENT_NEW_ROUND,
                                {"height": height, "round": round_,
                                 "proposer": self._round_proposer(
@@ -491,7 +549,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PROPOSE):
             return
         rs.step = STEP_PROPOSE
-        self.on_round_step()
+        self._note_round_step()
         self.ticker.schedule(TimeoutInfo(self.cfg.propose_timeout(round_),
                                          height, round_, STEP_PROPOSE))
         if self._is_our_turn(round_):
@@ -593,12 +651,23 @@ class ConsensusState:
             return
         if rs.proposal_block_parts is None:
             return              # parts before proposal: dropped (gossip re-sends)
+        if rs.proposal_block_parts.count == 0:
+            self._assembly_t0 = time.perf_counter()
         try:
             added = rs.proposal_block_parts.add_part(part)
         except Exception:
             return
         if not added or not rs.proposal_block_parts.is_complete():
             return
+        if self._assembly_t0 is not None:
+            dt = time.perf_counter() - self._assembly_t0
+            self._assembly_t0 = None
+            if not self._replaying:     # replayed parts aren't gossip
+                self.m_assembly.observe(dt, node=self.name)
+                tracing.event("consensus", "block_assembled",
+                              node=self.name, height=height,
+                              parts=rs.proposal_block_parts.total,
+                              dur_us=int(dt * 1e6))
         rs.proposal_block = codec.unpack(rs.proposal_block_parts.get_data())
         self.event_bus.publish(ev.EVENT_COMPLETE_PROPOSAL,
                                {"height": height,
@@ -630,7 +699,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PREVOTE):
             return
         rs.step = STEP_PREVOTE
-        self.on_round_step()
+        self._note_round_step()
         await self._do_prevote(height, round_)
 
     async def _do_prevote(self, height: int, round_: int) -> None:
@@ -694,7 +763,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT):
             return
         rs.step = STEP_PREVOTE_WAIT
-        self.on_round_step()
+        self._note_round_step()
         self.ticker.schedule(TimeoutInfo(self.cfg.prevote_timeout(round_),
                                          height, round_, STEP_PREVOTE_WAIT))
 
@@ -705,7 +774,7 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
             return
         rs.step = STEP_PRECOMMIT
-        self.on_round_step()
+        self._note_round_step()
         prevotes = rs.votes.prevotes(round_)
         maj, has_maj = (prevotes.two_thirds_majority()
                         if prevotes else (None, False))
@@ -759,7 +828,7 @@ class ConsensusState:
             return
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
-        self.on_round_step()
+        self._note_round_step()
         rs.commit_time_ns = self.now_ns()
         precommits = rs.votes.precommits(commit_round)
         maj, _ = precommits.two_thirds_majority()
@@ -827,6 +896,9 @@ class ConsensusState:
                 self.m_block_interval.observe(
                     max(now - last_wall, 0) / 1e9, node=self.name)
             self._last_commit_wall_ns = now
+            tracing.event("consensus", "commit", node=self.name,
+                          height=height, round=rs.commit_round,
+                          txs=len(block.data.txs))
             self.log.debug("committed block", height=height,
                            round=rs.commit_round, hash=block.hash(),
                            n_txs=len(block.data.txs))

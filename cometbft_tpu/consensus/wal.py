@@ -16,11 +16,15 @@ checkpointing).  Torn tails are truncated on open."""
 
 from __future__ import annotations
 
+import functools
 import os
 import struct
+import time
 import zlib
 
 import msgpack
+
+from ..libs import tracing
 
 _HDR = struct.Struct("<II")
 MAX_BODY = 1 << 20            # 1 MB cap, like the reference's maxMsgSizeBytes
@@ -29,6 +33,25 @@ DEFAULT_SEGMENT_BYTES = 4 << 20
 
 class WALError(Exception):
     pass
+
+
+@functools.cache
+def _wal_metrics():
+    """WAL latency series (registered once): fsync stalls on a loaded
+    disk are a classic hidden consensus-latency source — every own vote
+    is fsync'd before it may be broadcast."""
+    from ..libs import metrics as m
+
+    return (
+        m.histogram("consensus_wal_write_seconds",
+                    "WAL record append latency (buffered write)",
+                    buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                             0.0025, 0.005, 0.01, 0.05, 0.1)),
+        m.histogram("consensus_wal_fsync_seconds",
+                    "WAL flush+fsync latency",
+                    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                             0.005, 0.01, 0.025, 0.05, 0.1, 0.5, 1)),
+    )
 
 
 def wal_segments(path: str) -> list[str]:
@@ -180,11 +203,13 @@ class WAL:
     # -------------------------------------------------------------- write
 
     def write(self, record: dict) -> None:
+        t0 = time.perf_counter()
         body = msgpack.packb(record, use_bin_type=True)
         if len(body) > MAX_BODY:
             raise WALError(f"record too big: {len(body)}")
         self._f.write(_HDR.pack(zlib.crc32(body), len(body)) + body)
         self._maybe_rotate()
+        _wal_metrics()[0].observe(time.perf_counter() - t0)
 
     def write_sync(self, record: dict) -> None:
         self.write(record)
@@ -201,8 +226,13 @@ class WAL:
         self._prev_sentinel_seg = sentinel_seg
 
     def flush_and_sync(self) -> None:
+        t0 = time.perf_counter()
         self._f.flush()
         os.fsync(self._f.fileno())
+        dt = time.perf_counter() - t0
+        _wal_metrics()[1].observe(dt)
+        tracing.event("wal", "fsync", path=self._cur_path,
+                      dur_us=int(dt * 1e6))
 
     # --------------------------------------------------------------- read
 

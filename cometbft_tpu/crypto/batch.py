@@ -22,6 +22,7 @@ reference's pure-Go path).
 from __future__ import annotations
 
 import functools
+import time
 from abc import ABC, abstractmethod
 
 import numpy as np
@@ -327,10 +328,18 @@ def _valset_tables(pubs_full, devices: tuple):
     padded[n:] = pubs_full[0] if n else 0
     if len(devices) == 1:
         # pinned single chip: build the table THERE, not on the default
+        padded = _timed_put(padded, devices[0])
+    t0 = time.perf_counter()
+    tab, ok = _compiled_prepare_tables()(padded)
+    try:
+        # force completion so the timing covers the table-build kernel,
+        # not just its enqueue (runs once per valset, not per batch)
         import jax
 
-        padded = jax.device_put(padded, devices[0])
-    tab, ok = _compiled_prepare_tables()(padded)
+        jax.block_until_ready((tab, ok))
+    except Exception:
+        pass
+    _note_dispatch("tables", nb, time.perf_counter() - t0)
     while len(_VALSET_TABLES) >= _VALSET_TABLES_MAX:
         # evict warmup-owned entries first; while warmup itself is
         # running, a real commit's concurrently-inserted table must
@@ -380,25 +389,29 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
             rl_args = (idx, r32, s32, blocks, active, _rlc_args(bb, c))
             if len(devices) > 1:
                 rfn = _compiled_rlc_gather_sharded(devices)
+                rkind = "rlc_gather_sharded"
             else:
                 rfn = _compiled_rlc_gather()
+                rkind = "rlc_gather"
                 if place is not None:
-                    import jax
-
-                    rl_args = jax.device_put(rl_args, place)
-            if bool(np.asarray(rfn(tab, ok, *rl_args))):
+                    rl_args = _timed_put(rl_args, place)
+            t0 = time.perf_counter()
+            verdict = bool(np.asarray(rfn(tab, ok, *rl_args)))
+            _note_dispatch(rkind, bb, time.perf_counter() - t0)
+            if verdict:
                 _metrics()[1].inc(c, route="device_rlc" if len(devices) <= 1
                                   else "device_rlc_sharded")
                 results[start:end] = True
                 continue
         lane_args = (idx, r32, s32, blocks, active)
         if place is not None:
-            import jax
-
-            lane_args = jax.device_put(lane_args, place)
+            lane_args = _timed_put(lane_args, place)
         fn = _compiled_verify_gather(devices)
-        out = fn(tab, ok, *lane_args)
-        results[start:end] = np.asarray(out)[:c]
+        t0 = time.perf_counter()
+        out = np.asarray(fn(tab, ok, *lane_args))
+        _note_dispatch("gather_sharded" if len(devices) > 1 else "gather",
+                       bb, time.perf_counter() - t0)
+        results[start:end] = out[:c]
     return results
 
 
@@ -611,27 +624,37 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
         # lane sharded jit to localize a rejection
         if b >= _RLC_MIN_LANES:
             rargs = args + (_rlc_args(bb, b),)
-            if bool(np.asarray(_compiled_rlc_sharded(devices)(*rargs))):
+            t0 = time.perf_counter()
+            verdict = bool(np.asarray(_compiled_rlc_sharded(devices)(*rargs)))
+            _note_dispatch("rlc_sharded", bb, time.perf_counter() - t0)
+            if verdict:
                 _metrics()[1].inc(b, route="device_rlc_sharded")
                 return np.ones((b,), bool)
         fn = _compiled_verify_sharded(devices)
-        return np.asarray(fn(*args))[:b]
+        t0 = time.perf_counter()
+        out = np.asarray(fn(*args))
+        _note_dispatch("verify_sharded", bb, time.perf_counter() - t0)
+        return out[:b]
     place = _single_device_place(device, devices)
     if b >= _RLC_MIN_LANES:
         # one-shot RLC verdict first (the all-valid common case); a
         # reject falls through to the per-lane ladder for localization
         rargs = args + (_rlc_args(bb, b),)
         if place is not None:
-            import jax
-            rargs = jax.device_put(rargs, place)
-        if bool(np.asarray(_compiled_rlc()(*rargs))):
+            rargs = _timed_put(rargs, place)
+        t0 = time.perf_counter()
+        verdict = bool(np.asarray(_compiled_rlc()(*rargs)))
+        _note_dispatch("rlc", bb, time.perf_counter() - t0)
+        if verdict:
             _metrics()[1].inc(b, route="device_rlc")
             return np.ones((b,), bool)
     fn = _compiled_verify()
     if place is not None:
-        import jax
-        args = jax.device_put(args, place)
-    return np.asarray(fn(*args))[:b]
+        args = _timed_put(args, place)
+    t0 = time.perf_counter()
+    out = np.asarray(fn(*args))
+    _note_dispatch("verify", bb, time.perf_counter() - t0)
+    return out[:b]
 
 
 @functools.cache
@@ -646,6 +669,81 @@ def _metrics():
                   "signature lanes verified, by route (device/cpu)"),
         m.counter("crypto_batch_calls_total", "BatchVerifier.verify calls"),
     )
+
+
+# -------------------------------------------------- kernel profiling hooks
+
+@functools.cache
+def _kprof():
+    """Kernel-profiling series (tentpole: per-bucket compile visibility).
+
+    ``crypto_kernel_first_dispatch_seconds{kind,lanes}`` records the wall
+    time of the FIRST in-process dispatch of each compiled shape: a
+    multi-second/minute value is a cold XLA compile, a value near the
+    dispatch p50 means the persistent compile cache served it.  Later
+    dispatches of a seen shape land in
+    ``crypto_kernel_dispatch_seconds{kind}``; explicit host->device
+    placements land in ``crypto_device_transfer_seconds``."""
+    from ..libs import metrics as m
+
+    return (
+        m.gauge("crypto_kernel_first_dispatch_seconds",
+                "first dispatch wall time per compiled shape "
+                "(compile when cold, cache-hit when warm)"),
+        m.counter("crypto_kernel_first_dispatch_total",
+                  "compiled shapes first-dispatched in this process"),
+        m.histogram("crypto_kernel_dispatch_seconds",
+                    "device kernel dispatch latency (warm shapes)",
+                    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                             0.05, 0.1, 0.25, 0.5, 1, 2.5)),
+        m.histogram("crypto_device_transfer_seconds",
+                    "host->device transfer latency (explicit device_put)",
+                    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                             0.005, 0.01, 0.05, 0.1)),
+    )
+
+
+_SEEN_SHAPES: set = set()
+
+
+def _note_dispatch(kind: str, lanes_bucket: int, seconds: float) -> None:
+    """Record one compiled-kernel execution: the first (kind, bucket)
+    sighting is the compile-or-cache gauge + a flight-recorder event,
+    repeats are the warm dispatch histogram."""
+    gauge, first, hist, _ = _kprof()
+    key = (kind, lanes_bucket)
+    if key not in _SEEN_SHAPES:
+        _SEEN_SHAPES.add(key)
+        gauge.set(seconds, kind=kind, lanes=str(lanes_bucket))
+        first.inc(kind=kind)
+        from ..libs import tracing
+
+        tracing.event("crypto.kernel", "first_dispatch", kind=kind,
+                      lanes=lanes_bucket, dur_us=int(seconds * 1e6))
+    else:
+        hist.observe(seconds, kind=kind)
+
+
+def _timed_put(tree, place):
+    """``jax.device_put`` with transfer timing.  With the flight
+    recorder ON (deep-profiling opt-in) it blocks until the copy lands
+    so the histogram measures the real transfer; with tracing off (the
+    production default) it times only the enqueue — forcing a host sync
+    on every hot-path placement would forfeit the transfer/dispatch
+    overlap just to make a histogram prettier."""
+    import jax
+
+    from ..libs import tracing
+
+    t0 = time.perf_counter()
+    out = jax.device_put(tree, place)
+    if tracing.is_enabled():
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+    _kprof()[3].observe(time.perf_counter() - t0)
+    return out
 
 
 _DEVICE_WAIT_S = 2.0             # max time a verify waits on the device:

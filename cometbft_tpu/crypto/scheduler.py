@@ -51,6 +51,7 @@ import hashlib
 import time
 
 from ..libs import metrics
+from ..libs import tracing
 from ..libs.service import BaseService
 from .keys import PubKey
 
@@ -207,6 +208,11 @@ class VerificationScheduler(BaseService):
         self._dedup_b = dedup.bind()
         self._lanes_ok = lanes.bind(verdict="ok")
         self._lanes_bad = lanes.bind(verdict="bad")
+        # hot-path histograms pre-bound to the empty label set: _flush
+        # observes once per REQUEST (wait time), not once per batch
+        self._occ_b = self._m[0].bind()
+        self._wait_b = self._m[1].bind()
+        self._lat_b = self._m[2].bind()
         # per-INSTANCE tallies for stats(): the libs.metrics registry is
         # process-global (a restarted node's fresh scheduler would report
         # its predecessor's totals), so the operator/bench surface reads
@@ -284,7 +290,7 @@ class VerificationScheduler(BaseService):
         service is not running."""
         t0 = time.perf_counter()
         key = cache_key(pub.bytes(), msg, sig)
-        lat_h = self._m[2]
+        lat_h = self._lat_b
         hit_b, miss_b = self._bound["scheduler"]
         if self.cache.hit(key):
             hit_b.inc()
@@ -377,9 +383,11 @@ class VerificationScheduler(BaseService):
         self._t_batches += 1
         self._t_lanes_sum += len(batch)
         now = time.perf_counter()
-        self._m[0].observe(len(batch))                      # occupancy
+        self._occ_b.observe(len(batch))                     # occupancy
         for req in batch:
-            self._m[1].observe(now - req.t_enqueue)         # wait time
+            self._wait_b.observe(now - req.t_enqueue)       # wait time
+        tracing.event("crypto.sched", "flush", reason=reason,
+                      lanes=len(batch))
         loop = self._loop or asyncio.get_running_loop()
         task = loop.create_task(self._dispatch(batch))
         self._dispatches.add(task)
@@ -392,6 +400,8 @@ class VerificationScheduler(BaseService):
 
             self._pool = cf.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="vote-sched")
+        sp = tracing.begin("crypto.sched", "dispatch", lanes=len(batch),
+                           backend=self.backend)
         try:
             oks = await loop.run_in_executor(
                 self._pool, self._verify_batch, batch)
@@ -399,6 +409,7 @@ class VerificationScheduler(BaseService):
             self.log.error("batch dispatch failed; failing batch closed",
                            err=repr(e))           # signature verdict
             oks = [False] * len(batch)
+        tracing.finish(sp, ok=sum(map(bool, oks)))
         for req, ok in zip(batch, oks):
             ok = bool(ok)
             self._inflight.pop(req.key, None)

@@ -21,6 +21,14 @@ class Registry:
         with self._lock:
             existing = self._metrics.get(metric.name)
             if existing is not None:
+                if type(existing) is not type(metric):
+                    # silently handing back a Counter to code that asked
+                    # for a Gauge produces AttributeErrors (or worse,
+                    # wrong series) far from the offending registration
+                    raise ValueError(
+                        f"metric {metric.name!r} already registered as "
+                        f"{type(existing).__name__}, cannot re-register "
+                        f"as {type(metric).__name__}")
                 return existing
             self._metrics[metric.name] = metric
             return metric
@@ -31,7 +39,7 @@ class Registry:
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# HELP {m.name} {_escape_help(m.help)}")
             out.append(f"# TYPE {m.name} {m.TYPE}")
             out.extend(m.expose())
         return "\n".join(out) + "\n"
@@ -44,6 +52,13 @@ def _escape(v) -> str:
     """Prometheus label-value escaping (backslash, quote, newline)."""
     return str(v).replace("\\", "\\\\").replace('"', '\\"') \
         .replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the exposition format (backslash and
+    newline only — a raw multi-line help string would otherwise corrupt
+    the whole scrape)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _label_str(labels: dict[str, str]) -> str:
@@ -124,6 +139,10 @@ class Gauge(_Metric):
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def bind(self, **labels) -> "_BoundGauge":
+        """Pre-resolve a label set for hot paths (see Counter.bind)."""
+        return _BoundGauge(self, tuple(sorted(labels.items())))
+
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
 
@@ -131,6 +150,26 @@ class Gauge(_Metric):
         with self._lock:
             return [f"{self.name}{_label_str(dict(k))} {v}"
                     for k, v in sorted(self._values.items())]
+
+
+class _BoundGauge:
+    """A gauge pre-bound to one label set (see :meth:`Gauge.bind`)."""
+
+    __slots__ = ("_g", "_key")
+
+    def __init__(self, gauge: Gauge, key: tuple):
+        self._g = gauge
+        self._key = key
+
+    def set(self, value: float) -> None:
+        g = self._g
+        with g._lock:
+            g._values[self._key] = float(value)
+
+    def add(self, amount: float) -> None:
+        g = self._g
+        with g._lock:
+            g._values[self._key] = g._values.get(self._key, 0.0) + amount
 
 
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -148,7 +187,9 @@ class Histogram(_Metric):
         self._totals: dict[tuple, int] = {}
 
     def observe(self, value: float, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        self._observe_key(tuple(sorted(labels.items())), value)
+
+    def _observe_key(self, key: tuple, value: float) -> None:
         with self._lock:
             counts = self._counts.setdefault(
                 key, [0] * (len(self.buckets) + 1))
@@ -159,6 +200,10 @@ class Histogram(_Metric):
             counts[min(idx, len(self.buckets))] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
             self._totals[key] = self._totals.get(key, 0) + 1
+
+    def bind(self, **labels) -> "_BoundHistogram":
+        """Pre-resolve a label set for hot paths (see Counter.bind)."""
+        return _BoundHistogram(self, tuple(sorted(labels.items())))
 
     def time(self, **labels):
         """Context manager measuring seconds."""
@@ -207,6 +252,19 @@ class Histogram(_Metric):
                 out.append(f"{self.name}_count{_label_str(labels)} "
                            f"{self._totals[key]}")
         return out
+
+
+class _BoundHistogram:
+    """A histogram pre-bound to one label set (see Histogram.bind)."""
+
+    __slots__ = ("_h", "_key")
+
+    def __init__(self, hist: Histogram, key: tuple):
+        self._h = hist
+        self._key = key
+
+    def observe(self, value: float) -> None:
+        self._h._observe_key(self._key, value)
 
 
 class _Timer:

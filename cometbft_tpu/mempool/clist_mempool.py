@@ -12,9 +12,11 @@ the app's mempool connection, gas/byte-capped reaping, and an async
 from __future__ import annotations
 
 import asyncio
+import time
 from dataclasses import dataclass
 
 from ..abci.client import ABCIClient
+from ..libs import tracing
 from .cache import LRUTxCache
 from .mempool import Mempool, TxKey
 
@@ -125,6 +127,16 @@ class CListMempool(Mempool):
         self._m_node = metrics_node
         self._m_size = _m.gauge("mempool_size",
                                 "txs currently in the mempool")
+        self._m_reap = _m.histogram(
+            "mempool_reap_seconds",
+            "proposal reap latency (mempool -> block tx list)",
+            buckets=(0.00005, 0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                     0.005, 0.01, 0.05, 0.1))
+        self._m_recheck = _m.histogram(
+            "mempool_recheck_seconds",
+            "post-commit survivor recheck latency (whole pass)",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1, 5))
         self._txs_available = asyncio.Event()
         self._notified_available = False
         # edge callback fired once per height on the first admitted tx
@@ -191,6 +203,7 @@ class CListMempool(Mempool):
 
     def reap_max_bytes_max_gas(self, max_bytes: int,
                                max_gas: int) -> list[bytes]:
+        t0 = time.perf_counter()
         out, total_bytes, total_gas = [], 0, 0
         for item in self._ordered():
             total_bytes += len(item.tx)
@@ -200,6 +213,10 @@ class CListMempool(Mempool):
             if max_gas >= 0 and total_gas > max_gas:
                 break
             out.append(item.tx)
+        dt = time.perf_counter() - t0
+        self._m_reap.observe(dt, node=self._m_node)
+        tracing.event("mempool", "reap", node=self._m_node, txs=len(out),
+                      pool=len(self._txs), dur_us=int(dt * 1e6))
         return out
 
     def reap_max_txs(self, n: int) -> list[bytes]:
@@ -229,15 +246,25 @@ class CListMempool(Mempool):
                 self.cache.remove(key)
             self._txs.pop(key, None)
         # recheck survivors against the post-block app state
+        t0 = time.perf_counter()
+        rechecked = dropped = 0
         for key in list(self._txs.keys()):
             item = self._txs.get(key)
             if item is None:
                 continue
+            rechecked += 1
             res = await self.app.check_tx(item.tx, recheck=True)
             if not res.is_ok:
                 del self._txs[key]
+                dropped += 1
                 if not self.keep_invalid:
                     self.cache.remove(key)
+        if rechecked:
+            dt = time.perf_counter() - t0
+            self._m_recheck.observe(dt, node=self._m_node)
+            tracing.event("mempool", "recheck", node=self._m_node,
+                          height=height, rechecked=rechecked,
+                          dropped=dropped, dur_us=int(dt * 1e6))
         self._m_size.set(len(self._txs), node=self._m_node)
         if self._txs:
             self._notify_available()

@@ -353,6 +353,16 @@ class Node:
 
     async def start(self) -> None:
         """node.go:579 OnStart: listen, start reactors, start consensus."""
+        if self.config.instrumentation.tracing:
+            # flip the process-wide flight recorder on BEFORE any
+            # subsystem starts so the first height is fully traced;
+            # never flipped off at stop (in-proc ensembles share it, and
+            # the ring of a stopped node is still dumpable post-mortem)
+            from ..libs import tracing as _tracing
+
+            _tracing.configure(
+                enabled=True,
+                ring_size=self.config.instrumentation.tracing_ring_size)
         host, port = _parse_laddr(self.config.p2p.laddr) \
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)

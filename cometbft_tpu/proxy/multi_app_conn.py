@@ -9,12 +9,52 @@ client instance (its own lock / socket), produced by a ClientCreator
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Awaitable, Callable
 
 from ..abci.application import Application
 from ..abci.client import ABCIClient, LocalClient, SocketClient
+from ..libs import tracing
 
 ClientCreator = Callable[[], Awaitable[ABCIClient]]
+
+
+@functools.cache
+def _abci_metrics():
+    from ..libs import metrics as m
+
+    return m.histogram(
+        "abci_call_seconds",
+        "application call latency by logical connection and method "
+        "(a slow FinalizeBlock on the consensus connection IS commit "
+        "latency; a slow CheckTx on the mempool connection stalls "
+        "admission)")
+
+
+class TracedAppConn(ABCIClient):
+    """Per-connection latency shim around a real ABCI client: every call
+    lands in ``abci_call_seconds{conn,method}`` and, when tracing is on,
+    a flight-recorder span — so a height timeline shows exactly how long
+    the app held the consensus connection inside the commit step."""
+
+    def __init__(self, inner: ABCIClient, conn: str):
+        self._inner = inner
+        self._conn = conn
+        self._hist = _abci_metrics()
+
+    async def call(self, method: str, **params):
+        t0 = time.perf_counter()
+        sp = tracing.begin("abci", "call", conn=self._conn, method=method)
+        try:
+            return await self._inner.call(method, **params)
+        finally:
+            self._hist.observe(time.perf_counter() - t0,
+                               conn=self._conn, method=method)
+            tracing.finish(sp)
+
+    async def close(self) -> None:
+        await self._inner.close()
 
 
 def local_client_creator(app: Application) -> ClientCreator:
@@ -56,10 +96,10 @@ class AppConns:
         self.snapshot: ABCIClient | None = None
 
     async def start(self) -> None:
-        self.consensus = await self._creator()
-        self.mempool = await self._creator()
-        self.query = await self._creator()
-        self.snapshot = await self._creator()
+        self.consensus = TracedAppConn(await self._creator(), "consensus")
+        self.mempool = TracedAppConn(await self._creator(), "mempool")
+        self.query = TracedAppConn(await self._creator(), "query")
+        self.snapshot = TracedAppConn(await self._creator(), "snapshot")
 
     async def stop(self) -> None:
         for c in (self.consensus, self.mempool, self.query, self.snapshot):

@@ -61,11 +61,32 @@ async def health(env: Environment) -> dict:
 
 
 async def status(env: Environment) -> dict:
-    """rpc/core/status.go Status."""
+    """rpc/core/status.go Status, enriched with a live consensus-timeline
+    block: current height/round/step plus how long the node has sat in
+    that step and since its last commit (the flight-recorder's "is this
+    node stuck RIGHT NOW" surface — see /dump_trace for the history)."""
     node = env.node
     h = env.block_store.height()
     meta = env.block_store.load_block_meta(h) if h else None
     pv = node.consensus.priv_validator if node.consensus else None
+    consensus_info = None
+    cs = node.consensus
+    if cs:             # truthiness, not None-ness: the inspect-mode
+        # offline shim is falsy so this block degrades away with it
+        last_wall = getattr(cs, "_last_commit_wall_ns", 0)
+        # the age must come from the SAME clock that stamped the commit:
+        # cs.now_ns is injectable (deterministic harnesses), so
+        # subtracting real wall time from it would be garbage
+        consensus_info = {
+            "height": cs.rs.height,
+            "round": cs.rs.round,
+            "step": cs.rs.step_name(),
+            "step_age_s": round(cs.step_age_s(), 6),
+            "last_commit_age_s": (
+                round(max(cs.now_ns() - last_wall, 0) / 1e9, 6)
+                if last_wall else None),
+            "fatal_error": repr(cs.fatal_error) if cs.fatal_error else None,
+        }
     return {
         "node_info": {
             "id": node.node_key.id if node.node_key else "",
@@ -86,6 +107,7 @@ async def status(env: Environment) -> dict:
             "address": pv.get_pub_key().address().hex() if pv else "",
             "pub_key": pv.get_pub_key().bytes().hex() if pv else "",
         },
+        "consensus_info": consensus_info,
     }
 
 
@@ -606,6 +628,30 @@ async def block_search(env: Environment, query="", page=1,
         raise RPCError(-32602, f"bad query: {e}") from e
 
 
+# --------------------------------------------------- flight recorder
+
+async def dump_trace(env: Environment, limit=1000) -> dict:
+    """Dump the node-wide flight recorder (``libs/tracing`` ring buffer)
+    as JSON: the newest ``limit`` completed spans/events, in completion
+    order.  Sort records by ``start_ns`` to reconstruct a timeline; a
+    committed height shows its consensus step spans with the ABCI calls,
+    WAL fsyncs and verify micro-batches that ran inside them.  Empty
+    (with ``enabled: false``) unless ``[instrumentation] tracing`` is
+    on."""
+    from ..libs import tracing
+
+    lim = int(limit)
+    if lim < 0:
+        raise RPCError(-32602, "limit must be >= 0")
+    st = tracing.stats()
+    return {
+        "enabled": st["enabled"],
+        "ring_size": st["ring_size"],
+        "buffered": st["buffered"],
+        "records": tracing.dump(lim),
+    }
+
+
 # ---------------------------------------------------- unsafe (dev-only)
 
 async def dial_seeds(env: Environment, seeds=None) -> dict:
@@ -670,6 +716,7 @@ ROUTES = {
     "header_by_hash": header_by_hash,
     "genesis_chunked": genesis_chunked,
     "check_tx": check_tx,
+    "dump_trace": dump_trace,
 }
 
 # registered only when config rpc.unsafe is set (rpc/core/routes.go:57-62)

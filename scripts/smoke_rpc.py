@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""CI observability smoke: boot one validator node with tracing enabled,
+then hit the RPC listener the way an operator's tooling would —
+
+- ``GET /metrics`` must answer 200 with parseable Prometheus text
+  exposition (every line a comment, a blank, or ``name{labels} value``),
+- ``GET /dump_trace?limit=N`` must answer 200 with a JSON-RPC envelope
+  whose result carries flight-recorder records (consensus step spans at
+  minimum, since the node committed a block),
+- ``GET /status`` must carry the enriched ``consensus_info`` block.
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow's smoke job (`.github/workflows/lint.yml`); runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_rpc.py
+"""
+
+import asyncio
+import json
+import os
+import re
+import sys
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})?$")
+
+
+def check_exposition(text: str) -> None:
+    """Raise on anything the Prometheus text parser would choke on."""
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not (line.startswith("# HELP ") or line.startswith("# TYPE ")):
+                raise ValueError(f"line {ln}: bad comment {line!r}")
+            if "\n" in line or line != line.rstrip("\r"):
+                raise ValueError(f"line {ln}: unescaped control char")
+            continue
+        name, _, value = line.rpartition(" ")
+        if not name or not _NAME.match(name):
+            raise ValueError(f"line {ln}: bad series name {line!r}")
+        if value not in ("NaN", "+Inf", "-Inf"):
+            float(value)        # raises on garbage
+
+
+def fetch(url: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        # non-2xx raises in urllib; surface it as a status so the
+        # callers' FAIL diagnostics actually run
+        return e.code, e.read()
+
+
+async def main() -> int:
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    cfg = Config(consensus=test_consensus_config())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.instrumentation.tracing = True
+
+    pv = MockPV.from_secret(b"smoke-node")
+    doc = GenesisDoc(chain_id="smoke-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    node = await Node.create(doc, KVStoreApplication(), priv_validator=pv,
+                             config=cfg, name="smoke")
+    await node.start()
+    loop = asyncio.get_running_loop()
+    try:
+        # a single validator commits on its own; wait for height >= 1
+        for _ in range(600):
+            if node.block_store.height() >= 1:
+                break
+            await asyncio.sleep(0.05)
+        else:
+            print("FAIL: node never committed a block", file=sys.stderr)
+            return 1
+        host, port = node.rpc_addr
+        base = f"http://{host}:{port}"
+
+        status, body = await loop.run_in_executor(
+            None, fetch, base + "/metrics")
+        if status != 200:
+            print(f"FAIL: /metrics -> HTTP {status}", file=sys.stderr)
+            return 1
+        try:
+            check_exposition(body.decode())
+        except ValueError as e:
+            print(f"FAIL: /metrics exposition unparseable: {e}",
+                  file=sys.stderr)
+            return 1
+        if b"consensus_height" not in body:
+            print("FAIL: /metrics missing consensus_height", file=sys.stderr)
+            return 1
+
+        status, body = await loop.run_in_executor(
+            None, fetch, base + "/dump_trace?limit=500")
+        if status != 200:
+            print(f"FAIL: /dump_trace -> HTTP {status}", file=sys.stderr)
+            return 1
+        env = json.loads(body)
+        result = env.get("result") or {}
+        if not result.get("enabled"):
+            print("FAIL: /dump_trace reports tracing disabled",
+                  file=sys.stderr)
+            return 1
+        recs = result.get("records") or []
+        steps = [r for r in recs if r["sub"] == "consensus"
+                 and r["name"] == "step"]
+        if not steps:
+            print(f"FAIL: no consensus step spans in {len(recs)} records",
+                  file=sys.stderr)
+            return 1
+
+        status, body = await loop.run_in_executor(
+            None, fetch, base + "/status")
+        ci = (json.loads(body).get("result") or {}).get("consensus_info")
+        if not ci or "step_age_s" not in ci:
+            print("FAIL: /status missing consensus_info", file=sys.stderr)
+            return 1
+
+        print(f"smoke ok: height={node.block_store.height()} "
+              f"trace_records={len(recs)} step_spans={len(steps)}")
+        return 0
+    finally:
+        await node.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -47,6 +47,62 @@ def test_metrics_counter_gauge_histogram_exposition():
     assert reg.register(Counter("test_total")) is c
 
 
+def test_register_type_mismatch_raises():
+    """Re-registering a name as a DIFFERENT metric type must fail loudly
+    (regression: it used to hand back the existing Counter to code that
+    asked for a Gauge, breaking far from the offending registration)."""
+    reg = Registry()
+    c = reg.register(Counter("dup_metric", "counter first"))
+    with pytest.raises(ValueError, match="dup_metric"):
+        reg.register(Gauge("dup_metric", "now a gauge"))
+    with pytest.raises(ValueError):
+        reg.register(Histogram("dup_metric"))
+    # same type still dedups to the original
+    assert reg.register(Counter("dup_metric")) is c
+
+
+def test_help_text_escaped_per_exposition_spec():
+    """Backslashes and newlines in HELP text must be escaped — a raw
+    multi-line help string corrupts the whole scrape."""
+    reg = Registry()
+    reg.register(Counter("esc_total",
+                         "line one\nline two with a \\ backslash"))
+    text = reg.collect()
+    assert "# HELP esc_total line one\\nline two with a \\\\ backslash" \
+        in text
+    # no naked continuation line leaked into the exposition
+    assert "\nline two" not in text
+    # and every line still parses as comment/series
+    for line in text.splitlines():
+        assert not line or line.startswith("# ") or " " in line
+
+
+def test_gauge_and_histogram_bind():
+    """Gauge.bind()/Histogram.bind() mirror Counter.bind(): pre-resolved
+    label sets that skip the per-call sort on hot paths but land in the
+    same series."""
+    reg = Registry()
+    g = reg.register(Gauge("bind_gauge"))
+    bg = g.bind(node="n1")
+    bg.set(5)
+    bg.add(2.5)
+    assert g.value(node="n1") == 7.5
+    g.set(1, node="n2")                   # unbound path coexists
+    assert g.value(node="n2") == 1.0
+
+    h = reg.register(Histogram("bind_seconds", buckets=(0.1, 1.0)))
+    bh = h.bind(route="fast")
+    bh.observe(0.05)
+    bh.observe(0.5)
+    h.observe(0.5, route="slow")
+    assert h.count(route="fast") == 2
+    assert h.sum(route="fast") == 0.55
+    assert h.count(route="slow") == 1
+    text = reg.collect()
+    assert 'bind_seconds_bucket{le="0.1",route="fast"} 1' in text
+    assert 'bind_seconds_count{route="fast"} 2' in text
+
+
 def test_device_abandonment_flips_health_metrics(monkeypatch):
     """A stalled device dispatch must be VISIBLE (VERDICT r3 weak 6):
     crypto_device_degraded goes 1 and the abandonment counter ticks when
