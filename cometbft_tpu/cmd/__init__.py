@@ -648,7 +648,8 @@ def _debug_collect(rpc: str, home: str, out_dir: str) -> None:
     async def fetch_rpc():
         client = _rpc_client(rpc)
         for route in ("status", "net_info", "consensus_state",
-                      "dump_consensus_state", "num_unconfirmed_txs"):
+                      "dump_consensus_state", "num_unconfirmed_txs",
+                      "dump_incidents"):
             try:
                 out = await asyncio.wait_for(client.call(route), 5)
                 with open(os.path.join(out_dir, f"{route}.json"), "w") as f:

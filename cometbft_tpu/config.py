@@ -83,6 +83,11 @@ class P2PConfig:
     # the e2e runner uses it to emulate geo-distribution on one machine
     # (reference test/e2e/runner/latency_emulation.go)
     emulated_latency_ms: float = 0.0
+    # cadence of the Switch's per-peer telemetry flush into the
+    # peer-labeled Prometheus series (the packet hot path only touches
+    # plain ints; this is how often they become scrapeable).  0 disables
+    # the sampler — /net_info still reads the live counters directly.
+    telemetry_flush_interval_s: float = 2.0
     addr_book_path: str = "config/addrbook.json"
     # fault injection on every peer stream (p2p/fuzz.go FuzzedConnection,
     # config.FuzzConnConfig); fuzzing starts 10s after connect like
@@ -166,6 +171,25 @@ class InstrumentationConfig:
     tracing: bool = False
     # bounded ring capacity (records); old records fall off the back
     tracing_ring_size: int = 8192
+    # --- liveness watchdog (node/watchdog.py) -------------------------
+    # when consensus sits in one step (or goes without a commit, or all
+    # peers fall silent) longer than this, the watchdog writes a "black
+    # box" incident bundle — flight-recorder ring, per-peer telemetry
+    # snapshot, consensus summary, WAL tail — to watchdog_incident_dir,
+    # visible via GET /dump_incidents.  0 disables the watchdog.
+    watchdog_stall_threshold_s: float = 60.0
+    # how often the watchdog evaluates its stall conditions
+    watchdog_check_interval_s: float = 5.0
+    # rate limit: minimum seconds between two incident bundles (a stall
+    # that persists re-dumps at this cadence, not per check tick)
+    watchdog_min_interval_s: float = 300.0
+    # newest bundles kept on disk; older ones are pruned at write time
+    watchdog_max_bundles: int = 16
+    # bundle directory — relative paths resolve against the node home
+    # (nodes without a home dir skip bundling unless this is absolute)
+    watchdog_incident_dir: str = "data/incidents"
+    # newest WAL records captured into a bundle
+    watchdog_wal_tail: int = 200
 
 
 @dataclass
@@ -302,6 +326,27 @@ class Config:
         if self.instrumentation.tracing_ring_size < 16:
             raise ConfigError(
                 "instrumentation.tracing_ring_size must be >= 16")
+        inst = self.instrumentation
+        if inst.watchdog_stall_threshold_s < 0:
+            raise ConfigError(
+                "instrumentation.watchdog_stall_threshold_s must be >= 0")
+        if inst.watchdog_stall_threshold_s > 0:
+            if inst.watchdog_check_interval_s <= 0:
+                raise ConfigError(
+                    "instrumentation.watchdog_check_interval_s must be "
+                    "positive when the watchdog is enabled")
+            if inst.watchdog_min_interval_s < 0:
+                raise ConfigError(
+                    "instrumentation.watchdog_min_interval_s must be >= 0")
+            if inst.watchdog_max_bundles < 1:
+                raise ConfigError(
+                    "instrumentation.watchdog_max_bundles must be >= 1")
+            if inst.watchdog_wal_tail < 0:
+                raise ConfigError(
+                    "instrumentation.watchdog_wal_tail must be >= 0")
+        if self.p2p.telemetry_flush_interval_s < 0:
+            raise ConfigError(
+                "p2p.telemetry_flush_interval_s must be >= 0")
         if self.storage.db_backend not in ("logdb", "native", "memdb"):
             raise ConfigError(
                 f"storage.db_backend must be logdb|native|memdb, "

@@ -41,10 +41,38 @@ QUERY_MAJ23_SLEEP = 2.0
 @functools.cache
 def _dup_votes_metric():
     from ..libs import metrics as _m
+    from ..p2p.metrics import PEER_LABEL_BUDGET
 
+    # per-peer children (Counter.bind at add_peer); the cardinality
+    # guard caps them at the peer-label budget under churn
     return _m.counter(
         "consensus_gossip_duplicate_votes_total",
-        "re-gossiped votes dropped at the reactor (already in a vote set)")
+        "re-gossiped votes dropped at the reactor (already in a vote "
+        "set), by sending peer",
+        max_label_sets=PEER_LABEL_BUDGET)
+
+
+@functools.cache
+def _useful_votes_metric():
+    from ..libs import metrics as _m
+    from ..p2p.metrics import PEER_LABEL_BUDGET
+
+    return _m.counter(
+        "consensus_gossip_useful_votes_total",
+        "gossiped votes accepted into processing (not already held), by "
+        "sending peer — useful/(useful+duplicate) is that peer's gossip "
+        "efficiency",
+        max_label_sets=PEER_LABEL_BUDGET)
+
+
+@functools.cache
+def _msg_type_metric():
+    from ..libs import metrics as _m
+
+    return _m.counter(
+        "consensus_reactor_msgs_total",
+        "consensus reactor messages received, by wire tag (nrs, hv, nvb, "
+        "maj23, prop, pol, part, vote, vsb)")
 
 
 # ------------------------------------------------------------- wire helpers
@@ -162,6 +190,10 @@ class PeerState:
 
 # ------------------------------------------------------------------ reactor
 
+_KNOWN_TAGS = ("nrs", "hv", "nvb", "maj23", "prop", "pol", "part",
+               "vote", "vsb")
+
+
 class ConsensusReactor(Reactor):
     def __init__(self, cs: ConsensusState,
                  gossip_sleep: float = GOSSIP_SLEEP):
@@ -171,6 +203,14 @@ class ConsensusReactor(Reactor):
         self.wait_sync = False      # True while blocksync owns the chain
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
         self._last_nrs = None
+        # per-tag message counters, pre-bound (the tag comes off the
+        # wire, so only the closed protocol set gets a label — anything
+        # else lands in "other" rather than minting attacker-chosen
+        # label values)
+        mt = _msg_type_metric()
+        self._m_msgs = {tag: mt.bind(type=tag, node=cs.name)
+                        for tag in _KNOWN_TAGS}
+        self._m_msgs_other = mt.bind(type="other", node=cs.name)
         cs.broadcast_proposal = self._broadcast_proposal
         cs.broadcast_block_part = self._broadcast_block_part
         cs.broadcast_vote = self._broadcast_vote
@@ -194,6 +234,15 @@ class ConsensusReactor(Reactor):
 
     def add_peer(self, peer) -> None:
         peer.set("cons_peer_state", PeerState())
+        # gossip-efficiency children, pre-bound per peer (the label is
+        # the same 12-char prefix the p2p telemetry uses)
+        from ..p2p.metrics import peer_label
+
+        pl = peer_label(peer.id)
+        peer.set("m_dup_votes",
+                 _dup_votes_metric().bind(peer=pl, node=self.cs.name))
+        peer.set("m_useful_votes",
+                 _useful_votes_metric().bind(peer=pl, node=self.cs.name))
         if not self.wait_sync:
             peer.send(STATE_CHANNEL, self._nrs_msg())
             nvb = self._nvb_msg()
@@ -303,6 +352,11 @@ class ConsensusReactor(Reactor):
             return
         d = _unpack(msg)
         tag = d.get("@")
+        # wire-supplied tag may be any msgpack value: an unhashable one
+        # must count as "other", not raise out of receive() and tear
+        # down the connection
+        ((self._m_msgs.get(tag) if isinstance(tag, str) else None)
+         or self._m_msgs_other).inc()
         n_vals = self.cs.state.validators.size() \
             if self.cs.state is not None else 0
         if channel_id == STATE_CHANNEL:
@@ -345,8 +399,17 @@ class ConsensusReactor(Reactor):
                     # re-gossip of a vote we already hold: the peer
                     # bookkeeping above is all it was worth — don't buy
                     # a WAL write, a queue slot and a dup-check pass
-                    _dup_votes_metric().inc()
+                    peer.gossip.duplicate += 1
+                    m = peer.get("m_dup_votes")
+                    if m is not None:
+                        m.inc()
+                    else:
+                        _dup_votes_metric().inc()
                     return
+                peer.gossip.useful += 1
+                m = peer.get("m_useful_votes")
+                if m is not None:
+                    m.inc()
                 self.cs.feed_vote(vote, peer.id)
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if tag == "vsb":

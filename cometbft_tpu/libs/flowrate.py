@@ -3,7 +3,9 @@ send/recv metering for MConnection; SURVEY §2.8 small pkgs).
 
 A Monitor tracks an exponentially-weighted transfer rate; ``limit`` returns
 how many bytes may be sent now to stay under a target rate (the caller
-sleeps when it gets 0).
+sleeps when it gets 0).  ``rate`` decays while the stream is idle, so the
+p2p telemetry a silent peer exports converges to zero instead of freezing
+at its last burst.
 """
 
 from __future__ import annotations
@@ -37,19 +39,38 @@ class Monitor:
 
     @property
     def rate(self) -> float:
-        return self._rate
+        """Bytes/sec EMA, read-only and idle-decaying: the pending
+        partial window folds in as one sample, and every further full
+        period without an ``update`` decays the estimate by
+        ``(1 - alpha)`` — a connection that stops transferring reads as
+        approaching zero, not as its last burst forever.  Internal EMA
+        state is untouched (``update`` remains the only writer)."""
+        t = self._now()
+        elapsed = t - self._sample_start
+        if elapsed < self._period:
+            return self._rate
+        inst = self._sample_bytes / elapsed
+        r = self._alpha * inst + (1 - self._alpha) * self._rate
+        extra = int(elapsed / self._period) - 1
+        if extra > 0:
+            r *= (1 - self._alpha) ** extra
+        return r
 
     def status(self) -> dict:
         t = self._now()
         dur = max(t - self._start, 1e-9)
         return {"bytes": self.total, "duration_s": dur,
-                "avg_rate": self.total / dur, "inst_rate": self._rate}
+                "avg_rate": self.total / dur, "inst_rate": self.rate}
 
     def limit(self, want: int, max_rate: float | None) -> int:
         """How many of ``want`` bytes may transfer now under ``max_rate``
-        (None = unlimited).  0 means back off."""
+        (None = unlimited).  0 means back off.  The elapsed window is
+        floored at one sample period: at ``t == start`` (a connection's
+        very first write, the monotonic-clock startup edge) the budget is
+        one period's allowance instead of a guaranteed-0 that would
+        stall every fresh connection's first packet."""
         if not max_rate:
             return want
         t = self._now()
-        allowed = max_rate * (t - self._start) - self.total
+        allowed = max_rate * max(t - self._start, self._period) - self.total
         return max(0, min(want, int(allowed)))

@@ -42,6 +42,19 @@ class Registry:
             out.append(f"# HELP {m.name} {_escape_help(m.help)}")
             out.append(f"# TYPE {m.name} {m.TYPE}")
             out.extend(m.expose())
+        evicted = [(m.name, m.evicted_total) for m in metrics
+                   if m.evicted_total]
+        if evicted:
+            # synthetic series (not a registered Counter: incrementing a
+            # real metric from inside another metric's eviction path
+            # would re-enter the guard) so a scrape shows WHICH metric is
+            # churning label sets past its budget
+            out.append("# HELP metrics_label_evictions_total label sets "
+                       "evicted past a metric's cardinality cap")
+            out.append("# TYPE metrics_label_evictions_total counter")
+            for name, n in evicted:
+                out.append("metrics_label_evictions_total"
+                           f'{{metric="{_escape(name)}"}} {n}')
         return "\n".join(out) + "\n"
 
 
@@ -69,29 +82,66 @@ def _label_str(labels: dict[str, str]) -> str:
     return "{" + inner + "}"
 
 
+# Default ceiling on distinct label sets per metric.  Per-peer labels
+# (p2p telemetry) would otherwise grow the registry without bound as
+# peers churn over a long-running node's lifetime; closed label sets
+# (step names, channel names...) never come near it.
+DEFAULT_MAX_LABEL_SETS = 512
+
+
 class _Metric:
     TYPE = "untyped"
 
-    def __init__(self, name: str, help_: str = ""):
+    def __init__(self, name: str, help_: str = "",
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
         self.name = name
         self.help = help_
+        self.max_label_sets = max(1, int(max_label_sets))
+        self.evicted_total = 0        # guarded by self._lock
         self._lock = threading.Lock()
 
     def expose(self) -> list[str]:
         return []
 
+    def _evict_locked(self, *value_dicts: dict) -> None:
+        """Drop the oldest labeled child so a new one fits the cap
+        (called with self._lock held, BEFORE inserting the new key).
+        The unlabeled series ``()`` is never the victim — it is the
+        metric itself, not a per-entity child.  Insertion order is the
+        eviction order (dicts preserve it), which approximates
+        oldest-peer-first under churn."""
+        primary = value_dicts[0]
+        victim = None
+        for k in primary:
+            if k != ():
+                victim = k
+                break
+        if victim is None:       # only the unlabeled series exists
+            return
+        for d in value_dicts:
+            d.pop(victim, None)
+        self.evicted_total += 1
+
 
 class Counter(_Metric):
     TYPE = "counter"
 
-    def __init__(self, name, help_=""):
-        super().__init__(name, help_)
+    def __init__(self, name, help_="",
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        super().__init__(name, help_, max_label_sets)
         self._values: dict[tuple, float] = {}
 
     def inc(self, amount: float = 1.0, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        self._inc_key(tuple(sorted(labels.items())), amount)
+
+    def _inc_key(self, key: tuple, amount: float) -> None:
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            try:                      # common path: key exists, no guard
+                self._values[key] += amount
+            except KeyError:
+                if len(self._values) >= self.max_label_sets:
+                    self._evict_locked(self._values)
+                self._values[key] = float(amount)
 
     def bind(self, **labels) -> "_BoundCounter":
         """Pre-resolve a label set for hot paths: ``bind(...)`` once,
@@ -101,6 +151,11 @@ class Counter(_Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def label_sets(self) -> int:
+        """Distinct label sets currently held (cardinality introspection
+        for the guard's tests and the /net_info budget surface)."""
+        return len(self._values)
 
     def expose(self):
         with self._lock:
@@ -118,26 +173,44 @@ class _BoundCounter:
         self._key = key
 
     def inc(self, amount: float = 1.0) -> None:
-        c = self._c
-        with c._lock:
-            c._values[self._key] = c._values.get(self._key, 0.0) + amount
+        self._c._inc_key(self._key, amount)
 
 
 class Gauge(_Metric):
     TYPE = "gauge"
 
-    def __init__(self, name, help_=""):
-        super().__init__(name, help_)
+    def __init__(self, name, help_="",
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        super().__init__(name, help_, max_label_sets)
         self._values: dict[tuple, float] = {}
 
     def set(self, value: float, **labels) -> None:
+        self._set_key(tuple(sorted(labels.items())), value)
+
+    def _set_key(self, key: tuple, value: float) -> None:
         with self._lock:
-            self._values[tuple(sorted(labels.items()))] = float(value)
+            if key not in self._values and \
+                    len(self._values) >= self.max_label_sets:
+                self._evict_locked(self._values)
+            self._values[key] = float(value)
 
     def add(self, amount: float, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        self._add_key(tuple(sorted(labels.items())), amount)
+
+    def _add_key(self, key: tuple, amount: float) -> None:
         with self._lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            try:
+                self._values[key] += amount
+            except KeyError:
+                if len(self._values) >= self.max_label_sets:
+                    self._evict_locked(self._values)
+                self._values[key] = float(amount)
+
+    def remove(self, **labels) -> None:
+        """Drop one labeled child (a disconnected peer's gauge would
+        otherwise report its last value forever)."""
+        with self._lock:
+            self._values.pop(tuple(sorted(labels.items())), None)
 
     def bind(self, **labels) -> "_BoundGauge":
         """Pre-resolve a label set for hot paths (see Counter.bind)."""
@@ -145,6 +218,9 @@ class Gauge(_Metric):
 
     def value(self, **labels) -> float:
         return self._values.get(tuple(sorted(labels.items())), 0.0)
+
+    def label_sets(self) -> int:
+        return len(self._values)
 
     def expose(self):
         with self._lock:
@@ -162,14 +238,10 @@ class _BoundGauge:
         self._key = key
 
     def set(self, value: float) -> None:
-        g = self._g
-        with g._lock:
-            g._values[self._key] = float(value)
+        self._g._set_key(self._key, value)
 
     def add(self, amount: float) -> None:
-        g = self._g
-        with g._lock:
-            g._values[self._key] = g._values.get(self._key, 0.0) + amount
+        self._g._add_key(self._key, amount)
 
 
 DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -179,8 +251,9 @@ DEFAULT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
 class Histogram(_Metric):
     TYPE = "histogram"
 
-    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS):
-        super().__init__(name, help_)
+    def __init__(self, name, help_="", buckets=DEFAULT_BUCKETS,
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
+        super().__init__(name, help_, max_label_sets)
         self.buckets = tuple(sorted(buckets))
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
@@ -191,8 +264,12 @@ class Histogram(_Metric):
 
     def _observe_key(self, key: tuple, value: float) -> None:
         with self._lock:
-            counts = self._counts.setdefault(
-                key, [0] * (len(self.buckets) + 1))
+            counts = self._counts.get(key)
+            if counts is None:
+                if len(self._counts) >= self.max_label_sets:
+                    self._evict_locked(self._counts, self._sums,
+                                       self._totals)
+                counts = self._counts[key] = [0] * (len(self.buckets) + 1)
             # cumulative-bucket semantics: le is inclusive
             idx = bisect_right(self.buckets, value)
             if idx > 0 and self.buckets[idx - 1] == value:
@@ -281,15 +358,20 @@ class _Timer:
 
 
 def counter(name: str, help_: str = "",
-            registry: Registry | None = None) -> Counter:
-    return (registry or DEFAULT).register(Counter(name, help_))
+            registry: Registry | None = None,
+            max_label_sets: int = DEFAULT_MAX_LABEL_SETS) -> Counter:
+    return (registry or DEFAULT).register(
+        Counter(name, help_, max_label_sets))
 
 
 def gauge(name: str, help_: str = "",
-          registry: Registry | None = None) -> Gauge:
-    return (registry or DEFAULT).register(Gauge(name, help_))
+          registry: Registry | None = None,
+          max_label_sets: int = DEFAULT_MAX_LABEL_SETS) -> Gauge:
+    return (registry or DEFAULT).register(Gauge(name, help_, max_label_sets))
 
 
 def histogram(name: str, help_: str = "", buckets=DEFAULT_BUCKETS,
-              registry: Registry | None = None) -> Histogram:
-    return (registry or DEFAULT).register(Histogram(name, help_, buckets))
+              registry: Registry | None = None,
+              max_label_sets: int = DEFAULT_MAX_LABEL_SETS) -> Histogram:
+    return (registry or DEFAULT).register(
+        Histogram(name, help_, buckets, max_label_sets))

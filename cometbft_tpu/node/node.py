@@ -96,6 +96,8 @@ class Node:
         self.grpc_server = None
         self.prometheus_server = None
         self.loop_watchdog = None
+        self.liveness_watchdog = None
+        self.home: str | None = None
         self.tx_indexer = None
         self.block_indexer = None
         self.indexer_service = None
@@ -124,6 +126,7 @@ class Node:
                      name: str = "node") -> "Node":
         self = cls()
         self.name = name
+        self.home = home
         self.fast_sync = fast_sync or state_sync_provider is not None
         cfg = config or Config(consensus=test_consensus_config())
         self.config = cfg
@@ -252,7 +255,8 @@ class Node:
                                    fuzz_config=fuzz_cfg)
         self.switch = Switch(
             self.transport,
-            emulated_latency=cfg.p2p.emulated_latency_ms / 1e3)
+            emulated_latency=cfg.p2p.emulated_latency_ms / 1e3,
+            telemetry_interval=cfg.p2p.telemetry_flush_interval_s)
         if cfg.tx_index.indexer == "kv":
             from ..indexer import BlockIndexer, IndexerService, TxIndexer
 
@@ -479,11 +483,28 @@ class Node:
         if not self.fast_sync:
             # fast-sync defers consensus start to the blocksync handoff
             await self.consensus.start()
+        inst = self.config.instrumentation
+        if inst.watchdog_stall_threshold_s > 0:
+            incident_dir = self.incident_dir()
+            if incident_dir is not None:
+                from .watchdog import LivenessWatchdog
+
+                self.liveness_watchdog = LivenessWatchdog(
+                    self, incident_dir,
+                    stall_threshold_s=inst.watchdog_stall_threshold_s,
+                    check_interval_s=inst.watchdog_check_interval_s,
+                    min_interval_s=inst.watchdog_min_interval_s,
+                    max_bundles=inst.watchdog_max_bundles,
+                    wal_tail_records=inst.watchdog_wal_tail)
+                await self.liveness_watchdog.start()
         self._started = True
 
     async def stop(self) -> None:
         if self.statesync_done is not None:
             self.statesync_done.cancel()
+        if self.liveness_watchdog is not None:
+            await self.liveness_watchdog.stop()
+            self.liveness_watchdog = None
         if self.rpc_server is not None:
             await self.rpc_server.close()
         if self.grpc_server is not None:
@@ -514,6 +535,13 @@ class Node:
         if self.app_conns is not None:
             await self.app_conns.stop()
         self._started = False
+
+    def incident_dir(self) -> str | None:
+        """Where watchdog incident bundles live (see
+        ``watchdog.resolve_incident_dir``)."""
+        from .watchdog import resolve_incident_dir
+
+        return resolve_incident_dir(self.config, self.home)
 
     async def dial_peer(self, addr: str, persistent: bool = True):
         return await self.switch.dial_peer(addr, persistent=persistent)

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import time
 
 import msgpack
 
@@ -31,6 +32,12 @@ class MConnectionError(Exception):
     pass
 
 
+class PongTimeoutError(MConnectionError):
+    """A ping went unanswered past the pong deadline — its own type so
+    the Switch can count silent-death disconnects separately from
+    protocol/transport errors."""
+
+
 class _Channel:
     def __init__(self, desc: ChannelDescriptor):
         self.desc = desc
@@ -40,6 +47,20 @@ class _Channel:
         self.sent_off = 0
         self.recent = 0.0                      # recently-sent counter
         self.recv_buf = bytearray()            # re-assembly buffer
+        # plain-int telemetry, flushed to Prometheus by the Switch's
+        # periodic sampler (never a labeled metric call per packet)
+        self.sent_bytes = 0
+        self.sent_msgs = 0
+        self.recv_bytes = 0
+        self.recv_msgs = 0
+        self.queue_full_drops = 0
+
+    @property
+    def display_name(self) -> str:
+        """The channel's telemetry key — /net_info dict keys, incident
+        bundles and the Prometheus ``channel`` label all use this ONE
+        spelling (gauge cleanup at peer removal matches on it)."""
+        return self.desc.name or f"0x{self.desc.channel_id:02x}"
 
     def next_packet(self) -> tuple[bytes, bool]:
         """Carve the next <=PACKET_PAYLOAD chunk off the in-flight msg."""
@@ -85,6 +106,17 @@ class MConnection:
         self._pong_to_send = False
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
+        # --- telemetry (plain attrs; see telemetry()) -------------------
+        now = time.monotonic()
+        self.created_mono = now
+        self.last_recv_mono = now       # any complete packet counts
+        self.last_msg_recv_mono = now   # complete channel messages only
+        self.last_rtt_s: float | None = None
+        self.pong_timeouts = 0
+        self._ping_sent_mono: float | None = None
+        # hook: Switch observes RTT samples into the node-labeled
+        # histogram without MConnection knowing about metric labels
+        self.on_rtt = None              # (rtt_seconds: float) -> None
 
     # ------------------------------------------------------------ lifecycle
 
@@ -132,6 +164,7 @@ class MConnection:
         try:
             ch.queue.put_nowait(bytes(msg))
         except asyncio.QueueFull:
+            ch.queue_full_drops += 1
             return False
         self._send_wakeup.set()
         return True
@@ -181,6 +214,9 @@ class MConnection:
                         {"t": "m", "c": ch.desc.channel_id,
                          "e": eof, "d": chunk})
                     ch.recent += len(chunk)
+                    ch.sent_bytes += len(chunk)
+                    if eof:
+                        ch.sent_msgs += 1
                     batch += 1
                 # decay recently-sent so idle channels regain priority
                 for ch in self.channels.values():
@@ -216,6 +252,7 @@ class MConnection:
                     raise MConnectionError(f"oversized packet: {n}")
                 raw = await self.conn.read(n)
                 self.recv_monitor.update(n + 4)
+                self.last_recv_mono = time.monotonic()
                 if self.recv_rate:
                     while self.recv_monitor.limit(1, self.recv_rate) < 1:
                         await asyncio.sleep(0.01)
@@ -226,6 +263,15 @@ class MConnection:
                     self._send_wakeup.set()
                 elif t == "o":                    # pong
                     self._pong_due = None
+                    if self._ping_sent_mono is not None:
+                        rtt = time.monotonic() - self._ping_sent_mono
+                        self._ping_sent_mono = None
+                        self.last_rtt_s = rtt
+                        if self.on_rtt is not None:
+                            try:
+                                self.on_rtt(rtt)
+                            except Exception:
+                                pass
                 elif t == "m":
                     self._on_packet_msg(packet)
                 else:
@@ -241,13 +287,17 @@ class MConnection:
         ch = self.channels.get(packet.get("c"))
         if ch is None:
             raise MConnectionError(f"unknown channel {packet.get('c')}")
-        ch.recv_buf.extend(packet.get("d", b""))
+        data = packet.get("d", b"")
+        ch.recv_buf.extend(data)
+        ch.recv_bytes += len(data)
         if len(ch.recv_buf) > ch.desc.max_msg_size:
             raise MConnectionError(
                 f"message exceeds max size on channel {ch.desc.channel_id}")
         if packet.get("e"):
             msg = bytes(ch.recv_buf)
             ch.recv_buf.clear()
+            ch.recv_msgs += 1
+            self.last_msg_recv_mono = time.monotonic()
             if self.emulated_latency > 0:
                 # equal delays preserve delivery order (asyncio timer
                 # heap breaks ties by schedule sequence)
@@ -276,11 +326,13 @@ class MConnection:
             while True:
                 await asyncio.sleep(self.ping_interval)
                 await self._write_packet({"t": "i"})
+                self._ping_sent_mono = time.monotonic()
                 self._pong_due = loop.time() + self.pong_timeout
                 await asyncio.sleep(self.pong_timeout)
                 if self._pong_due is not None and \
                         loop.time() >= self._pong_due:
-                    raise MConnectionError("pong timeout")
+                    self.pong_timeouts += 1
+                    raise PongTimeoutError("pong timeout")
         except asyncio.CancelledError:
             raise
         except Exception as e:
@@ -289,3 +341,35 @@ class MConnection:
     def status(self) -> dict:
         return {"send": self.send_monitor.status(),
                 "recv": self.recv_monitor.status()}
+
+    def telemetry(self) -> dict:
+        """Full per-connection snapshot: per-channel counters + queue
+        occupancy, flowrate on both directions, ping RTT and liveness
+        ages.  Read-only over plain attrs — safe to call from RPC
+        handlers and the watchdog while the connection runs."""
+        now = time.monotonic()
+        channels = {}
+        for ch in self.channels.values():
+            channels[ch.display_name] = {
+                "channel_id": ch.desc.channel_id,
+                "sent_bytes": ch.sent_bytes,
+                "sent_msgs": ch.sent_msgs,
+                "recv_bytes": ch.recv_bytes,
+                "recv_msgs": ch.recv_msgs,
+                "send_queue": ch.queue.qsize(),
+                "send_queue_capacity": ch.desc.send_queue_capacity,
+                "queue_full_drops": ch.queue_full_drops,
+            }
+        return {
+            "age_s": round(now - self.created_mono, 3),
+            "send_bytes_total": self.send_monitor.total,
+            "recv_bytes_total": self.recv_monitor.total,
+            "send_rate": round(self.send_monitor.rate, 1),
+            "recv_rate": round(self.recv_monitor.rate, 1),
+            "last_recv_age_s": round(now - self.last_recv_mono, 3),
+            "last_msg_recv_age_s": round(now - self.last_msg_recv_mono, 3),
+            "last_rtt_s": (round(self.last_rtt_s, 6)
+                           if self.last_rtt_s is not None else None),
+            "pong_timeouts": self.pong_timeouts,
+            "channels": channels,
+        }

@@ -11,6 +11,32 @@ from .conn import MConnection
 from .node_info import NodeInfo
 
 
+class GossipStats:
+    """Per-peer gossip efficiency tallies, incremented by the consensus
+    reactor (plain ints — the Prometheus children are bound separately).
+    ``useful`` = votes we did not already hold; ``duplicate`` = re-gossip
+    dropped at the reactor.  A partner whose ratio trends toward zero is
+    mostly re-sending what we have."""
+
+    __slots__ = ("useful", "duplicate")
+
+    def __init__(self):
+        self.useful = 0
+        self.duplicate = 0
+
+    def ratio(self) -> float | None:
+        total = self.useful + self.duplicate
+        if total == 0:
+            return None
+        return self.useful / total
+
+    def as_dict(self) -> dict:
+        r = self.ratio()
+        return {"useful_votes": self.useful,
+                "duplicate_votes": self.duplicate,
+                "useful_ratio": None if r is None else round(r, 4)}
+
+
 class Peer:
     def __init__(self, node_info: NodeInfo, mconn: MConnection,
                  outbound: bool, persistent: bool = False,
@@ -20,6 +46,7 @@ class Peer:
         self.outbound = outbound
         self.persistent = persistent
         self.dial_addr = dial_addr          # for persistent reconnect
+        self.gossip = GossipStats()
         self._data: dict = {}               # reactor-attached state
 
     @property
@@ -49,6 +76,20 @@ class Peer:
 
     def status(self) -> dict:
         return self.mconn.status()
+
+    def telemetry(self) -> dict:
+        """The per-peer snapshot `/net_info` and the liveness watchdog's
+        incident bundles serve: identity + direction + the MConnection's
+        per-channel counters/flowrate/RTT + gossip efficiency."""
+        return {
+            "node_id": self.id,
+            "moniker": self.node_info.moniker,
+            "remote_addr": self.remote_addr,
+            "outbound": self.outbound,
+            "persistent": self.persistent,
+            "connection_status": self.mconn.telemetry(),
+            "gossip": self.gossip.as_dict(),
+        }
 
     def __repr__(self):
         arrow = "->" if self.outbound else "<-"

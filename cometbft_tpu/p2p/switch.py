@@ -9,11 +9,13 @@ with exponential backoff after errors (switch.go reconnectToPeer)."""
 from __future__ import annotations
 
 import asyncio
+import time
 
 from ..libs import aio
 import random
 
-from .conn import MConnection
+from .conn import MConnection, PongTimeoutError
+from .metrics import p2p_metrics, peer_label
 from .node_info import NodeInfo
 from .peer import Peer
 from .reactor import ChannelDescriptor, Reactor
@@ -22,6 +24,9 @@ from .transport import Transport
 RECONNECT_BASE_DELAY = 0.5
 RECONNECT_MAX_DELAY = 30.0
 RECONNECT_MAX_ATTEMPTS = 20
+# per-peer telemetry flush cadence (Prometheus series are written here,
+# never from the packet path); the Switch constructor can override
+TELEMETRY_FLUSH_INTERVAL = 2.0
 
 
 class SwitchError(Exception):
@@ -31,7 +36,8 @@ class SwitchError(Exception):
 class Switch:
     def __init__(self, transport: Transport,
                  ping_interval: float = 10.0, pong_timeout: float = 5.0,
-                 emulated_latency: float = 0.0):
+                 emulated_latency: float = 0.0,
+                 telemetry_interval: float = TELEMETRY_FLUSH_INTERVAL):
         self.transport = transport
         self.emulated_latency = emulated_latency
         self.reactors: dict[str, Reactor] = {}
@@ -40,15 +46,27 @@ class Switch:
         self.peers: dict[str, Peer] = {}
         self.ping_interval = ping_interval
         self.pong_timeout = pong_timeout
+        self.telemetry_interval = telemetry_interval
         self._running = False
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
+        self._telemetry_task: asyncio.Task | None = None
+        # last flushed (bytes..., drops) per (peer_label, chan_name) so
+        # the sampler incs counters by delta, keeping them monotonic
+        self._flushed: dict[tuple[str, str], tuple] = {}
         transport.on_accept = self._on_accepted
-        from ..libs import metrics as _m
 
         # labeled per node id: multi-node in-process ensembles share the
         # process-wide registry
         self._m_node = transport.node_key.id[:8]
-        self._m_peers = _m.gauge("p2p_peers", "connected peers")
+        self._m = p2p_metrics()
+        self._m_peers_out = self._m.peers.bind(node=self._m_node,
+                                               direction="outbound")
+        self._m_peers_in = self._m.peers.bind(node=self._m_node,
+                                              direction="inbound")
+        self._m_rtt = self._m.ping_rtt_seconds.bind(node=self._m_node)
+        # per-channel dispatch counters, pre-bound at add_reactor time so
+        # the receive hot path pays one dict lookup + one bound inc
+        self._m_reactor_msgs: dict[int, object] = {}
 
     # ----------------------------------------------------------- reactors
 
@@ -59,6 +77,8 @@ class Switch:
                     f"channel {desc.channel_id:#x} already claimed")
             self._chan_to_reactor[desc.channel_id] = reactor
             self._descriptors.append(desc)
+            self._m_reactor_msgs[desc.channel_id] = \
+                self._m.reactor_msgs.bind(reactor=name, node=self._m_node)
         self.reactors[name] = reactor
         reactor.set_switch(self)
 
@@ -72,9 +92,18 @@ class Switch:
         self._running = True
         for reactor in self.reactors.values():
             await reactor.start()
+        if self.telemetry_interval > 0:
+            self._telemetry_task = asyncio.create_task(
+                self._telemetry_routine())
 
     async def stop(self) -> None:
         self._running = False
+        # cancel everything BEFORE the first await: a yield here would
+        # let an in-flight reconnect dial land a peer after the removal
+        # snapshot below, leaking its MConnection tasks
+        tele_task, self._telemetry_task = self._telemetry_task, None
+        if tele_task is not None:
+            tele_task.cancel()
         for task in self._reconnect_tasks.values():
             task.cancel()
         self._reconnect_tasks.clear()
@@ -83,6 +112,11 @@ class Switch:
         for reactor in self.reactors.values():
             await reactor.stop()
         await self.transport.close()
+        if tele_task is not None:
+            try:
+                await tele_task
+            except (asyncio.CancelledError, Exception):
+                pass
 
     # -------------------------------------------------------------- peers
 
@@ -90,13 +124,26 @@ class Switch:
         await self._add_peer(conn, node_info, outbound=False)
 
     async def dial_peer(self, addr: str, persistent: bool = False) -> Peer:
-        conn, node_info = await self.transport.dial(addr)
+        try:
+            conn, node_info = await self.transport.dial(addr)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self._m.dial_failures.inc(node=self._m_node)
+            raise
         return await self._add_peer(conn, node_info, outbound=True,
                                     persistent=persistent, dial_addr=addr)
 
     async def _add_peer(self, conn, node_info: NodeInfo, outbound: bool,
                         persistent: bool = False,
                         dial_addr: str | None = None) -> Peer:
+        if not self._running:
+            # an accept (or concurrent dial) whose handshake finishes
+            # while stop() runs must not land a peer after the removal
+            # snapshot — its MConnection tasks would never be cancelled
+            # and the peer gauges would report a phantom forever
+            conn.close()
+            raise SwitchError("switch is not running")
         own_id = self.transport.node_key.id
         if node_info.node_id == own_id:
             conn.close()
@@ -106,10 +153,14 @@ class Switch:
             raise SwitchError(f"duplicate peer {node_info.node_id[:12]}")
 
         peer_box: list[Peer] = []
+        reactor_msgs = self._m_reactor_msgs
 
         def on_receive(chan_id: int, msg: bytes) -> None:
             reactor = self._chan_to_reactor.get(chan_id)
             if reactor is not None and peer_box:
+                bound = reactor_msgs.get(chan_id)
+                if bound is not None:
+                    bound.inc()
                 reactor.receive(chan_id, peer_box[0], msg)
 
         def on_error(exc: Exception) -> None:
@@ -120,19 +171,27 @@ class Switch:
                             ping_interval=self.ping_interval,
                             pong_timeout=self.pong_timeout,
                             emulated_latency=self.emulated_latency)
+        mconn.on_rtt = self._m_rtt.observe
         peer = Peer(node_info, mconn, outbound, persistent, dial_addr)
         peer_box.append(peer)
         self.peers[peer.id] = peer
-        self._m_peers.set(len(self.peers), node=self._m_node)
+        self._set_peer_gauges()
         mconn.start()
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
         return peer
 
+    def _set_peer_gauges(self) -> None:
+        n_out = sum(1 for p in self.peers.values() if p.outbound)
+        self._m_peers_out.set(n_out)
+        self._m_peers_in.set(len(self.peers) - n_out)
+
     async def stop_peer_for_error(self, peer: Peer, err) -> None:
         """switch.go StopPeerForError + persistent reconnect."""
         if peer.id not in self.peers:
             return
+        if isinstance(err, PongTimeoutError):
+            self._m.pong_timeouts.inc(node=self._m_node)
         await self._remove_peer(peer, err)
         if self._running and peer.persistent and peer.dial_addr:
             self._schedule_reconnect(peer.dial_addr)
@@ -142,7 +201,8 @@ class Switch:
 
     async def _remove_peer(self, peer: Peer, reason) -> None:
         self.peers.pop(peer.id, None)
-        self._m_peers.set(len(self.peers), node=self._m_node)
+        self._set_peer_gauges()
+        self._drop_peer_series(peer)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)
@@ -171,6 +231,100 @@ class Switch:
         task.add_done_callback(
             lambda _t: self._reconnect_tasks.pop(addr, None))
         self._reconnect_tasks[addr] = task
+
+    # ---------------------------------------------------------- telemetry
+
+    async def _telemetry_routine(self) -> None:
+        """Periodic flush of per-peer plain-int counters into the
+        peer-labeled Prometheus series (delta-inc keeps counters
+        monotonic; gauges are set).  Runs off the packet path at
+        ``telemetry_interval`` — the hot path only ever touches ints."""
+        try:
+            while True:
+                await asyncio.sleep(self.telemetry_interval)
+                try:
+                    self.flush_peer_telemetry()
+                except Exception:
+                    pass          # never let a metrics bug kill p2p
+        except asyncio.CancelledError:
+            raise
+
+    def flush_peer_telemetry(self) -> None:
+        for peer in list(self.peers.values()):
+            self._flush_one_peer(peer)
+
+    def _flush_one_peer(self, peer: Peer) -> None:
+        mets, node = self._m, self._m_node
+        pl = peer_label(peer.id)
+        mconn = peer.mconn
+        for ch in mconn.channels.values():
+            cname = ch.display_name
+            key = (pl, cname)
+            cur = (ch.sent_bytes, ch.recv_bytes, ch.sent_msgs,
+                   ch.recv_msgs, ch.queue_full_drops)
+            last = self._flushed.get(key, (0, 0, 0, 0, 0))
+            if cur[0] > last[0]:
+                mets.peer_send_bytes.inc(cur[0] - last[0], node=node,
+                                         peer=pl, channel=cname)
+            if cur[1] > last[1]:
+                mets.peer_recv_bytes.inc(cur[1] - last[1], node=node,
+                                         peer=pl, channel=cname)
+            if cur[2] > last[2]:
+                mets.peer_send_msgs.inc(cur[2] - last[2], node=node,
+                                        peer=pl, channel=cname)
+            if cur[3] > last[3]:
+                mets.peer_recv_msgs.inc(cur[3] - last[3], node=node,
+                                        peer=pl, channel=cname)
+            if cur[4] > last[4]:
+                mets.peer_queue_drops.inc(cur[4] - last[4], node=node,
+                                          peer=pl, channel=cname)
+                mets.queue_full_drops.inc(cur[4] - last[4], node=node,
+                                          channel=cname)
+            self._flushed[key] = cur
+            mets.peer_queue_depth.set(ch.queue.qsize(), node=node,
+                                      peer=pl, channel=cname)
+        mets.peer_send_rate.set(mconn.send_monitor.rate, node=node,
+                                peer=pl)
+        mets.peer_recv_rate.set(mconn.recv_monitor.rate, node=node,
+                                peer=pl)
+        if mconn.last_rtt_s is not None:
+            mets.peer_rtt.set(mconn.last_rtt_s, node=node, peer=pl)
+
+    def _drop_peer_series(self, peer: Peer) -> None:
+        """Final counter flush (up to one sampler interval of deltas is
+        still unreported — queue-full drops especially cluster right
+        before a disconnect), then drop the gauges so a departed peer
+        never reports stale depth/rate/RTT forever.  Counters stay
+        (Prometheus counters are append-only; the cardinality guard
+        reclaims them under churn)."""
+        try:
+            self._flush_one_peer(peer)
+        except Exception:
+            pass                  # metrics must never block removal
+        pl = peer_label(peer.id)
+        mets, node = self._m, self._m_node
+        for key in [k for k in self._flushed if k[0] == pl]:
+            self._flushed.pop(key, None)
+            mets.peer_queue_depth.remove(node=node, peer=pl,
+                                         channel=key[1])
+        mets.peer_send_rate.remove(node=node, peer=pl)
+        mets.peer_recv_rate.remove(node=node, peer=pl)
+        mets.peer_rtt.remove(node=node, peer=pl)
+
+    def peer_snapshot(self) -> list[dict]:
+        """Per-peer telemetry dicts for `/net_info` and the liveness
+        watchdog's incident bundles."""
+        return [p.telemetry() for p in self.peers.values()]
+
+    def quietest_peer_recv_age_s(self) -> float | None:
+        """Seconds since the MOST RECENTLY heard-from peer last produced
+        a complete packet — the watchdog's "all peers went quiet" input
+        (None with no peers: an isolated node is a different condition)."""
+        if not self.peers:
+            return None
+        now = time.monotonic()
+        return min(now - p.mconn.last_recv_mono
+                   for p in self.peers.values())
 
     # ---------------------------------------------------------- broadcast
 

@@ -10,8 +10,10 @@ compatibility).  Only then does the Switch see the peer.
 from __future__ import annotations
 
 import asyncio
+import time
 
 from .key import NodeKey, node_id
+from .metrics import p2p_metrics
 from .node_info import NodeInfo, NodeInfoError
 from .secret_connection import SecretConnection, handshake
 
@@ -56,8 +58,8 @@ class Transport:
     async def _handle_accept(self, reader, writer) -> None:
         try:
             freader, fwriter = self._maybe_fuzz(reader, writer)
-            conn, ni = await asyncio.wait_for(
-                self._upgrade(freader, fwriter), self.handshake_timeout)
+            conn, ni = await self._timed_upgrade(freader, fwriter,
+                                                 "inbound")
         except Exception:
             writer.close()
             return
@@ -77,13 +79,33 @@ class Transport:
         reader, writer = await asyncio.open_connection(host, int(port))
         try:
             freader, fwriter = self._maybe_fuzz(reader, writer)
-            return await asyncio.wait_for(
-                self._upgrade(freader, fwriter), self.handshake_timeout)
+            return await self._timed_upgrade(freader, fwriter, "outbound")
         except Exception:
             writer.close()
             raise
 
     # ------------------------------------------------------------ upgrade
+
+    async def _timed_upgrade(self, reader, writer, direction: str) \
+            -> tuple[SecretConnection, NodeInfo]:
+        """The upgrade under its timeout, metered: handshake latency by
+        direction on success, a failure counter otherwise (an operator
+        watching a validator fail to join a network sees WHERE — dials
+        that never complete the upgrade, or inbound peers that do not)."""
+        mets = p2p_metrics()
+        node = self.node_key.id[:8]
+        t0 = time.perf_counter()
+        try:
+            out = await asyncio.wait_for(
+                self._upgrade(reader, writer), self.handshake_timeout)
+        except asyncio.CancelledError:
+            raise                 # shutdown, not a handshake failure
+        except Exception:
+            mets.handshake_failures.inc(direction=direction, node=node)
+            raise
+        mets.handshake_seconds.observe(time.perf_counter() - t0,
+                                       direction=direction, node=node)
+        return out
 
     async def _upgrade(self, reader, writer) \
             -> tuple[SecretConnection, NodeInfo]:

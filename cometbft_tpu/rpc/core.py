@@ -112,15 +112,24 @@ async def status(env: Environment) -> dict:
 
 
 async def net_info(env: Environment) -> dict:
+    """rpc/core/net.go NetInfo, enriched with the live per-peer
+    telemetry the p2p layer now keeps: per-channel bytes/msgs in both
+    directions, send-queue depth/capacity and queue-full drops, the
+    flowrate send/recv EMAs, last ping RTT, connection age, and the
+    gossip useful/duplicate efficiency — so a bad gossip partner or a
+    backpressured channel is visible from one curl, not a Prometheus
+    deployment."""
     sw = env.node.switch
     peers = []
-    if sw is not None:
-        for p in sw.peers.values():
-            peers.append({"node_id": p.id, "moniker": p.node_info.moniker,
-                          "outbound": p.outbound})
+    if sw is not None and getattr(sw, "peer_snapshot", None) is not None:
+        peers = sw.peer_snapshot()
+    n_outbound = sum(1 for p in peers if p.get("outbound"))
     return {"listening": env.node.listen_addr is not None,
             "listen_addr": env.node.listen_addr or "",
-            "n_peers": len(peers), "peers": peers}
+            "n_peers": len(peers),
+            "n_outbound": n_outbound,
+            "n_inbound": len(peers) - n_outbound,
+            "peers": peers}
 
 
 _GENESIS_CHUNK_SIZE = 16 * 1024 * 1024   # rpc/core/env.go:32
@@ -652,6 +661,36 @@ async def dump_trace(env: Environment, limit=1000) -> dict:
     }
 
 
+async def dump_incidents(env: Environment, limit=50, name=None) -> dict:
+    """List the liveness watchdog's black-box incident bundles (newest
+    first, metadata only — filenames carry timestamp + reasons, bodies
+    can run megabytes of trace ring).  Pass ``name=<listing name>`` to
+    fetch one parsed bundle inline.  Always answers, even with the
+    watchdog disabled or no home dir: ``enabled: false`` + an empty
+    list, so operator tooling can probe unconditionally."""
+    from ..node.watchdog import list_incidents, load_incident
+
+    node = env.node
+    wd = getattr(node, "liveness_watchdog", None)
+    incident_fn = getattr(node, "incident_dir", None)
+    incident_dir = incident_fn() if callable(incident_fn) else None
+    out = {
+        "enabled": wd is not None,
+        "incident_dir": incident_dir or "",
+        "trips": wd.trips if wd is not None else 0,
+        "incidents": (list_incidents(incident_dir, int(limit))
+                      if incident_dir else []),
+    }
+    if name is not None:
+        if not incident_dir:
+            raise RPCError(-32603, "no incident directory on this node")
+        bundle = load_incident(incident_dir, str(name))
+        if bundle is None:
+            raise RPCError(-32603, f"no incident bundle {name!r}")
+        out["bundle"] = bundle
+    return out
+
+
 # ---------------------------------------------------- unsafe (dev-only)
 
 async def dial_seeds(env: Environment, seeds=None) -> dict:
@@ -717,6 +756,7 @@ ROUTES = {
     "genesis_chunked": genesis_chunked,
     "check_tx": check_tx,
     "dump_trace": dump_trace,
+    "dump_incidents": dump_incidents,
 }
 
 # registered only when config rpc.unsafe is set (rpc/core/routes.go:57-62)

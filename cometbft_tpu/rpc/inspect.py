@@ -31,6 +31,8 @@ class InspectNode:
         self.config = config
         self.genesis = genesis_doc
         self.name = name
+        self.home = home
+        self.liveness_watchdog = None     # offline: list bundles only
         backend = config.storage.db_backend
         self.block_store = BlockStore(open_db(
             backend, os.path.join(home, "data", "blockstore.db")))
@@ -55,6 +57,13 @@ class InspectNode:
         self.blocksync_reactor = None
         self.pruner = None
         self.event_bus = _NoLiveSubsystem()
+
+    def incident_dir(self) -> str | None:
+        """Same resolution as Node.incident_dir: a crashed validator's
+        black-box bundles are exactly what inspect mode is for."""
+        from ..node.watchdog import resolve_incident_dir
+
+        return resolve_incident_dir(self.config, self.home)
 
 
 async def run_inspect(home: str, config, genesis_doc,

@@ -1,13 +1,20 @@
 #!/usr/bin/env python
-"""CI observability smoke: boot one validator node with tracing enabled,
-then hit the RPC listener the way an operator's tooling would —
+"""CI observability smoke: boot a tracing-enabled validator plus one
+connected observer node, then hit the RPC listener the way an operator's
+tooling would —
 
 - ``GET /metrics`` must answer 200 with parseable Prometheus text
-  exposition (every line a comment, a blank, or ``name{labels} value``),
+  exposition (every line a comment, a blank, or ``name{labels} value``)
+  — including the new peer-labeled p2p series the telemetry sampler
+  writes,
 - ``GET /dump_trace?limit=N`` must answer 200 with a JSON-RPC envelope
   whose result carries flight-recorder records (consensus step spans at
   minimum, since the node committed a block),
-- ``GET /status`` must carry the enriched ``consensus_info`` block.
+- ``GET /status`` must carry the enriched ``consensus_info`` block,
+- ``GET /net_info`` must carry per-peer per-channel bytes, queue depth,
+  flowrate and RTT fields for the connected peer,
+- ``GET /dump_incidents`` must answer 200 with a well-formed (here:
+  empty — nothing stalled) incident list.
 
 Exit 0 on success, 1 with a reason on any failure.  Used by the lint
 workflow's smoke job (`.github/workflows/lint.yml`); runnable locally:
@@ -64,19 +71,29 @@ async def main() -> int:
     from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
     from cometbft_tpu.types.priv_validator import MockPV
 
-    cfg = Config(consensus=test_consensus_config())
-    cfg.p2p.laddr = "tcp://127.0.0.1:0"
-    cfg.rpc.laddr = "tcp://127.0.0.1:0"
-    cfg.instrumentation.tracing = True
+    def _cfg() -> Config:
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.instrumentation.tracing = True
+        cfg.p2p.telemetry_flush_interval_s = 0.25
+        return cfg
 
     pv = MockPV.from_secret(b"smoke-node")
     doc = GenesisDoc(chain_id="smoke-net",
                      validators=[GenesisValidator(pv.get_pub_key(), 10)])
     node = await Node.create(doc, KVStoreApplication(), priv_validator=pv,
-                             config=cfg, name="smoke")
+                             config=_cfg(), name="smoke")
     await node.start()
+    # a second, non-validator node so /net_info has a live peer to report
+    cfg2 = _cfg()
+    cfg2.rpc.laddr = ""
+    observer = await Node.create(doc, KVStoreApplication(), config=cfg2,
+                                 name="smoke-obs")
+    await observer.start()
     loop = asyncio.get_running_loop()
     try:
+        await observer.dial_peer(node.listen_addr, persistent=False)
         # a single validator commits on its own; wait for height >= 1
         for _ in range(600):
             if node.block_store.height() >= 1:
@@ -129,10 +146,71 @@ async def main() -> int:
             print("FAIL: /status missing consensus_info", file=sys.stderr)
             return 1
 
+        # ---- /net_info: per-peer telemetry for the connected observer
+        status, body = await loop.run_in_executor(
+            None, fetch, base + "/net_info")
+        if status != 200:
+            print(f"FAIL: /net_info -> HTTP {status}", file=sys.stderr)
+            return 1
+        ni = json.loads(body).get("result") or {}
+        if ni.get("n_peers") != 1 or len(ni.get("peers") or []) != 1:
+            print(f"FAIL: /net_info reports {ni.get('n_peers')} peers, "
+                  "expected the observer", file=sys.stderr)
+            return 1
+        peer = ni["peers"][0]
+        conn = peer.get("connection_status") or {}
+        for field in ("send_rate", "recv_rate", "last_rtt_s",
+                      "send_bytes_total", "recv_bytes_total", "channels"):
+            if field not in conn:
+                print(f"FAIL: /net_info peer missing {field}",
+                      file=sys.stderr)
+                return 1
+        if "gossip" not in peer or "useful_votes" not in peer["gossip"]:
+            print("FAIL: /net_info peer missing gossip efficiency",
+                  file=sys.stderr)
+            return 1
+        chans = conn["channels"]
+        vote = chans.get("vote")
+        if not vote:
+            print(f"FAIL: /net_info peer channels lack 'vote': "
+                  f"{sorted(chans)}", file=sys.stderr)
+            return 1
+        for field in ("sent_bytes", "recv_bytes", "sent_msgs",
+                      "recv_msgs", "send_queue", "send_queue_capacity",
+                      "queue_full_drops"):
+            if field not in vote:
+                print(f"FAIL: /net_info vote channel missing {field}",
+                      file=sys.stderr)
+                return 1
+        if conn["send_bytes_total"] <= 0:
+            print("FAIL: /net_info shows no bytes sent to the observer",
+                  file=sys.stderr)
+            return 1
+
+        # ---- /dump_incidents: 200 + well-formed (empty) list
+        status, body = await loop.run_in_executor(
+            None, fetch, base + "/dump_incidents")
+        if status != 200:
+            print(f"FAIL: /dump_incidents -> HTTP {status}",
+                  file=sys.stderr)
+            return 1
+        inc = json.loads(body).get("result") or {}
+        if "incidents" not in inc or not isinstance(inc["incidents"],
+                                                    list):
+            print(f"FAIL: /dump_incidents malformed: {inc}",
+                  file=sys.stderr)
+            return 1
+        if inc["incidents"]:
+            print("FAIL: healthy smoke net reported incidents: "
+                  f"{inc['incidents']}", file=sys.stderr)
+            return 1
+
         print(f"smoke ok: height={node.block_store.height()} "
-              f"trace_records={len(recs)} step_spans={len(steps)}")
+              f"trace_records={len(recs)} step_spans={len(steps)} "
+              f"peer_channels={len(chans)}")
         return 0
     finally:
+        await observer.stop()
         await node.stop()
 
 

@@ -1,0 +1,163 @@
+"""Unit tests for ``libs/flowrate.Monitor`` rate math — the meter behind
+every MConnection's send/recv telemetry and rate limiting (it shipped
+untested before the network-telemetry PR).  All tests drive an injected
+clock with binary-exact step sizes (0.125, 1/64) so period-boundary
+comparisons are deterministic, not at the mercy of decimal float error."""
+
+import pytest
+
+from cometbft_tpu.libs.flowrate import Monitor
+
+pytestmark = pytest.mark.timeout(60)
+
+PERIOD = 0.125                  # binary-exact sample period
+STEP = 1 / 64                   # binary-exact sub-period step (8 per period)
+
+
+class FakeClock:
+    def __init__(self, t=1024.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _monitor(alpha=0.25):
+    clk = FakeClock()
+    return Monitor(sample_period=PERIOD, ema_alpha=alpha, now=clk), clk
+
+
+# ------------------------------------------------------------------- EMA
+
+def test_ema_converges_to_steady_rate():
+    """Updating n bytes once per full sample period converges the EMA to
+    n/period bytes/sec (1000 B / 0.125 s -> 8 kB/s)."""
+    m, clk = _monitor()
+    for _ in range(60):
+        clk.advance(PERIOD)
+        m.update(1000)
+    assert m.rate == pytest.approx(8000, rel=0.01)
+    assert m.total == 60_000
+
+
+def test_ema_window_sub_period_updates_accumulate():
+    """Updates inside one sample period accumulate into a single sample:
+    eight 125-byte updates across one period count the same as one
+    1000-byte update (the EMA never sees partial windows)."""
+    a, clk_a = _monitor()
+    for _ in range(8):
+        clk_a.advance(STEP)     # 8 * 1/64 == 0.125 exactly
+        a.update(125)
+    b, clk_b = _monitor()
+    clk_b.advance(PERIOD)
+    b.update(1000)
+    assert a._rate == b._rate   # both windows closed identically
+    assert a.rate == pytest.approx(b.rate)
+
+
+def test_ema_weights_recent_samples():
+    """A burst followed by a trickle moves the EMA toward the new level
+    geometrically (alpha per full period)."""
+    m, clk = _monitor()
+    for _ in range(40):
+        clk.advance(PERIOD)
+        m.update(10_000)        # 80 kB/s
+    fast = m.rate
+    for _ in range(5):
+        clk.advance(PERIOD)
+        m.update(100)           # collapse to 800 B/s
+    assert m.rate < fast * 0.3
+    assert m.rate > 800         # but not yet fully converged
+
+
+# ------------------------------------------------------------ idle decay
+
+def test_idle_decay_converges_to_zero():
+    """With no updates, ``rate`` decays geometrically per elapsed period
+    instead of freezing at the last burst — and reading it does not
+    mutate the EMA (no self-accelerating decay)."""
+    m, clk = _monitor()
+    for _ in range(40):
+        clk.advance(PERIOD)
+        m.update(10_000)
+    busy = m.rate
+    assert busy == pytest.approx(80_000, rel=0.05)
+    clk.advance(5 * PERIOD)     # 5 idle periods
+    idle5 = m.rate
+    assert idle5 < busy * 0.5
+    assert m.rate == pytest.approx(idle5)     # repeated reads identical
+    clk.advance(45 * PERIOD)    # 50 idle periods total
+    assert m.rate < busy * 0.001
+    # a new burst recovers (the update path was untouched by the reads)
+    for _ in range(40):
+        clk.advance(PERIOD)
+        m.update(10_000)
+    assert m.rate == pytest.approx(80_000, rel=0.05)
+
+
+def test_rate_inside_first_period_is_last_ema():
+    """Within one sample period of the last closed window the EMA is
+    returned as-is (no decay, no partial-window fold)."""
+    m, clk = _monitor()
+    clk.advance(PERIOD)
+    m.update(1000)
+    ema = m._rate
+    clk.advance(PERIOD / 2)
+    assert m.rate == ema
+
+
+# -------------------------------------------------- startup / limit edges
+
+def test_limit_at_startup_grants_one_period_burst():
+    """The monotonic-clock edge at startup: at t == start (zero elapsed)
+    the budget is one sample period's allowance, not 0 — otherwise every
+    fresh rate-limited connection's first packet would always back off."""
+    m, clk = _monitor()
+    assert m.limit(500, 10_000) == 500          # one period = 1250 bytes
+    assert m.limit(5000, 10_000) == 1250        # capped at the burst
+    # unlimited rate passes through untouched, even at t == start
+    assert m.limit(12345, None) == 12345
+    assert m.limit(12345, 0) == 12345
+
+
+def test_limit_enforces_average_rate():
+    """Total transfer stays within max_rate * elapsed (+ the one-period
+    startup burst) when the caller obeys limit() — and is not starved."""
+    m, clk = _monitor()
+    max_rate = 10_000
+    sent = 0
+    steps = 1000
+    for _ in range(steps):
+        allowed = m.limit(400, max_rate)
+        if allowed:
+            m.update(allowed)
+            sent += allowed
+        clk.advance(STEP)
+    elapsed = steps * STEP
+    assert sent <= max_rate * (elapsed + PERIOD) + 400
+    assert sent >= max_rate * elapsed * 0.9
+
+
+def test_update_at_exact_period_boundary():
+    """elapsed == period closes the sample window (>= comparison): the
+    sample state resets and the EMA folds the full sample in."""
+    m, clk = _monitor()
+    clk.advance(PERIOD)
+    m.update(300)
+    assert m._sample_bytes == 0                 # window closed
+    assert m._rate == pytest.approx(0.25 * (300 / PERIOD))
+
+
+def test_status_reports_totals_and_decayed_rate():
+    m, clk = _monitor()
+    clk.advance(PERIOD)
+    m.update(1000)
+    clk.advance(1.0 - PERIOD)
+    st = m.status()
+    assert st["bytes"] == 1000
+    assert st["duration_s"] == pytest.approx(1.0)
+    assert st["avg_rate"] == pytest.approx(1000.0)
+    assert st["inst_rate"] == m.rate            # decayed, not frozen EMA

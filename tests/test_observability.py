@@ -77,6 +77,78 @@ def test_help_text_escaped_per_exposition_spec():
         assert not line or line.startswith("# ") or " " in line
 
 
+def test_label_cardinality_cap_evicts_oldest():
+    """Per-metric label sets are capped: the oldest labeled child is
+    evicted to admit a new one, the eviction is counted, and the
+    unlabeled series survives — per-peer labels cannot grow the registry
+    unboundedly as peers churn."""
+    reg = Registry()
+    c = reg.register(Counter("cap_total", "capped", max_label_sets=4))
+    c.inc()                                   # unlabeled series
+    for i in range(8):
+        c.inc(1, peer=f"p{i}")
+    assert c.label_sets() == 4                # cap held
+    assert c.evicted_total == 5               # 9 inserts - 4 kept
+    assert c.value() == 1.0                   # unlabeled never evicted
+    assert c.value(peer="p7") == 1.0          # newest kept
+    assert c.value(peer="p0") == 0.0          # oldest gone
+    text = reg.collect()
+    assert "# TYPE metrics_label_evictions_total counter" in text
+    assert 'metrics_label_evictions_total{metric="cap_total"} 5' in text
+    # an uncapped sibling metric exports no eviction series
+    reg2 = Registry()
+    reg2.register(Counter("free_total")).inc(route="x")
+    assert "metrics_label_evictions_total" not in reg2.collect()
+
+
+def test_label_cap_applies_to_bound_children_and_other_types():
+    """Bound children go through the same guard, and Gauge/Histogram are
+    capped like Counter (set/add/observe paths)."""
+    reg = Registry()
+    c = reg.register(Counter("bcap_total", max_label_sets=3))
+    bound = [c.bind(peer=f"b{i}") for i in range(6)]
+    for b in bound:
+        b.inc()
+    assert c.label_sets() == 3
+    # an evicted bound child transparently re-inserts (counter resets,
+    # which Prometheus rate() treats as a restart)
+    bound[0].inc()
+    assert c.value(peer="b0") == 1.0
+    assert c.label_sets() == 3
+
+    g = reg.register(Gauge("bcap_gauge", max_label_sets=3))
+    for i in range(6):
+        g.set(i, peer=f"g{i}")
+    for i in range(6):
+        g.add(1, peer=f"ga{i}")
+    assert g.label_sets() == 3
+
+    h = reg.register(Histogram("bcap_seconds", buckets=(1.0,),
+                               max_label_sets=3))
+    for i in range(6):
+        h.observe(0.5, peer=f"h{i}")
+    assert len(h._counts) == 3
+    assert len(h._sums) == 3 and len(h._totals) == 3   # evicted together
+    assert h.count(peer="h5") == 1 and h.count(peer="h0") == 0
+    # exposition stays parseable after evictions
+    for line in reg.collect().splitlines():
+        assert not line or line.startswith("# ") or " " in line
+
+
+def test_gauge_remove_drops_labeled_child():
+    """Gauge.remove lets the switch drop a departed peer's series so it
+    does not report its last value forever."""
+    reg = Registry()
+    g = reg.register(Gauge("rm_gauge"))
+    g.set(7, peer="x")
+    g.set(9, peer="y")
+    g.remove(peer="x")
+    g.remove(peer="ghost")                    # absent: no-op
+    text = reg.collect()
+    assert 'rm_gauge{peer="y"} 9.0' in text
+    assert 'peer="x"' not in text
+
+
 def test_gauge_and_histogram_bind():
     """Gauge.bind()/Histogram.bind() mirror Counter.bind(): pre-resolved
     label sets that skip the per-call sort on hot paths but land in the

@@ -243,6 +243,141 @@ def test_switch_rejects_wrong_network():
     assert run(main())
 
 
+def test_mconnection_telemetry_counters():
+    """Per-channel bytes/msgs both directions, queue-full drops, and the
+    telemetry() snapshot shape — the raw material of /net_info."""
+    async def main():
+        server, (r1, w1), (r2, w2) = await _tcp_pair()
+        k1, k2 = Ed25519PrivKey.generate(), Ed25519PrivKey.generate()
+        c1, c2 = await asyncio.gather(handshake(r1, w1, k1),
+                                      handshake(r2, w2, k2))
+        descs = [ChannelDescriptor(0x20, priority=5, name="state",
+                                   send_queue_capacity=2),
+                 ChannelDescriptor(0x30, priority=1, name="bulk")]
+        got1, got2 = [], []
+        m1, m2 = _mconn_pair(c1, c2, descs, got1, got2)
+        big = b"B" * 5000                   # spans multiple packets
+        assert m1.send(0x20, b"vote")
+        assert m1.send(0x30, big)
+        for _ in range(200):
+            if len(got2) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        t1, t2 = m1.telemetry(), m2.telemetry()
+        assert t1["channels"]["state"]["sent_msgs"] == 1
+        assert t1["channels"]["state"]["sent_bytes"] == len(b"vote")
+        assert t1["channels"]["bulk"]["sent_msgs"] == 1
+        assert t1["channels"]["bulk"]["sent_bytes"] == len(big)
+        assert t2["channels"]["state"]["recv_msgs"] == 1
+        assert t2["channels"]["bulk"]["recv_bytes"] == len(big)
+        assert t2["recv_bytes_total"] > len(big)      # framing overhead
+        assert t1["channels"]["state"]["send_queue_capacity"] == 2
+        assert t1["age_s"] >= 0 and t2["last_recv_age_s"] >= 0
+        # queue-full drops are counted per channel (capacity 2, stopped
+        # send routine cannot drain under a fast enough fill)
+        drops_before = m1.channels[0x20].queue_full_drops
+        sent = sum(1 for _ in range(50) if m1.send(0x20, b"x" * 900))
+        assert m1.channels[0x20].queue_full_drops == \
+            drops_before + (50 - sent)
+        assert sent < 50
+        t1b = m1.telemetry()
+        assert t1b["channels"]["state"]["queue_full_drops"] >= 1
+        await m1.stop(), await m2.stop()
+        server.close()
+        return True
+
+    assert run(main())
+
+
+def test_switch_peer_gauges_and_telemetry_flush():
+    """Direction-labeled peer gauges, per-peer Prometheus series after a
+    sampler flush, peer_snapshot() for /net_info, and gauge cleanup when
+    the peer leaves."""
+    async def main():
+        from cometbft_tpu.p2p.metrics import p2p_metrics, peer_label
+
+        sw1, listen1 = _make_switch(secret=b"tm1")
+        sw2, listen2 = _make_switch(secret=b"tm2")
+        e1, e2 = EchoReactor(), EchoReactor()
+        sw1.add_reactor("echo", e1)
+        sw2.add_reactor("echo", e2)
+        addr1 = await listen1()
+        await listen2()
+        await sw1.start(), await sw2.start()
+        peer = await sw2.dial_peer(addr1)
+        for _ in range(200):
+            if sw1.n_peers() == 1:
+                break
+            await asyncio.sleep(0.01)
+        mets = p2p_metrics()
+        assert mets.peers.value(node=sw2._m_node,
+                                direction="outbound") == 1
+        assert mets.peers.value(node=sw2._m_node,
+                                direction="inbound") == 0
+        assert mets.peers.value(node=sw1._m_node,
+                                direction="inbound") == 1
+        # handshake latency was observed on both sides
+        assert mets.handshake_seconds.count(
+            node=sw2._m_node, direction="outbound") >= 1
+        assert mets.handshake_seconds.count(
+            node=sw1._m_node, direction="inbound") >= 1
+
+        peer.send(EchoReactor.CHAN, b"ping:hello")
+        for _ in range(200):
+            if e2.received:
+                break
+            await asyncio.sleep(0.01)
+        # reactor dispatch counted on the receiving switch
+        assert mets.reactor_msgs.value(reactor="echo",
+                                       node=sw1._m_node) >= 1
+
+        # per-peer series appear after an explicit sampler flush
+        sw2.flush_peer_telemetry()
+        pl = peer_label(sw1.transport.node_key.id)
+        assert mets.peer_send_bytes.value(
+            node=sw2._m_node, peer=pl, channel="0x42") > 0
+        assert mets.peer_recv_bytes.value(
+            node=sw2._m_node, peer=pl, channel="0x42") > 0
+        # the same totals feed peer_snapshot / net_info
+        snap = sw2.peer_snapshot()
+        assert len(snap) == 1
+        chan = snap[0]["connection_status"]["channels"]["0x42"]
+        assert chan["sent_msgs"] >= 1 and chan["recv_msgs"] >= 1
+        assert snap[0]["gossip"]["useful_votes"] == 0
+        assert sw2.quietest_peer_recv_age_s() is not None
+
+        # on disconnect the peer's gauges are dropped, counters remain
+        mets.peer_queue_depth.set(1, node=sw2._m_node, peer=pl,
+                                  channel="0x42")
+        await sw2.stop_peer_gracefully(peer)
+        assert mets.peer_queue_depth.value(
+            node=sw2._m_node, peer=pl, channel="0x42") == 0.0
+        assert mets.peers.value(node=sw2._m_node, direction="outbound") == 0
+        assert sw2.quietest_peer_recv_age_s() is None
+        await sw1.stop(), await sw2.stop()
+        return True
+
+    assert run(main())
+
+
+def test_dial_failure_counted():
+    async def main():
+        from cometbft_tpu.p2p.metrics import p2p_metrics
+
+        sw, listen = _make_switch(secret=b"df1")
+        await listen()
+        await sw.start()
+        before = p2p_metrics().dial_failures.value(node=sw._m_node)
+        with pytest.raises(Exception):
+            await sw.dial_peer("127.0.0.1:1")     # nothing listens there
+        assert p2p_metrics().dial_failures.value(
+            node=sw._m_node) == before + 1
+        await sw.stop()
+        return True
+
+    assert run(main())
+
+
 def test_switch_persistent_reconnect():
     async def main():
         sw1, listen1 = _make_switch(secret=b"p1")

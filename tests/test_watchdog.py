@@ -1,0 +1,344 @@
+"""Liveness watchdog: stall detection, black-box incident bundles, the
+rate limit, WAL-tail capture, `/dump_incidents`, and the per-peer label
+budget.  Fast tests drive the watchdog synchronously against a stub node
+(check() needs no event loop); the live induced-stall test is tier-2
+with the other real-TCP net suites."""
+
+import asyncio
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from cometbft_tpu.node.watchdog import (BUNDLE_PREFIX, LivenessWatchdog,
+                                        list_incidents, load_incident,
+                                        wal_tail)
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------- stub node
+
+class _StubRS:
+    height, round = 7, 2
+
+    def step_name(self):
+        return "Prevote"
+
+
+class _StubConsensus:
+    """Looks enough like ConsensusState for the watchdog's read paths."""
+
+    def __init__(self, step_age=999.0, commit_age_s=999.0):
+        self.rs = _StubRS()
+        self.fatal_error = None
+        self.wal = None
+        self._task = object()            # "started"
+        self._step_age = step_age
+        self._now = 1_000_000 * 10**9
+        self._last_commit_wall_ns = self._now - int(commit_age_s * 1e9)
+
+    def step_age_s(self):
+        return self._step_age
+
+    def now_ns(self):
+        return self._now
+
+
+def _stub_node(tmp_path, step_age=999.0, peers_quiet_age=None):
+    switch = SimpleNamespace(
+        peers={"p1": object()} if peers_quiet_age is not None else {},
+        peer_snapshot=lambda: [{"node_id": "p1", "connection_status": {}}],
+        quietest_peer_recv_age_s=lambda: peers_quiet_age)
+    return SimpleNamespace(
+        name="stub",
+        consensus=_StubConsensus(step_age=step_age),
+        switch=switch,
+        block_store=SimpleNamespace(height=lambda: 7),
+    )
+
+
+def _watchdog(node, tmp_path, **kw):
+    kw.setdefault("stall_threshold_s", 1.0)
+    kw.setdefault("check_interval_s", 0.05)
+    kw.setdefault("min_interval_s", 60.0)
+    d = os.path.join(str(tmp_path), "incidents")
+    os.makedirs(d, exist_ok=True)
+    return LivenessWatchdog(node, d, **kw)
+
+
+def _bundles(wd):
+    return sorted(n for n in os.listdir(wd.incident_dir)
+                  if n.startswith(BUNDLE_PREFIX))
+
+
+# ----------------------------------------------------------- fast: trips
+
+def test_stall_trips_and_writes_bundle(tmp_path):
+    node = _stub_node(tmp_path, step_age=999.0, peers_quiet_age=500.0)
+    wd = _watchdog(node, tmp_path)
+    path = wd.check()
+    assert path is not None and os.path.exists(path)
+    bundle = json.loads(open(path).read())
+    assert "consensus_step_stalled" in bundle["reasons"]
+    assert "no_recent_commit" in bundle["reasons"]
+    assert "peers_quiet" in bundle["reasons"]
+    assert bundle["consensus"]["step"] == "Prevote"
+    assert bundle["consensus"]["step_age_s"] == 999.0
+    assert bundle["peers"] == [{"node_id": "p1", "connection_status": {}}]
+    assert bundle["height"] == 7
+    assert "records" in bundle["trace"]       # ring dump (may be empty)
+    assert bundle["wal_tail"] == []           # stub has no WAL
+    assert wd.trips == 1 and wd.bundles_written == 1
+
+
+def test_no_stall_is_a_noop(tmp_path):
+    node = _stub_node(tmp_path, step_age=0.01)
+    node.consensus._last_commit_wall_ns = node.consensus._now
+    wd = _watchdog(node, tmp_path)
+    assert wd.check() is None
+    assert wd.trips == 0 and _bundles(wd) == []
+
+
+def test_unstarted_consensus_never_trips(tmp_path):
+    """Blocksync/statesync phases park consensus legitimately: an
+    unstarted state machine (no _task) must not read as a stall."""
+    node = _stub_node(tmp_path, step_age=999.0)
+    node.consensus._task = None
+    wd = _watchdog(node, tmp_path)
+    assert wd.check() is None
+    assert wd.trips == 0
+
+
+def test_fatal_error_is_a_reason(tmp_path):
+    node = _stub_node(tmp_path, step_age=0.01)
+    node.consensus._last_commit_wall_ns = node.consensus._now
+    node.consensus.fatal_error = RuntimeError("boom")
+    wd = _watchdog(node, tmp_path)
+    path = wd.check()
+    bundle = json.loads(open(path).read())
+    assert bundle["reasons"] == ["consensus_fatal_error"]
+    assert "boom" in bundle["consensus"]["fatal_error"]
+
+
+def test_rate_limit_suppresses_and_recovers(tmp_path):
+    node = _stub_node(tmp_path, step_age=999.0)
+    wd = _watchdog(node, tmp_path, min_interval_s=3600.0)
+    assert wd.check() is not None
+    for _ in range(5):                       # persisting stall, same hour
+        assert wd.check() is None
+    assert wd.trips == 6                     # every detection counted
+    assert wd.bundles_written == 1           # but one bundle
+    assert len(_bundles(wd)) == 1
+    wd._last_bundle_mono -= 3601             # the hour passes
+    assert wd.check() is not None
+    assert len(_bundles(wd)) == 2
+
+
+def test_bundle_pruning_keeps_newest(tmp_path):
+    node = _stub_node(tmp_path, step_age=999.0)
+    wd = _watchdog(node, tmp_path, min_interval_s=0.0, max_bundles=3)
+    paths = [wd.check() for _ in range(6)]
+    kept = _bundles(wd)
+    assert len(kept) == 3
+    assert os.path.basename(paths[-1]) in kept
+    assert os.path.basename(paths[0]) not in kept
+
+
+# --------------------------------------------------------- fast: wal tail
+
+def test_wal_tail_returns_newest_records(tmp_path):
+    from cometbft_tpu.consensus.wal import WAL
+
+    # tiny segments force rotation so the tail spans files
+    wal = WAL(os.path.join(str(tmp_path), "cs.wal"),
+              max_segment_bytes=2048)
+    for i in range(300):
+        wal.write({"seq": i, "pad": b"x" * 32})
+    tail = wal_tail(wal, 50)
+    assert [r["seq"] for r in tail] == list(range(250, 300))
+    assert tail[0]["pad"] == (b"x" * 32).hex()      # bytes -> hex
+    # limit larger than the log returns everything, in order
+    assert [r["seq"] for r in wal_tail(wal, 10_000)] == list(range(300))
+    assert wal_tail(wal, 0) == [] and wal_tail(None, 50) == []
+    wal.close()
+
+
+# ------------------------------------------------------ fast: listing/RPC
+
+def test_list_and_load_incidents(tmp_path):
+    node = _stub_node(tmp_path, step_age=999.0)
+    wd = _watchdog(node, tmp_path, min_interval_s=0.0)
+    p1 = wd.check()
+    p2 = wd.check()
+    listing = list_incidents(wd.incident_dir)
+    assert len(listing) == 2
+    assert listing[0]["name"] == os.path.basename(p2)   # newest first
+    assert listing[0]["size_bytes"] > 0
+    assert "consensus_step_stalled" in listing[0]["reasons"]
+    assert listing[0]["wall_time_ns"] is not None
+    loaded = load_incident(wd.incident_dir, listing[1]["name"])
+    assert loaded["reasons"] == json.loads(open(p1).read())["reasons"]
+    # RPC-reachable: path components and non-bundle names are refused
+    assert load_incident(wd.incident_dir, "../secrets.json") is None
+    assert load_incident(wd.incident_dir, "notabundle.json") is None
+    assert load_incident(wd.incident_dir, "incident-x.json") is None
+    assert list_incidents(os.path.join(str(tmp_path), "absent")) == []
+
+
+def test_incident_dir_resolution():
+    """No home + relative dir -> watchdog has nowhere safe to write and
+    resolves to None; absolute dirs always win."""
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node import Node
+
+    n = Node()
+    n.config = Config()
+    assert n.incident_dir() is None
+    n.home = "/tmp/home-x"
+    assert n.incident_dir() == "/tmp/home-x/data/incidents"
+    n.config.instrumentation.watchdog_incident_dir = "/var/incidents"
+    n.home = None
+    assert n.incident_dir() == "/var/incidents"
+
+
+# ------------------------------------------- fast: per-peer label budget
+
+def test_dup_vote_counter_labels_bounded_under_peer_churn():
+    """Satellite regression: the per-peer gossip-efficiency counters ride
+    the metric-level cardinality guard, so unbounded peer churn cannot
+    grow the registry past the peer label budget."""
+    from cometbft_tpu.consensus.reactor import (_dup_votes_metric,
+                                                _useful_votes_metric)
+    from cometbft_tpu.p2p.metrics import PEER_LABEL_BUDGET, peer_label
+
+    dup, useful = _dup_votes_metric(), _useful_votes_metric()
+    assert dup.max_label_sets == PEER_LABEL_BUDGET
+    assert useful.max_label_sets == PEER_LABEL_BUDGET
+    before_evictions = dup.evicted_total
+    for i in range(PEER_LABEL_BUDGET * 3):      # churn 3 budgets of peers
+        pid = f"{i:012d}" + "ab" * 14           # distinct 12-char prefixes
+        dup.bind(peer=peer_label(pid)).inc()
+        useful.inc(peer=peer_label(pid))
+    assert dup.label_sets() <= PEER_LABEL_BUDGET
+    assert useful.label_sets() <= PEER_LABEL_BUDGET
+    assert dup.evicted_total > before_evictions
+
+
+# --------------------------------------------------- tier-2: live 2-node
+
+@pytest.mark.slow
+def test_live_stall_produces_bundle_and_dump_incidents(tmp_path):
+    """Acceptance: an induced consensus stall on a live 2-node TCP net
+    (kill one of two equal-power validators -> no more 2/3) produces an
+    on-disk incident bundle within the configured threshold containing
+    step spans, the peer snapshot and the WAL tail — and the survivor's
+    `GET /dump_incidents` serves it."""
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.config import test_consensus_config as _tcc
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    async def main():
+        pvs = [MockPV.from_secret(b"wdnode%d" % i) for i in range(2)]
+        doc = GenesisDoc(chain_id="wd-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                     for pv in pvs])
+        nodes = []
+        for i, pv in enumerate(pvs):
+            cfg = Config(consensus=_tcc())
+            cfg.p2p.laddr = "tcp://127.0.0.1:0"
+            cfg.rpc.laddr = "tcp://127.0.0.1:0" if i == 0 else ""
+            cfg.instrumentation.tracing = True
+            cfg.instrumentation.watchdog_stall_threshold_s = 1.0
+            cfg.instrumentation.watchdog_check_interval_s = 0.2
+            cfg.instrumentation.watchdog_min_interval_s = 60.0
+            cfg.p2p.telemetry_flush_interval_s = 0.5
+            node = await Node.create(
+                doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+                node_key=NodeKey.from_secret(b"wk%d" % i),
+                home=os.path.join(str(tmp_path), f"n{i}"), name=f"wd{i}")
+            nodes.append(node)
+            await node.start()
+        try:
+            assert nodes[0].liveness_watchdog is not None
+            await nodes[0].dial_peer(nodes[1].listen_addr,
+                                     persistent=False)
+            # both validators needed for 2/3: reach a height together
+            for _ in range(600):
+                if all(n.height() >= 2 for n in nodes):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(n.height() >= 2 for n in nodes), "net never started"
+
+            # enriched /net_info while the peer is still up
+            cli = HTTPClient(*nodes[0].rpc_addr)
+            try:
+                ni = await cli.call("net_info")
+                assert ni["n_peers"] == 1
+                peer = ni["peers"][0]
+                conn = peer["connection_status"]
+                assert conn["recv_bytes_total"] > 0
+                assert "send_rate" in conn and "recv_rate" in conn
+                assert "last_rtt_s" in conn
+                vote_ch = conn["channels"]["vote"]
+                assert vote_ch["recv_msgs"] > 0
+                assert vote_ch["send_queue_capacity"] > 0
+                assert "send_queue" in vote_ch
+                assert "queue_full_drops" in vote_ch
+                assert "useful_votes" in peer["gossip"]
+
+                # induce the stall: the other validator dies
+                await nodes[1].stop()
+                incident_dir = nodes[0].incident_dir()
+                deadline = asyncio.get_running_loop().time() + 30
+                bundle_names = []
+                while asyncio.get_running_loop().time() < deadline:
+                    if os.path.isdir(incident_dir):
+                        bundle_names = [
+                            n for n in os.listdir(incident_dir)
+                            if n.startswith(BUNDLE_PREFIX)
+                            and n.endswith(".json")]
+                        if bundle_names:
+                            break
+                    await asyncio.sleep(0.1)
+                assert bundle_names, "watchdog never wrote a bundle"
+
+                out = await cli.call("dump_incidents")
+                assert out["enabled"] and len(out["incidents"]) >= 1
+                name = out["incidents"][0]["name"]
+                full = await cli.call("dump_incidents", name=name)
+                bundle = full["bundle"]
+                assert any(r in ("consensus_step_stalled",
+                                 "no_recent_commit")
+                           for r in bundle["reasons"])
+                assert isinstance(bundle["peers"], list)
+                steps = [r for r in bundle["trace"]["records"]
+                         if r["sub"] == "consensus" and r["name"] == "step"]
+                assert steps, "bundle carries no consensus step spans"
+                assert bundle["wal_tail"], "bundle carries no WAL tail"
+                assert bundle["consensus"]["height"] >= 2
+            finally:
+                await cli.close()
+        finally:
+            for n in nodes:
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+        return True
+
+    assert run(main())
