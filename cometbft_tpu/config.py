@@ -99,6 +99,11 @@ class P2PConfig:
     fuzz_prob_drop_conn: float = 0.0
     fuzz_prob_sleep: float = 0.0
     fuzz_start_after_s: float = 10.0
+    # seed of the fuzzer's private random.Random — connection fuzzing is
+    # deterministic by default (same seed, same per-connection decision
+    # stream) and composes with [chaos] schedules (libs/failures sites
+    # p2p.fuzz.{drop,delay,kill} override these probabilities when armed)
+    fuzz_seed: int = 0
 
 
 @dataclass
@@ -233,6 +238,31 @@ class BaseConfig:
     # verified-signature LRU entries; 0 disables caching AND the gossip
     # prefetch that feeds it (coalescing still serves async callers)
     vote_sched_cache_size: int = 65536
+    # deadline on awaiting a scheduler verdict (seconds): past it the
+    # caller re-verifies directly instead of hanging on a future a
+    # failed dispatch can never resolve.  0 = auto: ~5x the coalescing
+    # window, floored at 1 s so a cold native-verifier build can't trip
+    # it on a healthy node
+    vote_sched_verify_timeout_s: float = 0.0
+
+
+@dataclass
+class ChaosConfig:
+    """Deterministic fault injection (libs/failures).  Off by default;
+    when enabled, every armed site's schedule is a pure function of
+    ``seed`` and the site's own call index, and fired faults land in a
+    bounded in-memory event log for same-seed replay assertions.  The
+    ``CMT_CHAOS`` env var overrides this section (chaos harnesses arm
+    subprocess nodes without editing config files)."""
+
+    enable: bool = False
+    # master seed; per-site RNGs derive from "{seed}:{site}"
+    seed: int = 0
+    # fault spec strings, "site:key=value:...", e.g.
+    #   "wal.fsync.eio:at=40", "p2p.recv.corrupt:prob=0.02:max=20"
+    faults: list[str] = field(default_factory=list)
+    # bounded fault event log capacity
+    log_size: int = 8192
 
 
 @dataclass
@@ -248,6 +278,7 @@ class Config:
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
         default_factory=InstrumentationConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     # ------------------------------------------------------- TOML persistence
     # (reference: config/toml.go — viper-loaded config.toml; here the file
@@ -260,7 +291,7 @@ class Config:
         lines = ["# cometbft_tpu node configuration", ""]
         for section_name in ("base", "consensus", "mempool", "p2p", "rpc",
                              "blocksync", "statesync", "storage", "tx_index",
-                             "instrumentation"):
+                             "instrumentation", "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f_ in dataclasses.fields(section):
@@ -361,6 +392,20 @@ class Config:
         if self.p2p.fuzz_mode not in ("drop", "delay"):
             raise ConfigError(f"p2p.fuzz_mode must be drop|delay, "
                               f"got {self.p2p.fuzz_mode!r}")
+        if self.base.vote_sched_verify_timeout_s < 0:
+            raise ConfigError(
+                "base.vote_sched_verify_timeout_s must be >= 0")
+        if self.chaos.log_size < 16:
+            raise ConfigError("chaos.log_size must be >= 16")
+        if self.chaos.enable:
+            from .libs.failures import FaultSpecError, parse_fault_spec
+
+            for spec in self.chaos.faults:
+                try:
+                    parse_fault_spec(spec)
+                except FaultSpecError as e:
+                    raise ConfigError(f"bad chaos.faults entry: {e}") \
+                        from None
 
 
 class ConfigError(Exception):

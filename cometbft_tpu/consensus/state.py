@@ -19,6 +19,7 @@ proposal timeliness, and ABCI 2.0 vote extensions on precommits.
 from __future__ import annotations
 
 import asyncio
+import errno
 import time
 from typing import Callable
 
@@ -169,7 +170,23 @@ class ConsensusState:
             # once per height on the first admitted tx (the reference
             # subscribes to mempool.TxsAvailable())
             mp.on_txs_available = self.notify_txs_available
-        self._schedule_round0_now()
+        if STEP_PROPOSE <= self.rs.step <= STEP_PRECOMMIT_WAIT:
+            # Replay ended MID-ROUND (a crash between the round's first
+            # WAL record and its commit — the wal.fsync.eio chaos site
+            # exposes this): own votes for this round may never have
+            # been signed, replay never signs, and the NewHeight
+            # timeout below would be discarded by the step guard — a
+            # lone validator would wedge forever.  Re-enter the round
+            # machinery LIVE through the precommit-wait path: it
+            # advances to round+1, where nothing was ever signed (the
+            # priv validator's last-sign state still guards round r
+            # itself), so the node re-proposes/re-votes freshly instead
+            # of waiting for gossip that a solo or fully-restarted net
+            # can never produce.
+            self.ticker.schedule(TimeoutInfo(
+                1, self.rs.height, self.rs.round, STEP_PRECOMMIT_WAIT))
+        else:
+            self._schedule_round0_now()
 
     async def stop(self) -> None:
         self.ticker.stop()
@@ -184,7 +201,17 @@ class ConsensusState:
                 pass
             self._task = None
         if self.wal is not None:
-            self.wal.flush_and_sync()
+            try:
+                self.wal.flush_and_sync()
+            except Exception as e:
+                # a dead WAL (fsyncgate halt) must not wedge stop(), but
+                # a FIRST failure on this final flush is news: record it
+                # loudly — buffered records the node acknowledged may
+                # never have become durable
+                if self.fatal_error is None:
+                    self.fatal_error = e
+                self.log.error("final WAL flush failed at stop",
+                               err=repr(e))
         # close the open step span so the flight recorder shows the
         # final step of a stopped node instead of dropping it
         tracing.finish(self._step_span, stopped=True)
@@ -310,6 +337,34 @@ class ConsensusState:
     # deterministic bug must not become a silent infinite error loop
     MAX_CONSECUTIVE_ERRORS = 16
 
+    # OSError errnos that mean the STORAGE layer failed (fsyncgate
+    # class).  Deliberately narrow: ConnectionResetError/BrokenPipeError
+    # /TimeoutError are OSError subclasses too (a socket-ABCI app
+    # restarting mid-height must stay a recoverable handler error, not
+    # a permanent halt).
+    # no EBADF: a closed SOCKET can surface it too, and the WAL/LogDB
+    # dead-handle flags already make every follow-up storage op loud
+    _FATAL_IO_ERRNOS = frozenset(
+        getattr(errno, name) for name in
+        ("EIO", "ENOSPC", "EROFS", "EDQUOT", "ENXIO")
+        if hasattr(errno, name))
+
+    def _is_fatal_io_error(self, e: Exception) -> bool:
+        """True iff ``e`` is a WAL/storage IO failure (halt consensus)
+        rather than a transient handler error (count and continue).
+        Provenance first — a dead WAL handle is definitive — then the
+        storage errno class."""
+        from .wal import WALError
+
+        if isinstance(e, WALError):
+            return True
+        if isinstance(e, OSError):
+            if self.wal is not None and \
+                    getattr(self.wal, "_io_failed", None) is not None:
+                return True
+            return e.errno in self._FATAL_IO_ERRNOS
+        return False
+
     async def _receive_routine(self) -> None:
         """state.go:788 — the single writer."""
         consecutive_errors = 0
@@ -320,7 +375,21 @@ class ConsensusState:
                 consecutive_errors = 0
             except asyncio.CancelledError:
                 raise
-            except Exception as e:       # recoverable: log and continue
+            except Exception as e:
+                if self._is_fatal_io_error(e):
+                    # fsyncgate: a WAL/storage IO failure is IMMEDIATELY
+                    # fatal — durability of everything already
+                    # acknowledged is unknown, and retrying fsync on the
+                    # same fd can lie (the kernel dropped the dirty
+                    # pages with the first error).  Halt so the watchdog
+                    # bundles the evidence; recovery is a restart
+                    # replaying the intact prefix.
+                    self.fatal_error = e
+                    self.ticker.stop()
+                    self.log.error("HALT: consensus IO failure "
+                                   "(fsyncgate)", kind=kind, err=repr(e))
+                    return
+                # recoverable: log and continue
                 import traceback
 
                 self.log.error("consensus handler error", kind=kind,

@@ -16,6 +16,7 @@ checkpointing).  Torn tails are truncated on open."""
 
 from __future__ import annotations
 
+import errno
 import functools
 import os
 import struct
@@ -24,7 +25,7 @@ import zlib
 
 import msgpack
 
-from ..libs import tracing
+from ..libs import failures, tracing
 
 _HDR = struct.Struct("<II")
 MAX_BODY = 1 << 20            # 1 MB cap, like the reference's maxMsgSizeBytes
@@ -133,6 +134,12 @@ class WAL:
         # boundary (see prune note below).  Unknown after reopen -> prune
         # nothing until two sentinels have been written in this process.
         self._prev_sentinel_seg: str | None = None
+        # fsyncgate: once ANY write/fsync on this handle failed, the
+        # kernel may have dropped the dirty pages — a later fsync that
+        # "succeeds" on the same fd proves nothing.  The WAL goes dead
+        # (every further write/sync raises); recovery is a process
+        # restart reopening the file, which truncates the torn tail.
+        self._io_failed: Exception | None = None
 
     # ------------------------------------------------------------ segments
 
@@ -202,14 +209,51 @@ class WAL:
 
     # -------------------------------------------------------------- write
 
+    def _check_alive(self) -> None:
+        if self._io_failed is not None:
+            raise WALError(
+                "WAL is dead after an earlier IO failure (fsyncgate: "
+                "never retry on the same fd)") from self._io_failed
+
     def write(self, record: dict) -> None:
         t0 = time.perf_counter()
+        self._check_alive()
         body = msgpack.packb(record, use_bin_type=True)
         if len(body) > MAX_BODY:
             raise WALError(f"record too big: {len(body)}")
-        self._f.write(_HDR.pack(zlib.crc32(body), len(body)) + body)
+        rec = _HDR.pack(zlib.crc32(body), len(body)) + body
+        f = failures.fire("wal.write.torn")
+        if f is not None:
+            # a torn write IS a crash from the record's point of view:
+            # persist a seeded prefix (mid-header or mid-body per the
+            # rule's cut= param), then fail the handle like the outage
+            # that tore it
+            self._io_failed = self._torn_write(rec, f)
+            raise WALError("chaos: torn WAL write") from self._io_failed
+        try:
+            self._f.write(rec)
+        except OSError as e:
+            self._io_failed = e
+            raise
         self._maybe_rotate()
         _wal_metrics()[0].observe(time.perf_counter() - t0)
+
+    def _torn_write(self, rec: bytes, rule: dict) -> Exception:
+        """Persist a strict prefix of ``rec`` (the chaos analogue of
+        power loss mid-append).  ``cut=header`` tears inside the 8-byte
+        crc|len header, ``cut=body`` after a whole header; default draws
+        anywhere in the record."""
+        rng = failures.site_rng("wal.write.torn")
+        cut = rule.get("cut")
+        if cut == "header":
+            keep = rng.randrange(1, _HDR.size)
+        elif cut == "body":
+            keep = _HDR.size + rng.randrange(0, max(len(rec) - _HDR.size, 1))
+        else:
+            keep = rng.randrange(1, len(rec))
+        self._f.write(rec[:keep])
+        self._f.flush()
+        return OSError(errno.EIO, "chaos: write torn mid-record")
 
     def write_sync(self, record: dict) -> None:
         self.write(record)
@@ -227,8 +271,21 @@ class WAL:
 
     def flush_and_sync(self) -> None:
         t0 = time.perf_counter()
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self._check_alive()
+        try:
+            self._f.flush()
+            f = failures.fire("wal.fsync.eio")
+            if f is not None:
+                raise OSError(errno.EIO, "chaos: injected fsync EIO")
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            # fsyncgate semantics: an fsync failure is FATAL for this
+            # handle.  Linux drops the dirty pages after reporting the
+            # error, so retrying fsync on the same fd can "succeed"
+            # while the data never hit the platter — mark the WAL dead
+            # and let the caller halt consensus.
+            self._io_failed = e
+            raise
         dt = time.perf_counter() - t0
         _wal_metrics()[1].observe(dt)
         tracing.event("wal", "fsync", path=self._cur_path,

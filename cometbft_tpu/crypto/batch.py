@@ -796,6 +796,8 @@ def _device_call(fn):
     global _DEVICE_POOL, _DEVICE_INFLIGHT, _DEGRADED_LOGGED
     import concurrent.futures as cf
 
+    from ..libs import failures
+
     gauge, abandoned = _device_health()
     if _DEVICE_POOL is None:
         _DEVICE_POOL = cf.ThreadPoolExecutor(
@@ -803,6 +805,24 @@ def _device_call(fn):
     if _DEVICE_INFLIGHT is not None and not _DEVICE_INFLIGHT.done():
         gauge.set(1)             # still wedged from an earlier abandonment
         return None
+    if failures.is_enabled():
+        # chaos sites wrap the dispatch ON the device-owner thread so
+        # the hang/raise exercises the real bounded-wait + host-fallback
+        # machinery (the only way to rehearse a wedged or dying
+        # accelerator on a CPU-only box)
+        f_hang = failures.fire("device.dispatch.hang")
+        f_raise = failures.fire("device.dispatch.raise")
+        if f_hang is not None or f_raise is not None:
+            inner = fn
+
+            def fn():
+                if f_hang is not None:
+                    time.sleep(float(f_hang.get("delay",
+                                                _DEVICE_WAIT_S + 1.0)))
+                if f_raise is not None:
+                    raise RuntimeError(
+                        "chaos: injected device dispatch failure")
+                return inner()
     fut = _DEVICE_POOL.submit(fn)
     _DEVICE_INFLIGHT = fut
     try:
@@ -818,6 +838,21 @@ def _device_call(fn):
                 "device dispatch abandoned after bounded wait; "
                 "verification falling back to host until the device "
                 "answers again", wait_s=_DEVICE_WAIT_S)
+        return None
+    except Exception as e:
+        # a dispatch that RAISES (driver crash, runtime error mid-kernel)
+        # degrades exactly like one that hangs: host fallback, visible
+        # on the same gauge/counter — never an exception on the
+        # consensus path
+        abandoned.inc()
+        gauge.set(1)
+        if not _DEGRADED_LOGGED:
+            _DEGRADED_LOGGED = True
+            from ..libs import log as _tmlog
+
+            _tmlog.logger("crypto").error(
+                "device dispatch raised; verification falling back to "
+                "host until the device answers again", err=repr(e))
         return None
     gauge.set(0)
     if _DEGRADED_LOGGED:

@@ -50,6 +50,7 @@ import asyncio
 import hashlib
 import time
 
+from ..libs import failures
 from ..libs import metrics
 from ..libs import tracing
 from ..libs.service import BaseService
@@ -182,11 +183,21 @@ class VerificationScheduler(BaseService):
 
     def __init__(self, backend: str = "auto", max_wait_ms: float = 2.0,
                  max_lanes: int = 256, cache_size: int = 65536,
+                 verify_timeout_s: float = 0.0,
                  name: str = "vote-sched"):
         super().__init__(name=name)
         self.backend = backend
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.max_lanes = snap_lane_cap(max_lanes)
+        # deadline on awaiting a verdict future: a fault between flush
+        # and demux must never hang a caller forever.  Default ~5x the
+        # coalescing window, floored at 1 s (a cold native-verifier
+        # build or a loaded box must not trip it); past the deadline the
+        # caller re-verifies directly — a correct verdict, minus the
+        # batching win.
+        self.verify_timeout_s = (float(verify_timeout_s)
+                                 if verify_timeout_s and verify_timeout_s > 0
+                                 else max(1.0, 5.0 * self.max_wait_s))
         self.cache = VerifiedSigCache(cache_size)
         self._pending: dict[tuple, _Request] = {}
         # dispatched but not yet demuxed: identical requests arriving
@@ -308,7 +319,22 @@ class VerificationScheduler(BaseService):
         if req.future is None:
             req.future = asyncio.get_running_loop().create_future()
         try:
-            ok = await req.future
+            # shield: one caller's deadline must not cancel the future
+            # its batchmates (and the demux loop) still share
+            ok = await asyncio.wait_for(asyncio.shield(req.future),
+                                        self.verify_timeout_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:       # deadline, or a poisoned future
+            # fall back OFF the event loop, and NOT on self._pool: the
+            # deadline usually means that single worker is wedged, and
+            # queueing behind it would just hang a second time
+            self.log.error("scheduler verdict overdue/failed; "
+                           "verifying directly", err=repr(e))
+            ok = bool(await asyncio.to_thread(
+                pub.verify_signature, msg, sig))
+            if ok:
+                self.cache.seed(key)
         finally:
             lat_h.observe(time.perf_counter() - t0)
         return ok
@@ -405,10 +431,21 @@ class VerificationScheduler(BaseService):
         try:
             oks = await loop.run_in_executor(
                 self._pool, self._verify_batch, batch)
-        except Exception as e:                    # infra failure, not a
-            self.log.error("batch dispatch failed; failing batch closed",
-                           err=repr(e))           # signature verdict
-            oks = [False] * len(batch)
+        except Exception as e:
+            # infra failure, not a signature verdict: every batchmate
+            # still deserves a REAL answer, so re-verify per item on the
+            # worker (no batch machinery, no chaos site on the recovery
+            # path).  Only if even that fails does the batch fail
+            # closed — False, never an unresolved future.
+            self.log.error("batch dispatch failed; re-verifying items "
+                           "directly", err=repr(e))
+            try:
+                oks = await loop.run_in_executor(
+                    self._pool, self._verify_items_direct, batch)
+            except Exception as e2:
+                self.log.error("per-item recovery failed; failing batch "
+                               "closed", err=repr(e2))
+                oks = [False] * len(batch)
         tracing.finish(sp, ok=sum(map(bool, oks)))
         for req, ok in zip(batch, oks):
             ok = bool(ok)
@@ -437,6 +474,10 @@ class VerificationScheduler(BaseService):
         the batch machinery — there is nothing to amortize."""
         from . import batch as cryptobatch
 
+        f = failures.fire("sched.dispatch.raise")
+        if f is not None:
+            raise RuntimeError("chaos: injected scheduler dispatch "
+                               "failure")
         if len(batch) == 1:
             r = batch[0]
             return [bool(r.pub.verify_signature(r.msg, r.sig))]
@@ -445,6 +486,13 @@ class VerificationScheduler(BaseService):
             bv.add(r.pub, r.msg, r.sig)
         _, oks = bv.verify()
         return oks
+
+    @staticmethod
+    def _verify_items_direct(batch: list[_Request]) -> list[bool]:
+        """Recovery path for a failed batch dispatch: one direct
+        verification per item, no batching, no injection sites."""
+        return [bool(r.pub.verify_signature(r.msg, r.sig))
+                for r in batch]
 
     # ------------------------------------------------------------- surface
 
@@ -504,7 +552,8 @@ def set_scheduler(sched: VerificationScheduler | None) -> None:
 
 
 async def acquire_scheduler(backend: str = "auto", max_wait_ms: float = 2.0,
-                            max_lanes: int = 256, cache_size: int = 65536
+                            max_lanes: int = 256, cache_size: int = 65536,
+                            verify_timeout_s: float = 0.0
                             ) -> VerificationScheduler:
     """Start (or share) the process-wide scheduler.  In-proc ensembles
     call this once per node: the first caller's knobs win — verdicts are
@@ -522,7 +571,7 @@ async def acquire_scheduler(backend: str = "auto", max_wait_ms: float = 2.0,
     if _GLOBAL is None:
         sched = VerificationScheduler(
             backend=backend, max_wait_ms=max_wait_ms, max_lanes=max_lanes,
-            cache_size=cache_size)
+            cache_size=cache_size, verify_timeout_s=verify_timeout_s)
         await sched.start()
         _GLOBAL = sched
     _REFS += 1

@@ -250,7 +250,8 @@ class Node:
                 prob_drop_rw=cfg.p2p.fuzz_prob_drop_rw,
                 prob_drop_conn=cfg.p2p.fuzz_prob_drop_conn,
                 prob_sleep=cfg.p2p.fuzz_prob_sleep,
-                start_after_s=cfg.p2p.fuzz_start_after_s)
+                start_after_s=cfg.p2p.fuzz_start_after_s,
+                seed=cfg.p2p.fuzz_seed)
         self.transport = Transport(self.node_key, self._node_info,
                                    fuzz_config=fuzz_cfg)
         self.switch = Switch(
@@ -367,6 +368,12 @@ class Node:
             _tracing.configure(
                 enabled=True,
                 ring_size=self.config.instrumentation.tracing_ring_size)
+        # arm the fault-injection plane before any subsystem runs its
+        # first instrumented operation (same process-wide/sticky
+        # discipline as tracing; CMT_CHAOS env overrides the section)
+        from ..libs import failures as _failures
+
+        _failures.configure_from_config(self.config.chaos)
         host, port = _parse_laddr(self.config.p2p.laddr) \
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)
@@ -428,7 +435,9 @@ class Node:
                 backend=self.config.base.signature_backend,
                 max_wait_ms=self.config.base.vote_sched_max_wait_ms,
                 max_lanes=self.config.base.vote_sched_max_lanes,
-                cache_size=self.config.base.vote_sched_cache_size)
+                cache_size=self.config.base.vote_sched_cache_size,
+                verify_timeout_s=(
+                    self.config.base.vote_sched_verify_timeout_s))
 
         def _warm_native():
             # build/load the C++ verifiers off the event loop so a fresh

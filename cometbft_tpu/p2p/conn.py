@@ -17,6 +17,7 @@ import time
 
 import msgpack
 
+from ..libs import failures
 from ..libs.flowrate import Monitor
 from .reactor import ChannelDescriptor
 from .secret_connection import SecretConnection
@@ -104,6 +105,9 @@ class MConnection:
         self._send_wakeup = asyncio.Event()
         self._pong_due: float | None = None
         self._pong_to_send = False
+        # one packet held back by the p2p.send.reorder fault site; None
+        # on every un-chaosed connection
+        self._chaos_held: dict | None = None
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         # --- telemetry (plain attrs; see telemetry()) -------------------
@@ -210,9 +214,12 @@ class MConnection:
                         ch.sending = ch.queue.get_nowait()
                         ch.sent_off = 0
                     chunk, eof = ch.next_packet()
-                    await self._write_packet(
-                        {"t": "m", "c": ch.desc.channel_id,
-                         "e": eof, "d": chunk})
+                    pkt = {"t": "m", "c": ch.desc.channel_id,
+                           "e": eof, "d": chunk}
+                    if failures.is_enabled():
+                        await self._chaos_send_packet(ch, pkt)
+                    else:
+                        await self._write_packet(pkt)
                     ch.recent += len(chunk)
                     ch.sent_bytes += len(chunk)
                     if eof:
@@ -223,6 +230,10 @@ class MConnection:
                     ch.recent *= 0.8
                 if not any(c.has_data() for c in self.channels.values()) \
                         and not self._pong_to_send:
+                    if self._chaos_held is not None:
+                        # an idle wire must not strand a reordered packet
+                        held, self._chaos_held = self._chaos_held, None
+                        await self._write_packet(held)
                     try:
                         await asyncio.wait_for(self._send_wakeup.wait(), 0.5)
                     except asyncio.TimeoutError:
@@ -231,6 +242,50 @@ class MConnection:
             raise
         except Exception as e:
             self._fail(e)
+
+    async def _chaos_send_packet(self, ch: _Channel, pkt: dict) -> None:
+        """Per-channel send-side fault sites (active only while the
+        fault plane is armed; the caller takes the zero-cost direct
+        write otherwise).  Semantics per packet, in order:
+
+        - ``p2p.send.drop`` — swallow it (the AEAD stream stays in sync
+          because the frame is never encrypted, but the peer's message
+          re-assembly sees a hole: a multi-packet message decodes
+          corrupt, a single-packet message silently vanishes),
+        - ``p2p.send.corrupt`` — flip one seeded bit of the payload
+          (arrives authenticated, decodes garbage — message-level
+          corruption, the class ``p2p/fuzz.py`` cannot produce),
+        - ``p2p.send.delay`` — sleep ``delay`` (default 50 ms) before
+          the write,
+        - ``p2p.send.reorder`` — hold the packet and release it after
+          the next one (or at wire idle),
+        - ``p2p.send.duplicate`` — write it twice.
+
+        Accounting in the caller proceeds regardless: the node believes
+        it sent, which is exactly the telemetry skew a real lossy link
+        produces."""
+        name = ch.display_name
+        if failures.fire("p2p.send.drop", chan=name) is not None:
+            return
+        f = failures.fire("p2p.send.corrupt", chan=name)
+        if f is not None and pkt["d"]:
+            data = bytearray(pkt["d"])
+            rng = failures.site_rng("p2p.send.corrupt")
+            data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
+            pkt = dict(pkt, d=bytes(data))
+        f = failures.fire("p2p.send.delay", chan=name)
+        if f is not None:
+            await asyncio.sleep(float(f.get("delay", 0.05)))
+        f = failures.fire("p2p.send.reorder", chan=name)
+        if f is not None and self._chaos_held is None:
+            self._chaos_held = pkt      # released after the NEXT packet
+            return
+        await self._write_packet(pkt)
+        if failures.fire("p2p.send.duplicate", chan=name) is not None:
+            await self._write_packet(pkt)
+        if self._chaos_held is not None:
+            held, self._chaos_held = self._chaos_held, None
+            await self._write_packet(held)
 
     async def _write_packet(self, packet: dict) -> None:
         raw = msgpack.packb(packet, use_bin_type=True)
@@ -298,6 +353,21 @@ class MConnection:
             ch.recv_buf.clear()
             ch.recv_msgs += 1
             self.last_msg_recv_mono = time.monotonic()
+            if failures.is_enabled():
+                # receive-side faults operate on COMPLETE messages (the
+                # unit the reactor sees): drop it, or flip one seeded
+                # bit so the codec/handler rejects it downstream
+                if failures.fire("p2p.recv.drop",
+                                 chan=ch.display_name) is not None:
+                    return
+                f = failures.fire("p2p.recv.corrupt",
+                                  chan=ch.display_name)
+                if f is not None and msg:
+                    data = bytearray(msg)
+                    rng = failures.site_rng("p2p.recv.corrupt")
+                    data[rng.randrange(len(data))] ^= \
+                        1 << rng.randrange(8)
+                    msg = bytes(data)
             if self.emulated_latency > 0:
                 # equal delays preserve delivery order (asyncio timer
                 # heap breaks ties by schedule sequence)

@@ -7,13 +7,23 @@ Dropping an *encrypted frame* write desynchronizes the AEAD nonce
 sequence, so the peer's next decrypt fails and the connection tears down
 through the real error path — exactly the class of fault the production
 stack must absorb (switch reconnect with backoff, mempool/consensus
-gossip resume)."""
+gossip resume).
+
+Determinism: decisions come from an injected ``random.Random(seed)``
+(config knob ``p2p.fuzz_seed``), never the module-global ``random`` —
+same seed, same per-connection decision stream.  When the fault plane
+(``libs/failures``) is armed, the sites ``p2p.fuzz.drop`` /
+``p2p.fuzz.delay`` / ``p2p.fuzz.kill`` take precedence over the local
+probabilities, so connection fuzzing composes with (and is recorded in
+the event log of) seeded chaos schedules."""
 
 from __future__ import annotations
 
 import asyncio
 import random
 import time
+
+from ..libs import failures
 
 MODE_DROP = "drop"
 MODE_DELAY = "delay"
@@ -29,7 +39,7 @@ class FuzzConnConfig:
                  prob_drop_conn: float = 0.0,
                  prob_sleep: float = 0.0,
                  start_after_s: float = 0.0,
-                 seed: int | None = None):
+                 seed: int = 0):
         self.mode = mode
         self.max_delay_s = max_delay_s
         self.prob_drop_rw = prob_drop_rw
@@ -53,6 +63,21 @@ class _Fuzzer:
         if not self._active():
             return False
         cfg = self.cfg
+        if failures.is_enabled():
+            # chaos-schedule override: an armed p2p.fuzz.* site decides
+            # (and logs) instead of the local probability draw
+            if failures.fire("p2p.fuzz.kill") is not None:
+                self.writer.close()
+                return True
+            if failures.fire("p2p.fuzz.drop") is not None:
+                return True
+            f = failures.fire("p2p.fuzz.delay")
+            if f is not None:
+                await asyncio.sleep(float(f.get(
+                    "delay",
+                    failures.site_rng("p2p.fuzz.delay").random()
+                    * cfg.max_delay_s)))
+                return False
         if cfg.mode == MODE_DELAY:
             await asyncio.sleep(cfg.rng.random() * cfg.max_delay_s)
             return False

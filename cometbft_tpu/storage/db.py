@@ -9,10 +9,13 @@ engine replaces it behind this same interface).
 
 from __future__ import annotations
 
+import errno
 import os
 import struct
 import zlib
 from abc import ABC, abstractmethod
+
+from ..libs import failures
 
 
 class KVStore(ABC):
@@ -125,6 +128,12 @@ class LogDB(KVStore):
         self._data: dict[bytes, bytes] = {}
         self._live_bytes = 0
         self._log_bytes = 0
+        # same fsyncgate discipline as consensus/wal.py: after one
+        # write/fsync failure the handle is dead — the in-memory index
+        # may already be ahead of what durably landed, and a retried
+        # fsync on the same fd proves nothing.  Every further write
+        # raises; recovery is a restart replaying the intact log prefix.
+        self._io_failed: Exception | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._replay()
         self._f = open(path, "ab")
@@ -168,9 +177,25 @@ class LogDB(KVStore):
         self._append_raw(self._record(key, value))
 
     def _append_raw(self, rec: bytes):
-        self._f.write(rec)
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        if self._io_failed is not None:
+            raise OSError(
+                errno.EIO,
+                "LogDB is dead after an earlier IO failure (never retry "
+                "on the same fd)") from self._io_failed
+        try:
+            f = failures.fire("db.append.enospc")
+            if f is not None:
+                raise OSError(errno.ENOSPC,
+                              "chaos: injected ENOSPC on append")
+            self._f.write(rec)
+            self._f.flush()
+            f = failures.fire("db.fsync.eio")
+            if f is not None:
+                raise OSError(errno.EIO, "chaos: injected fsync EIO")
+            os.fsync(self._f.fileno())
+        except OSError as e:
+            self._io_failed = e
+            raise
         self._log_bytes += len(rec)
         if (self._log_bytes > 1 << 20
                 and self._log_bytes > 4 * max(self._live_bytes, 1)):

@@ -242,6 +242,71 @@ def test_clean_stop_resolves_inflight_requests():
     _run(main())
 
 
+def test_dispatch_failure_resolves_every_batchmate_with_real_verdicts():
+    """Regression via the ``sched.dispatch.raise`` chaos site: an
+    exception in the dispatch body must not hang or fail-closed the
+    batch — every future AND callback gets the per-item verdict from
+    the direct recovery pass."""
+    from cometbft_tpu.libs import failures as F
+
+    async def main():
+        F.configure(enabled=True, seed=1,
+                    faults=["sched.dispatch.raise:at=1"])
+        try:
+            s = VerificationScheduler(backend="cpu", max_wait_ms=1.0)
+            await s.start()
+            items = _signed(4)
+            bad = (items[2][0], items[2][1], b"\x00" * 64)
+            cb_verdicts = {}
+            s.submit_nowait(*bad, on_done=lambda ok: cb_verdicts
+                            .setdefault("bad", ok))
+            oks = await asyncio.wait_for(asyncio.gather(
+                *[s.verify(p, m, sig) for p, m, sig in items]), timeout=10)
+            assert oks == [True] * 4       # real verdicts, not fail-closed
+            assert cb_verdicts == {"bad": False}
+            # the injected failure is on record, and the NEXT batch rides
+            # the normal path again
+            assert [e["site"] for e in F.events()] == \
+                ["sched.dispatch.raise"]
+            assert await s.verify(*_signed(1, seed=9)[0])
+            await s.stop()
+        finally:
+            F.reset()
+
+    _run(main())
+
+
+def test_verify_deadline_falls_back_to_direct_verification():
+    """``verify()`` must never hang on a future nothing will resolve: a
+    wedged flush path (here: _flush stubbed out) trips the bounded wait
+    and the caller re-verifies directly — correct verdict, bounded
+    latency."""
+    async def main():
+        s = VerificationScheduler(backend="cpu", max_wait_ms=1.0,
+                                  verify_timeout_s=0.3)
+        assert s.verify_timeout_s == 0.3
+        await s.start()
+        s._flush = lambda reason: None       # nothing ever dispatches
+        pub, msg, sig = _signed(1)[0]
+        t0 = asyncio.get_event_loop().time()
+        ok = await asyncio.wait_for(s.verify(pub, msg, sig), timeout=5)
+        dt = asyncio.get_event_loop().time() - t0
+        assert ok and 0.25 <= dt < 2.0
+        # the direct fallback seeded the cache: the retry is a hit
+        assert s.cache.hit(cache_key(pub.bytes(), msg, sig))
+        del s._flush                          # let stop() flush cleanly
+        await s.stop()
+
+    _run(main())
+
+
+def test_verify_timeout_default_floors_at_one_second():
+    s = VerificationScheduler(backend="cpu", max_wait_ms=2.0)
+    assert s.verify_timeout_s == 1.0         # 5x window, floored
+    s2 = VerificationScheduler(backend="cpu", max_wait_ms=500.0)
+    assert s2.verify_timeout_s == 2.5        # 5x window above the floor
+
+
 # ------------------------------------------------------ VoteSet integration
 
 def _valset(n):

@@ -126,6 +126,116 @@ def test_wal_torn_tail_truncated_on_reopen(tmp_path):
     wal2.close()
 
 
+def _tear_next_write(path, spec, record, **wal_kw):
+    """Arm the ``wal.write.torn`` chaos site, write one record (which
+    tears), and return the reopened WAL."""
+    from cometbft_tpu.consensus.wal import WAL, WALError
+    from cometbft_tpu.libs import failures as F
+
+    wal = WAL(path, **wal_kw)
+    F.configure(enabled=True, seed=13, faults=[spec])
+    try:
+        with pytest.raises(WALError):
+            wal.write(record)
+        # fsyncgate: the torn handle is dead
+        with pytest.raises(WALError):
+            wal.write({"#": "vote", "n": -1})
+    finally:
+        F.reset()
+        try:
+            wal.close()
+        except OSError:
+            pass
+    return WAL(path, **wal_kw)
+
+
+@pytest.mark.parametrize("cut", ["header", "body"])
+def test_wal_torn_write_truncated_on_reopen(tmp_path, cut):
+    """Injected truncation mid-header and mid-record (wal.write.torn
+    site): reopen keeps every intact record, drops the torn tail, and
+    the WAL stays appendable."""
+    from cometbft_tpu.consensus.wal import WAL
+
+    path = str(tmp_path / "cs.wal")
+    wal = WAL(path)
+    for i in range(5):
+        wal.write_sync({"#": "vote", "n": i, "pad": "x" * 40})
+    wal.close()
+    size_before = os.path.getsize(path)
+
+    wal2 = _tear_next_write(path, f"wal.write.torn:at=1:cut={cut}",
+                            {"#": "vote", "n": 99, "pad": "y" * 40})
+    # the torn bytes hit the disk, but reopen truncated them: only the
+    # 5 intact records remain and the file is back to its clean length
+    recs = list(wal2.iter_records())
+    assert [r["n"] for r in recs] == [0, 1, 2, 3, 4]
+    assert os.path.getsize(path) == size_before
+    wal2.write_sync({"#": "vote", "n": 100})
+    assert [r["n"] for r in wal2.iter_records()][-1] == 100
+    wal2.close()
+
+
+def test_wal_torn_write_across_segment_boundary(tmp_path):
+    """A torn record in a freshly-rotated segment: reopen truncates ONLY
+    the new segment's tail; every earlier segment and the replay index
+    (records_after_height) stay intact."""
+    from cometbft_tpu.consensus.wal import WAL
+
+    path = str(tmp_path / "cs.wal")
+    wal = WAL(path, max_segment_bytes=1024)
+    for h in (1, 2):
+        for i in range(12):
+            wal.write({"#": "vote", "peer": "",
+                       "data": {"h": h, "i": i, "pad": "z" * 48}})
+        wal.write_sync({"#": "endheight", "h": h})
+        wal._prev_sentinel_seg = None       # keep every segment
+    wal.flush_and_sync()
+    segs = wal._segments()
+    assert len(segs) > 1, "no rotation happened"
+    wal.close()
+
+    wal2 = _tear_next_write(path, "wal.write.torn:at=1:cut=body",
+                            {"#": "vote", "peer": "", "data": {"h": 3}},
+                            max_segment_bytes=1024)
+    # replay after height 1 still yields exactly height 2's records,
+    # crossing the intact segment boundary; the torn record is gone
+    recs = wal2.records_after_height(1)
+    assert {r["data"]["h"] for r in recs if "data" in r} == {2}
+    assert wal2.records_after_height(2) == []
+    # the earlier segments were untouched by the truncation
+    assert wal2._segments()[:len(segs) - 1] == segs[:len(segs) - 1]
+    wal2.close()
+
+
+def test_wal_fsync_eio_site_kills_handle_not_file(tmp_path):
+    """``wal.fsync.eio``: the failing fsync raises OSError(EIO), every
+    later operation on the handle raises WALError (fsyncgate: no retry
+    on the same fd), and a fresh open replays everything that landed."""
+    import errno
+
+    from cometbft_tpu.consensus.wal import WAL, WALError
+    from cometbft_tpu.libs import failures as F
+
+    path = str(tmp_path / "cs.wal")
+    wal = WAL(path)
+    wal.write_sync({"#": "vote", "n": 1})
+    F.configure(enabled=True, seed=3, faults=["wal.fsync.eio:at=1"])
+    try:
+        with pytest.raises(OSError) as ei:
+            wal.write_sync({"#": "vote", "n": 2})
+        assert ei.value.errno == errno.EIO
+        for op in (lambda: wal.flush_and_sync(),
+                   lambda: wal.write({"#": "vote", "n": 3})):
+            with pytest.raises(WALError):
+                op()
+    finally:
+        F.reset()
+    wal2 = WAL(path)
+    # record 2's buffered write landed before the injected fsync failure
+    assert [r["n"] for r in wal2.iter_records()] == [1, 2]
+    wal2.close()
+
+
 def test_pruner_honors_min_of_app_and_companion(tmp_path):
     from cometbft_tpu.sm.pruner import Pruner
     from cometbft_tpu.storage import BlockStore, MemDB, StateStore
