@@ -61,7 +61,8 @@ class _Requester:
                                        REQUEST_TIMEOUT)
             except asyncio.TimeoutError:
                 # peer too slow: drop it (pool.go:153 timeout → RemovePeer)
-                self.pool.remove_peer(peer.id, reason="block request timeout")
+                self.pool.remove_peer(peer.id, reason="block request timeout",
+                                      event="block_timeout")
             finally:
                 peer.pending = max(0, peer.pending - 1)
             if self.block is not None and not self.redo.is_set():
@@ -112,7 +113,8 @@ class _Requester:
 class BlockPool:
     def __init__(self, start_height: int,
                  send_request: Callable[[str, int], None],
-                 on_peer_error: Callable[[str, str], None] = lambda p, r: None):
+                 on_peer_error: Callable[[str, str, str], None] =
+                 lambda p, r, e: None):
         self.height = start_height          # next height to consume
         self.send_request = send_request
         self.on_peer_error = on_peer_error
@@ -144,7 +146,12 @@ class BlockPool:
             p.base, p.height = base, height
         self.max_peer_height = max(self.max_peer_height, height)
 
-    def remove_peer(self, peer_id: str, reason: str = "") -> None:
+    def remove_peer(self, peer_id: str, reason: str = "",
+                    event: str | None = None) -> None:
+        """Drop a peer from the pool.  ``event`` names the misbehavior
+        to report upstream (``block_timeout`` / ``bad_block``); None
+        means the peer simply went away (switch-initiated removal) and
+        must NOT be scored."""
         p = self.peers.pop(peer_id, None)
         if p is None:
             return
@@ -155,7 +162,8 @@ class BlockPool:
         # (pool.go removePeer -> updateMaxPeerHeight)
         self.max_peer_height = max(
             (q.height for q in self.peers.values()), default=0)
-        self.on_peer_error(peer_id, reason)
+        if event is not None:
+            self.on_peer_error(peer_id, reason, event)
 
     def _pick_peer(self, height: int) -> _BsPeer | None:
         best = None
@@ -211,12 +219,15 @@ class BlockPool:
         self.height += 1
 
     def redo_request(self, height: int) -> str | None:
-        """Verification downstream failed: ban the peer that served this
-        height and refetch every block it delivered (pool.go RedoRequest)."""
+        """Verification downstream failed: penalize the peer that served
+        this height and refetch every block it delivered (pool.go
+        RedoRequest).  ``bad_block`` is the heaviest misbehavior event —
+        the peer-quality scorer bans on repetition."""
         r = self.requesters.get(height)
         bad_peer = r.peer_id if r is not None else None
         if bad_peer is not None:
-            self.remove_peer(bad_peer, reason=f"bad block at {height}")
+            self.remove_peer(bad_peer, reason=f"bad block at {height}",
+                             event="bad_block")
         elif r is not None:
             r.refetch()
         return bad_peer

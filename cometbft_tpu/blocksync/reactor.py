@@ -145,8 +145,15 @@ class BlocksyncReactor(Reactor):
         if peer is not None:
             peer.send(BLOCKSYNC_CHANNEL, _pack("breq", h=height))
 
-    def _on_pool_peer_error(self, peer_id: str, reason: str) -> None:
+    def _on_pool_peer_error(self, peer_id: str, reason: str,
+                            event: str = "block_timeout") -> None:
         if self.switch is None:
+            return
+        if hasattr(self.switch, "report_peer"):
+            # score the typed event (bad_block bans on repetition) AND
+            # drop the peer — the pool already decided it must go
+            self.switch.report_peer(peer_id, event, detail=reason,
+                                    disconnect=True)
             return
         peer = self.switch.peers.get(peer_id)
         if peer is not None:

@@ -104,6 +104,19 @@ class P2PConfig:
     # stream) and composes with [chaos] schedules (libs/failures sites
     # p2p.fuzz.{drop,delay,kill} override these probabilities when armed)
     fuzz_seed: int = 0
+    # --- peer quality / reputation (p2p/quality.py) -------------------
+    # every layer reports typed, severity-weighted misbehavior events
+    # into one decaying per-peer score; crossing quality_disconnect_score
+    # drops the peer, crossing quality_ban_score issues a TIMED addrbook
+    # ban (TTL doubling per repeat offense up to the max).  Persistent
+    # peers are exempt from bans (scored + disconnected + re-dialed).
+    quality_enable: bool = True
+    quality_disconnect_score: float = 5.0
+    quality_ban_score: float = 10.0
+    # score half-life: an offense loses half its weight every this long
+    quality_half_life_s: float = 120.0
+    quality_ban_ttl_s: float = 60.0
+    quality_ban_ttl_max_s: float = 3600.0
 
 
 @dataclass
@@ -132,6 +145,15 @@ class RPCConfig:
     # measures the lag; the watchdog must be enabled via
     # instrumentation.loop_stall_threshold_s)
     overload_shed_lag_s: float = 2.0
+    # --- admission gate (rpc/server.py) -------------------------------
+    # at most this many request handlers run concurrently; up to
+    # max_queued_requests more wait; past that the server sheds with
+    # HTTP 503 + Retry-After (rpc_requests_shed_total counts them).
+    # Diagnostic routes (/status, /net_info, /health, /dump_*) bypass
+    # the gate so an overloaded node stays debuggable.
+    max_concurrent_requests: int = 64
+    max_queued_requests: int = 256
+    shed_retry_after_s: float = 1.0
 
 
 @dataclass
@@ -378,6 +400,26 @@ class Config:
         if self.p2p.telemetry_flush_interval_s < 0:
             raise ConfigError(
                 "p2p.telemetry_flush_interval_s must be >= 0")
+        if self.p2p.quality_disconnect_score <= 0 or \
+                self.p2p.quality_ban_score <= 0:
+            raise ConfigError(
+                "p2p.quality_{disconnect,ban}_score must be positive")
+        if self.p2p.quality_ban_score < self.p2p.quality_disconnect_score:
+            raise ConfigError(
+                "p2p.quality_ban_score must be >= quality_disconnect_score")
+        if self.p2p.quality_half_life_s <= 0:
+            raise ConfigError("p2p.quality_half_life_s must be positive")
+        if self.p2p.quality_ban_ttl_s <= 0 or \
+                self.p2p.quality_ban_ttl_max_s < self.p2p.quality_ban_ttl_s:
+            raise ConfigError(
+                "p2p.quality_ban_ttl_s must be positive and <= "
+                "quality_ban_ttl_max_s")
+        if self.rpc.max_concurrent_requests < 1:
+            raise ConfigError("rpc.max_concurrent_requests must be >= 1")
+        if self.rpc.max_queued_requests < 0:
+            raise ConfigError("rpc.max_queued_requests must be >= 0")
+        if self.rpc.shed_retry_after_s < 0:
+            raise ConfigError("rpc.shed_retry_after_s must be >= 0")
         if self.storage.db_backend not in ("logdb", "native", "memdb"):
             raise ConfigError(
                 f"storage.db_backend must be logdb|native|memdb, "

@@ -217,6 +217,7 @@ class ConsensusReactor(Reactor):
         cs.on_round_step = self._broadcast_new_round_step
         cs.on_vote_added = self._broadcast_has_vote
         cs.on_valid_block = self._broadcast_new_valid_block
+        cs.on_peer_misbehavior = self._on_peer_misbehavior
 
     def get_channels(self):
         return [
@@ -257,6 +258,28 @@ class ConsensusReactor(Reactor):
     def remove_peer(self, peer, reason=None) -> None:
         for task in self._peer_tasks.pop(peer.id, []):
             task.cancel()
+
+    _MISBEHAVIOR_EVENTS = {"vote": "invalid_vote", "part": "invalid_part",
+                           "proposal": "invalid_proposal"}
+
+    def _on_peer_misbehavior(self, peer_id: str, kind: str,
+                             exc: Exception) -> None:
+        """A peer-fed consensus message made its handler raise.  Only
+        VALIDATION failures (bad vote/proposal signature, part with a
+        bad merkle proof) are the sender's fault — a quorum-completing
+        vote runs commit + ABCI inline, and a flapping app's
+        ConnectionResetError must not blame whichever honest peer's
+        vote happened to land last."""
+        sw = self.switch
+        if sw is None or not hasattr(sw, "report_peer"):
+            return
+        from ..types.part_set import PartSetError
+        from ..types.vote_set import VoteSetError
+
+        if not isinstance(exc, (VoteSetError, PartSetError)):
+            return
+        event = self._MISBEHAVIOR_EVENTS.get(kind, "protocol_error")
+        sw.report_peer(peer_id, event, detail=f"{kind}: {exc!r}"[:160])
 
     async def stop(self) -> None:
         for tasks in self._peer_tasks.values():

@@ -104,6 +104,12 @@ class ConsensusState:
         self.broadcast_vote: Callable[[Vote], None] = lambda v: None
         self.on_conflicting_vote: Callable[[Vote, Vote], None] = \
             lambda a, b: None
+        # fired when a PEER-fed message made a handler raise a
+        # recoverable error (bad vote signature, malformed part, ...) —
+        # the reactor maps (peer_id, kind, exc) onto the p2p peer-quality
+        # scorer; default no-op keeps harness/test construction light
+        self.on_peer_misbehavior: Callable[[str, str, Exception], None] = \
+            lambda pid, kind, exc: None
         # reactor hooks: round-step transitions + votes added to our sets
         self.on_round_step: Callable[[], None] = lambda: None
         self.on_vote_added: Callable[[Vote], None] = lambda v: None
@@ -396,6 +402,14 @@ class ConsensusState:
                                err=repr(e),
                                trace=traceback.format_exc(limit=4))
                 self.m_errors.inc()
+                if peer:
+                    # the offending message came off the wire: let the
+                    # reactor feed the peer-quality scorer (never let a
+                    # scoring bug escalate a recoverable handler error)
+                    try:
+                        self.on_peer_misbehavior(peer, kind, e)
+                    except Exception:
+                        pass
                 consecutive_errors += 1
                 if consecutive_errors >= self.MAX_CONSECUTIVE_ERRORS:
                     # fatal: stop processing so the failure is observable

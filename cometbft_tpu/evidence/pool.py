@@ -17,6 +17,7 @@ from ..abci.types import Misbehavior
 from ..storage.db import KVStore, MemDB
 from ..types import codec
 from ..types.evidence import (DuplicateVoteEvidence, Evidence, EvidenceError,
+                              EvidenceNotApplicableError,
                               LightClientAttackEvidence)
 from ..types.vote import Vote
 from .verify import verify_evidence
@@ -53,7 +54,8 @@ class EvidencePool:
         if self.is_pending(ev) or self.is_committed(ev):
             return False
         if self.state is None or self.state_store is None:
-            raise EvidenceError("evidence pool has no state yet")
+            raise EvidenceNotApplicableError(
+                "evidence pool has no state yet")
         verify_evidence(ev, self.state, self.state_store,
                         backend=self.backend, block_store=self.block_store)
         self.db.set(_key(K_PENDING, ev), codec.pack(ev))

@@ -14,7 +14,7 @@ import msgpack
 from ..libs import aio
 
 from ..types import codec
-from ..types.evidence import EvidenceError
+from ..types.evidence import EvidenceError, EvidenceNotApplicableError
 from ..p2p.reactor import ChannelDescriptor, Reactor
 from .pool import EvidencePool
 
@@ -42,10 +42,23 @@ class EvidenceReactor(Reactor):
             return
         try:
             self.pool.add_evidence(codec.unpack(d["e"]))
-        except EvidenceError:
+        except EvidenceNotApplicableError:
+            # evidence we can't currently judge (expired, below our
+            # block base, no state yet): drop it WITHOUT punishing — a
+            # freshly statesync'd node must not ban honest peers
+            # re-gossiping legitimate pending evidence
+            return
+        except EvidenceError as e:
             # invalid gossiped evidence: drop the peer (reactor.go Receive
-            # punishes the sender)
-            if self.switch is not None:
+            # punishes the sender) and score it heavily — fabricated
+            # evidence is a deliberate act, repetition earns a timed ban
+            if self.switch is None:
+                return
+            if hasattr(self.switch, "report_peer"):
+                self.switch.report_peer(peer.id, "bad_evidence",
+                                        detail=repr(e)[:120],
+                                        disconnect=True)
+            else:
                 aio.spawn(self.switch.stop_peer_for_error(
                     peer, "invalid evidence"))
 

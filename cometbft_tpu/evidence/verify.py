@@ -15,6 +15,7 @@ from __future__ import annotations
 from fractions import Fraction
 
 from ..types.evidence import (DuplicateVoteEvidence, Evidence, EvidenceError,
+                              EvidenceNotApplicableError,
                               LightClientAttackEvidence)
 from ..types.validation import VerifyCommitLightTrustingAllSignatures
 
@@ -36,7 +37,9 @@ def verify_evidence(ev: Evidence, state, state_store,
     if block_store is not None:
         blk = block_store.load_block(ev.height())
         if blk is None:
-            raise EvidenceError(
+            # not necessarily malicious: a statesync'd node has no
+            # blocks below its snapshot base
+            raise EvidenceNotApplicableError(
                 f"no committed block at evidence height {ev.height()}")
         if ev_time != blk.header.time_ns:
             raise EvidenceError(
@@ -49,7 +52,8 @@ def verify_evidence(ev: Evidence, state, state_store,
     age_ns = state.last_block_time_ns - ev_time
     if age_blocks > ev_params.max_age_num_blocks and \
             age_ns > ev_params.max_age_duration_ns:
-        raise EvidenceError(
+        # expiry race with the sender's pruning, not malice
+        raise EvidenceNotApplicableError(
             f"evidence from height {ev.height()} is too old "
             f"({age_blocks} blocks, {age_ns} ns)")
 
@@ -66,7 +70,9 @@ def _verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
     """verify.go:164 VerifyDuplicateVote."""
     vals = state_store.load_validators(ev.height())
     if vals is None:
-        raise EvidenceError(f"no validator set at height {ev.height()}")
+        # pruned / statesync'd history: we cannot judge, so don't blame
+        raise EvidenceNotApplicableError(
+            f"no validator set at height {ev.height()}")
     idx, val = vals.get_by_address(ev.vote_a.validator_address)
     if idx < 0:
         raise EvidenceError("validator not in set at evidence height")
@@ -91,7 +97,7 @@ def _verify_light_client_attack(ev: LightClientAttackEvidence,
     check against the common-height set with 1/3 trust)."""
     common_vals = state_store.load_validators(ev.common_height)
     if common_vals is None:
-        raise EvidenceError(
+        raise EvidenceNotApplicableError(
             f"no validator set at common height {ev.common_height}")
     blk = ev.conflicting_block
     if blk is None:

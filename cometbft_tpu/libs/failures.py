@@ -59,7 +59,14 @@ _CONF_LOCK = threading.Lock()
 
 # rule keys with non-float values, everything else in a spec parses as
 # float (``prob=0.02``) with int-preservation (``at=40`` stays an int)
-_STR_KEYS = ("cut", "chan", "mode")
+_STR_KEYS = ("cut", "chan", "mode", "node")
+# str params that act as SELECTORS when present on a rule: the site
+# only counts/fires calls whose `detail` carries the same value, so
+# "p2p.send.corrupt:node=bad0:every=3" arms ONE node's links in an
+# in-proc ensemble and "chan=vote" one channel's packets.  Calls that
+# don't match don't advance the call index — the schedule is a pure
+# function of the MATCHING stream.
+_SELECTOR_KEYS = ("chan", "node")
 
 
 class FaultSpecError(ValueError):
@@ -84,7 +91,7 @@ class FaultRule:
     """
 
     __slots__ = ("site", "at", "count", "every", "prob", "after",
-                 "max_fires", "params", "calls", "fired")
+                 "max_fires", "params", "selectors", "calls", "fired")
 
     def __init__(self, site: str, at=None, count=None, every=None,
                  prob=None, after=0, max_fires=None, params=None):
@@ -96,6 +103,8 @@ class FaultRule:
         self.after = int(after)
         self.max_fires = max_fires
         self.params = params or {}
+        self.selectors = {k: v for k, v in self.params.items()
+                          if k in _SELECTOR_KEYS}
         self.calls = 0              # per-site call index (1-based)
         self.fired = 0
 
@@ -204,7 +213,14 @@ class ChaosPlane:
 
     def fire(self, site: str, **detail) -> "dict | None":
         rule = self.rules.get(site)
-        if rule is None or not rule.decide(self.site_rng(site)):
+        if rule is None:
+            return None
+        for k, v in rule.selectors.items():
+            # selector mismatch: not part of this rule's stream at all
+            # (the call index does not advance)
+            if detail.get(k) != v:
+                return None
+        if not rule.decide(self.site_rng(site)):
             return None
         self._seq += 1
         ev = dict(rule.params)

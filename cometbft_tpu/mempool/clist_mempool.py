@@ -38,6 +38,13 @@ class TxRejectedError(Exception):
         super().__init__(f"tx rejected: code={code} {log}")
 
 
+class MempoolFullError(TxRejectedError):
+    """Capacity rejection raised by the mempool itself (pool full).
+    Its own type so the gossip reactor can tell OUR backpressure apart
+    from an app rejection without parsing log strings the app
+    controls."""
+
+
 class _AdmissionGate:
     """Reader-writer gate for admission vs update.
 
@@ -152,7 +159,7 @@ class CListMempool(Mempool):
         if len(tx) > self.max_tx_bytes:
             raise TxRejectedError(1, "tx too large")
         if len(self._txs) >= self.max_txs:
-            raise TxRejectedError(1, "mempool is full")
+            raise MempoolFullError(1, "mempool is full")
         key = TxKey(tx)
         if not self.cache.push(key):
             return                       # seen before (maybe committed)
@@ -170,7 +177,7 @@ class CListMempool(Mempool):
                 raise TxRejectedError(res.code, res.log)
             if len(self._txs) >= self.max_txs:
                 self.cache.remove(key)   # full while we were in flight
-                raise TxRejectedError(1, "mempool is full")
+                raise MempoolFullError(1, "mempool is full")
             if key not in self._txs:
                 self._txs[key] = _MempoolTx(tx, res.gas_wanted,
                                             self.height, seq)

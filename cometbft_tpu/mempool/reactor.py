@@ -10,17 +10,29 @@ pipeline."""
 from __future__ import annotations
 
 import asyncio
+import functools
 
 from ..libs import aio
 
 import msgpack
 
 from ..p2p.reactor import ChannelDescriptor, Reactor
-from .clist_mempool import CListMempool, TxRejectedError
+from .clist_mempool import CListMempool, MempoolFullError, TxRejectedError
 from .mempool import TxKey
 
 MEMPOOL_CHANNEL = 0x30
 GOSSIP_SLEEP = 0.02
+
+
+@functools.cache
+def _full_skips_metric():
+    from ..libs import metrics as _m
+
+    return _m.counter(
+        "mempool_gossip_full_skips_total",
+        "gossiped txs dropped WITHOUT CheckTx because the mempool was "
+        "full (backpressure: a full pool must not buy every flooded tx "
+        "an app round-trip)")
 
 
 class MempoolReactor(Reactor):
@@ -32,6 +44,7 @@ class MempoolReactor(Reactor):
         self._peer_tasks: dict[str, asyncio.Task] = {}
         # tx hash -> set of peer ids that sent it to us (dedup/no-echo)
         self._senders: dict[bytes, set[str]] = {}
+        self._m_full_skips = _full_skips_metric()
 
     def get_channels(self):
         return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=5,
@@ -53,15 +66,30 @@ class MempoolReactor(Reactor):
 
     def receive(self, channel_id: int, peer, msg: bytes) -> None:
         d = msgpack.unpackb(msg, raw=False)
-        for tx in d.get("txs", []):
+        txs = d.get("txs", [])
+        if txs and self.mempool.size() >= self.mempool.max_txs:
+            # overload shedding: a full mempool drops gossiped txs at
+            # the door instead of spawning a CheckTx app round-trip per
+            # tx just to learn "mempool is full" (RPC submitters still
+            # get the explicit rejection)
+            self._m_full_skips.inc(len(txs),
+                                   node=getattr(self.mempool, "_m_node", ""))
+            return
+        for tx in txs:
             self._senders.setdefault(TxKey(tx), set()).add(peer.id)
-            aio.spawn(self._check_tx(tx))
+            aio.spawn(self._check_tx(tx, peer.id))
 
-    async def _check_tx(self, tx: bytes) -> None:
+    async def _check_tx(self, tx: bytes, peer_id: str = "") -> None:
         try:
             await self.mempool.check_tx(tx)
-        except TxRejectedError:
-            pass
+        except MempoolFullError:
+            pass        # our capacity problem, not the sender's
+        except TxRejectedError as e:
+            # app-rejected gossip is (feather-weight) peer misbehavior
+            if peer_id and self.switch is not None and \
+                    hasattr(self.switch, "report_peer"):
+                self.switch.report_peer(peer_id, "invalid_tx",
+                                        detail=e.log[:80])
         except Exception:
             pass
 

@@ -254,10 +254,31 @@ class Node:
                 seed=cfg.p2p.fuzz_seed)
         self.transport = Transport(self.node_key, self._node_info,
                                    fuzz_config=fuzz_cfg)
+        # addrbook before the switch: the peer-quality scorer records
+        # its timed bans there (persisted across restarts); without pex
+        # the scorer keeps bans in-memory only
+        if cfg.p2p.pex:
+            book_path = None
+            if home is not None:
+                book_path = os.path.join(home, cfg.p2p.addr_book_path) \
+                    if not os.path.isabs(cfg.p2p.addr_book_path) \
+                    else cfg.p2p.addr_book_path
+            self.addr_book = AddrBook(book_path)
+        from ..p2p.quality import PeerScorer
+
+        scorer = PeerScorer(
+            addr_book=self.addr_book,
+            enabled=cfg.p2p.quality_enable,
+            disconnect_score=cfg.p2p.quality_disconnect_score,
+            ban_score=cfg.p2p.quality_ban_score,
+            half_life_s=cfg.p2p.quality_half_life_s,
+            ban_ttl_s=cfg.p2p.quality_ban_ttl_s,
+            ban_ttl_max_s=cfg.p2p.quality_ban_ttl_max_s)
         self.switch = Switch(
             self.transport,
             emulated_latency=cfg.p2p.emulated_latency_ms / 1e3,
-            telemetry_interval=cfg.p2p.telemetry_flush_interval_s)
+            telemetry_interval=cfg.p2p.telemetry_flush_interval_s,
+            scorer=scorer, chaos_scope=name)
         if cfg.tx_index.indexer == "kv":
             from ..indexer import BlockIndexer, IndexerService, TxIndexer
 
@@ -287,12 +308,6 @@ class Node:
         self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("statesync", self.statesync_reactor)
         if cfg.p2p.pex:
-            book_path = None
-            if home is not None:
-                book_path = os.path.join(home, cfg.p2p.addr_book_path) \
-                    if not os.path.isabs(cfg.p2p.addr_book_path) \
-                    else cfg.p2p.addr_book_path
-            self.addr_book = AddrBook(book_path)
             self.pex_reactor = PexReactor(
                 self.addr_book, self.node_key.id,
                 max_outbound=cfg.p2p.max_num_outbound_peers,

@@ -268,6 +268,10 @@ class LivenessWatchdog(BaseService):
                        if node.block_store is not None else None),
             "consensus": consensus,
             "peers": sw.peer_snapshot() if sw is not None else [],
+            "peer_quality": (sw.scorer.snapshot()
+                             if sw is not None
+                             and getattr(sw, "scorer", None) is not None
+                             else None),
             "trace": {
                 "enabled": tstats["enabled"],
                 "buffered": tstats["buffered"],

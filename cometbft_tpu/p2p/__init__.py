@@ -2,16 +2,18 @@
 
 Host-side networking is asyncio TCP (the consensus workload's device story
 is batching, not transport): an authenticated-encryption SecretConnection,
-an MConnection channel multiplexer, and a Switch owning peers + reactors.
+an MConnection channel multiplexer, and a Switch owning peers + reactors,
+with a node-wide peer-reputation scorer (quality.py) gating admission.
 """
 
 from .key import NodeKey
 from .pex import AddrBook, PexReactor
 from .node_info import NodeInfo
 from .peer import Peer
+from .quality import PeerScorer
 from .reactor import ChannelDescriptor, Reactor
 from .switch import Switch
 from .transport import Transport
 
 __all__ = ["NodeKey", "NodeInfo", "Peer", "ChannelDescriptor", "Reactor",
-           "Switch", "Transport", "AddrBook", "PexReactor"]
+           "Switch", "Transport", "AddrBook", "PexReactor", "PeerScorer"]

@@ -22,7 +22,8 @@ controls.  Defenses, mirroring the reference's design:
 - **Promotion / demotion.**  ``mark_good`` (successful handshake)
   promotes new -> old.  ``mark_attempt`` counts dial failures; entries
   past ``MAX_ATTEMPTS`` are dropped on the next overflow or pick.
-  ``mark_bad`` bans outright.
+  ``mark_bad`` issues a timed ban (TTL-expiring; the peer-quality
+  scorer escalates repeat offenders).
 
 The public surface (add/pick/sample/size/save/mark_*) is shared with the
 PEX reactor and the seed crawler.
@@ -45,6 +46,7 @@ BUCKETS_PER_SOURCE = 16     # distinct new-buckets one source can reach:
 #   single source, and the old tier entirely so
 MAX_ATTEMPTS = 5            # dial failures before an entry is droppable
 OLD_BIAS = 0.6              # chance pick() prefers the vetted tier
+DEFAULT_BAN_TTL_S = 3600.0  # mark_bad without an explicit TTL
 
 
 def _group(addr: str) -> str:
@@ -95,7 +97,10 @@ class AddrBook:
         self._old: list[dict[str, _Entry]] = [
             {} for _ in range(N_OLD_BUCKETS)]
         self._where: dict[str, tuple[str, int]] = {}   # id -> (tier, idx)
-        self._banned: set[str] = set()
+        # timed bans: id -> expiry (epoch seconds).  Bans used to be a
+        # forever-set; now they expire so a transient bad actor (or a
+        # node that restarted out of a corrupting state) is readmitted.
+        self._banned: dict[str, float] = {}
         if path and os.path.exists(path):
             self._load()
 
@@ -126,14 +131,32 @@ class AddrBook:
         except (OSError, json.JSONDecodeError):
             return
         self._salt = d.get("salt", self._salt)
-        self._banned = set(d.get("banned", []))
+        banned = d.get("banned", {})
+        if isinstance(banned, dict):
+            # current schema: {node_id: expiry}; expired entries drop,
+            # and an uncoercible expiry (hand-edited file) counts as
+            # expired rather than refusing to boot the node
+            now = time.time()
+            self._banned = {}
+            for nid, exp in banned.items():
+                try:
+                    exp = float(exp)
+                except (TypeError, ValueError):
+                    continue
+                if exp > now:
+                    self._banned[nid] = exp
+        else:
+            # legacy bare list (the forever-ban era): those bans carried
+            # no expiry, so treat them as already expired on load — a
+            # peer banned by an old build is readmitted, not doomed
+            self._banned = {}
         for tier, key in (("new", "new"), ("old", "old")):
             for ed in d.get(key, []):
                 e = _Entry.from_json(ed)
                 self._place(e, tier)
         # legacy flat format ({"addrs": {id: addr}}): import as new tier
         for nid, addr in d.get("addrs", {}).items():
-            if nid not in self._where and nid not in self._banned:
+            if nid not in self._where and not self.is_banned(nid):
                 self._place(_Entry(nid, addr, _group(addr)), "new")
 
     SAVE_INTERVAL_S = 10.0      # debounce for hot-path mutations: the
@@ -146,11 +169,13 @@ class AddrBook:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
+            now = time.time()
             json.dump({
                 "salt": self._salt,
                 "new": [e.to_json() for b in self._new for e in b.values()],
                 "old": [e.to_json() for b in self._old for e in b.values()],
-                "banned": sorted(self._banned),
+                "banned": {nid: exp for nid, exp in self._banned.items()
+                           if exp > now},
             }, f, indent=1)
         os.replace(tmp, self.path)
         self._last_save = time.time()
@@ -203,7 +228,7 @@ class AddrBook:
         address (we dialed it successfully — pex outbound path) replaces
         any entry and lands directly in the vetted tier, so a peer that
         moved updates cleanly."""
-        if not addr or node_id in self._banned:
+        if not addr or self.is_banned(node_id):
             return False
         import time as _time
 
@@ -263,11 +288,31 @@ class AddrBook:
             else:
                 self._drop(node_id)
 
-    def mark_bad(self, node_id: str) -> None:
-        """Ban and forget (addrbook MarkBad)."""
-        self._banned.add(node_id)
+    def mark_bad(self, node_id: str,
+                 ttl: float = DEFAULT_BAN_TTL_S) -> None:
+        """Timed ban and forget (addrbook MarkBad, but with a TTL — the
+        caller escalates repeat offenders; forever-bans are gone)."""
+        self._banned[node_id] = time.time() + ttl
         self._drop(node_id)
         self.save_debounced()
+
+    def is_banned(self, node_id: str) -> bool:
+        """Active-ban check; an expired ban is dropped on read so the
+        peer is readmitted without any sweeper."""
+        exp = self._banned.get(node_id)
+        if exp is None:
+            return False
+        if exp <= time.time():
+            self._banned.pop(node_id, None)
+            return False
+        return True
+
+    def banned(self) -> dict[str, float]:
+        """Active bans as {node_id: expiry-epoch-seconds}."""
+        now = time.time()
+        for nid in [n for n, exp in self._banned.items() if exp <= now]:
+            self._banned.pop(nid, None)
+        return dict(self._banned)
 
     # ------------------------------------------------------------ selection
 

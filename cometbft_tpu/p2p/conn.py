@@ -39,6 +39,12 @@ class PongTimeoutError(MConnectionError):
     protocol/transport errors."""
 
 
+class ConnectionLostError(MConnectionError):
+    """The underlying transport died (reset/EOF/OS error) — its own
+    type so the Switch's misbehavior classifier never scores a plain
+    network failure as peer misbehavior."""
+
+
 class _Channel:
     def __init__(self, desc: ChannelDescriptor):
         self.desc = desc
@@ -108,6 +114,10 @@ class MConnection:
         # one packet held back by the p2p.send.reorder fault site; None
         # on every un-chaosed connection
         self._chaos_held: dict | None = None
+        # fault-site selector scope (the Switch stamps its node name so
+        # a [chaos] spec with node=<name> arms ONE node's links in an
+        # in-proc ensemble; empty matches only selector-less rules)
+        self.chaos_scope = ""
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         # --- telemetry (plain attrs; see telemetry()) -------------------
@@ -265,23 +275,26 @@ class MConnection:
         it sent, which is exactly the telemetry skew a real lossy link
         produces."""
         name = ch.display_name
-        if failures.fire("p2p.send.drop", chan=name) is not None:
+        scope = self.chaos_scope
+        if failures.fire("p2p.send.drop", chan=name,
+                         node=scope) is not None:
             return
-        f = failures.fire("p2p.send.corrupt", chan=name)
+        f = failures.fire("p2p.send.corrupt", chan=name, node=scope)
         if f is not None and pkt["d"]:
             data = bytearray(pkt["d"])
             rng = failures.site_rng("p2p.send.corrupt")
             data[rng.randrange(len(data))] ^= 1 << rng.randrange(8)
             pkt = dict(pkt, d=bytes(data))
-        f = failures.fire("p2p.send.delay", chan=name)
+        f = failures.fire("p2p.send.delay", chan=name, node=scope)
         if f is not None:
             await asyncio.sleep(float(f.get("delay", 0.05)))
-        f = failures.fire("p2p.send.reorder", chan=name)
+        f = failures.fire("p2p.send.reorder", chan=name, node=scope)
         if f is not None and self._chaos_held is None:
             self._chaos_held = pkt      # released after the NEXT packet
             return
         await self._write_packet(pkt)
-        if failures.fire("p2p.send.duplicate", chan=name) is not None:
+        if failures.fire("p2p.send.duplicate", chan=name,
+                         node=scope) is not None:
             await self._write_packet(pkt)
         if self._chaos_held is not None:
             held, self._chaos_held = self._chaos_held, None
@@ -334,7 +347,7 @@ class MConnection:
         except asyncio.CancelledError:
             raise
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
-            self._fail(MConnectionError(f"connection lost: {e}"))
+            self._fail(ConnectionLostError(f"connection lost: {e}"))
         except Exception as e:
             self._fail(e)
 
@@ -357,11 +370,12 @@ class MConnection:
                 # receive-side faults operate on COMPLETE messages (the
                 # unit the reactor sees): drop it, or flip one seeded
                 # bit so the codec/handler rejects it downstream
-                if failures.fire("p2p.recv.drop",
-                                 chan=ch.display_name) is not None:
+                if failures.fire("p2p.recv.drop", chan=ch.display_name,
+                                 node=self.chaos_scope) is not None:
                     return
                 f = failures.fire("p2p.recv.corrupt",
-                                  chan=ch.display_name)
+                                  chan=ch.display_name,
+                                  node=self.chaos_scope)
                 if f is not None and msg:
                     data = bytearray(msg)
                     rng = failures.site_rng("p2p.recv.corrupt")

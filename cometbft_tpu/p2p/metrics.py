@@ -79,6 +79,19 @@ def p2p_metrics() -> SimpleNamespace:
             "p2p_send_queue_full_total",
             "sends refused because the per-channel send queue was full, "
             "by channel (backpressure visible per channel, node-wide)"),
+        misbehavior=m.counter(
+            "p2p_peer_misbehavior_total",
+            "misbehavior events reported to the peer scorer, by typed "
+            "event (see p2p/quality.py taxonomy)"),
+        peer_bans=m.counter(
+            "p2p_peer_bans_total",
+            "timed bans issued by the peer scorer, by the event that "
+            "tipped the score over the ban threshold"),
+        reconnect_giveups=m.counter(
+            "p2p_reconnect_giveups_total",
+            "persistent-peer reconnect loops that exhausted the "
+            "exponential backoff budget (they keep retrying at the max "
+            "delay; this counts the downshifts)"),
         # ---------------------------------------------- peer-labeled
         peer_send_bytes=m.counter(
             "p2p_peer_send_bytes_total",
@@ -115,5 +128,10 @@ def p2p_metrics() -> SimpleNamespace:
         peer_rtt=m.gauge(
             "p2p_peer_rtt_seconds",
             "last measured ping RTT per peer",
+            max_label_sets=PEER_LABEL_BUDGET),
+        peer_score=m.gauge(
+            "p2p_peer_score",
+            "decaying misbehavior score per connected peer (0 = clean; "
+            "crossing the configured thresholds disconnects / bans)",
             max_label_sets=PEER_LABEL_BUDGET),
     )

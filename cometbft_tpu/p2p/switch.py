@@ -14,10 +14,13 @@ import time
 from ..libs import aio
 import random
 
-from .conn import MConnection, PongTimeoutError
+from ..libs import log as tmlog
+from .conn import (ConnectionLostError, MConnection, MConnectionError,
+                   PongTimeoutError)
 from .metrics import p2p_metrics, peer_label
 from .node_info import NodeInfo
 from .peer import Peer
+from .quality import PeerMisbehaviorError, PeerScorer
 from .reactor import ChannelDescriptor, Reactor
 from .transport import Transport
 
@@ -37,13 +40,27 @@ class Switch:
     def __init__(self, transport: Transport,
                  ping_interval: float = 10.0, pong_timeout: float = 5.0,
                  emulated_latency: float = 0.0,
-                 telemetry_interval: float = TELEMETRY_FLUSH_INTERVAL):
+                 telemetry_interval: float = TELEMETRY_FLUSH_INTERVAL,
+                 scorer: PeerScorer | None = None,
+                 chaos_scope: str = ""):
         self.transport = transport
         self.emulated_latency = emulated_latency
+        # node-wide peer reputation: every layer's misbehavior reports
+        # funnel through report_peer into this one scorer, which orders
+        # disconnects and timed bans (p2p/quality.py)
+        self.scorer = scorer if scorer is not None else PeerScorer()
+        # selector scope stamped on every MConnection so [chaos] specs
+        # with node=<name> arm one node's links in an in-proc ensemble
+        self.chaos_scope = chaos_scope
         self.reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._descriptors: list[ChannelDescriptor] = []
         self.peers: dict[str, Peer] = {}
+        # node ids we have EVER dialed persistently: the ban exemption
+        # must hold while the peer is between connections (late async
+        # misbehavior reports land after removal) and for its inbound
+        # reconnects (which never carry persistent=True themselves)
+        self._persistent_ids: set[str] = set()
         self.ping_interval = ping_interval
         self.pong_timeout = pong_timeout
         self.telemetry_interval = telemetry_interval
@@ -58,6 +75,7 @@ class Switch:
         # labeled per node id: multi-node in-process ensembles share the
         # process-wide registry
         self._m_node = transport.node_key.id[:8]
+        self.log = tmlog.logger("p2p", node=chaos_scope or self._m_node)
         self._m = p2p_metrics()
         self._m_peers_out = self._m.peers.bind(node=self._m_node,
                                                direction="outbound")
@@ -121,7 +139,12 @@ class Switch:
     # -------------------------------------------------------------- peers
 
     async def _on_accepted(self, conn, node_info: NodeInfo) -> None:
-        await self._add_peer(conn, node_info, outbound=False)
+        try:
+            await self._add_peer(conn, node_info, outbound=False)
+        except SwitchError as e:
+            # refusing an inbound (banned / duplicate / stopping) is a
+            # normal outcome, not an unretrieved task exception
+            self.log.debug("inbound peer refused", err=str(e))
 
     async def dial_peer(self, addr: str, persistent: bool = False) -> Peer:
         try:
@@ -151,6 +174,17 @@ class Switch:
         if node_info.node_id in self.peers:
             conn.close()
             raise SwitchError(f"duplicate peer {node_info.node_id[:12]}")
+        if persistent:
+            self._persistent_ids.add(node_info.node_id)
+        if not persistent and \
+                node_info.node_id not in self._persistent_ids and \
+                self.scorer.is_banned(node_info.node_id):
+            # admission control: a timed ban refuses the connection at
+            # the door (inbound and plain outbound alike).  Persistent
+            # peers are operator-pinned and exempt from bans — including
+            # their INBOUND reconnects, which don't carry the flag.
+            conn.close()
+            raise SwitchError(f"peer {node_info.node_id[:12]} is banned")
 
         peer_box: list[Peer] = []
         reactor_msgs = self._m_reactor_msgs
@@ -172,6 +206,7 @@ class Switch:
                             pong_timeout=self.pong_timeout,
                             emulated_latency=self.emulated_latency)
         mconn.on_rtt = self._m_rtt.observe
+        mconn.chaos_scope = self.chaos_scope
         peer = Peer(node_info, mconn, outbound, persistent, dial_addr)
         peer_box.append(peer)
         self.peers[peer.id] = peer
@@ -186,12 +221,75 @@ class Switch:
         self._m_peers_out.set(n_out)
         self._m_peers_in.set(len(self.peers) - n_out)
 
+    # ------------------------------------------------------- peer quality
+
+    @staticmethod
+    def _classify_error(err) -> str | None:
+        """Map a connection-teardown cause to a misbehavior event, or
+        None when it isn't the peer's fault (plain network failures) or
+        was already scored (PeerMisbehaviorError)."""
+        if not isinstance(err, Exception):
+            return None                      # string reason / None
+        if isinstance(err, (PeerMisbehaviorError, ConnectionLostError,
+                            asyncio.CancelledError)):
+            return None
+        if isinstance(err, PongTimeoutError):
+            return "pong_timeout"
+        if isinstance(err, MConnectionError):
+            return "malformed_frame"         # post-AEAD decode/framing
+        if isinstance(err, (ConnectionError, OSError)):
+            return None
+        return "protocol_error"              # reactor raised on input
+
+    def _score(self, peer_id: str, event: str, *, persistent: bool,
+               detail: str = "", weight: float | None = None) -> str | None:
+        """Record one event with the scorer + metrics; returns the
+        ordered action without executing it."""
+        action = self.scorer.report(peer_id, event, weight=weight,
+                                    persistent=persistent, detail=detail)
+        self._m.misbehavior.inc(node=self._m_node, event=event)
+        if action == "ban":
+            self._m.peer_bans.inc(node=self._m_node, reason=event)
+            self.log.warn("peer banned", peer=peer_id[:12], reason=event,
+                          detail=detail[:80])
+        return action
+
+    def report_peer(self, peer_id: str, event: str, detail: str = "",
+                    weight: float | None = None,
+                    disconnect: bool = False) -> str | None:
+        """Reactor-facing misbehavior report.  Scores the event; when
+        the scorer orders a disconnect/ban — or the caller already
+        decided the peer must go (``disconnect=True``, e.g. blocksync
+        dropping a bad block server) — the peer is stopped.  Persistent
+        peers are re-dialed by stop_peer_for_error as usual."""
+        peer = self.peers.get(peer_id)
+        # a late report for a disconnected peer must still honor the
+        # persistent-peer ban exemption
+        persistent = (peer.persistent if peer is not None else False) \
+            or peer_id in self._persistent_ids
+        action = self._score(peer_id, event, persistent=persistent,
+                             detail=detail, weight=weight)
+        if peer is not None and (action is not None or disconnect):
+            aio.spawn(self.stop_peer_for_error(
+                peer, PeerMisbehaviorError(event, detail)))
+        return action
+
     async def stop_peer_for_error(self, peer: Peer, err) -> None:
         """switch.go StopPeerForError + persistent reconnect."""
         if peer.id not in self.peers:
             return
         if isinstance(err, PongTimeoutError):
             self._m.pong_timeouts.inc(node=self._m_node)
+        event = self._classify_error(err)
+        if event is not None:
+            # connection-level misbehavior (garbage frames, reactor
+            # blow-ups, silent death) feeds the same ledger as the
+            # in-band reports, so a reconnect-and-misbehave loop
+            # escalates to a timed ban
+            self._score(peer.id, event,
+                        persistent=(peer.persistent
+                                    or peer.id in self._persistent_ids),
+                        detail=repr(err)[:160])
         await self._remove_peer(peer, err)
         if self._running and peer.persistent and peer.dial_addr:
             self._schedule_reconnect(peer.dial_addr)
@@ -216,16 +314,39 @@ class Switch:
 
         async def _reconnect():
             delay = RECONNECT_BASE_DELAY
-            for _ in range(RECONNECT_MAX_ATTEMPTS):
+            attempts = 0
+            while True:
                 await asyncio.sleep(delay * (1 + 0.2 * random.random()))
                 if not self._running:
                     return
+                if any(p.dial_addr == addr for p in self.peers.values()):
+                    return      # already re-dialed (a racing loop won)
                 try:
                     await self.dial_peer(addr, persistent=True)
                     return
-                except Exception:
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    if isinstance(e, SwitchError) and \
+                            "duplicate peer" in str(e):
+                        # the peer reconnected INBOUND while we backed
+                        # off: mission accomplished — without this the
+                        # now-unbounded loop would re-handshake against
+                        # a connected peer every max-delay forever
+                        return
+                    attempts += 1
+                    if attempts == RECONNECT_MAX_ATTEMPTS:
+                        # the reference gives up here — silently losing
+                        # an operator-pinned peer forever.  Log + count
+                        # the backoff exhaustion, then keep retrying at
+                        # the max delay (with jitter) indefinitely: a
+                        # persistent peer is persistent.
+                        self._m.reconnect_giveups.inc(node=self._m_node)
+                        self.log.warn(
+                            "persistent-peer reconnect exhausted backoff; "
+                            "continuing at max delay", addr=addr,
+                            attempts=attempts, err=repr(e)[:80])
                     delay = min(delay * 2, RECONNECT_MAX_DELAY)
-            # give up silently (reference logs and gives up too)
 
         task = asyncio.create_task(_reconnect())
         task.add_done_callback(
@@ -287,6 +408,8 @@ class Switch:
                                 peer=pl)
         mets.peer_recv_rate.set(mconn.recv_monitor.rate, node=node,
                                 peer=pl)
+        mets.peer_score.set(self.scorer.score(peer.id), node=node,
+                            peer=pl)
         if mconn.last_rtt_s is not None:
             mets.peer_rtt.set(mconn.last_rtt_s, node=node, peer=pl)
 
@@ -310,11 +433,18 @@ class Switch:
         mets.peer_send_rate.remove(node=node, peer=pl)
         mets.peer_recv_rate.remove(node=node, peer=pl)
         mets.peer_rtt.remove(node=node, peer=pl)
+        mets.peer_score.remove(node=node, peer=pl)
 
     def peer_snapshot(self) -> list[dict]:
         """Per-peer telemetry dicts for `/net_info` and the liveness
-        watchdog's incident bundles."""
-        return [p.telemetry() for p in self.peers.values()]
+        watchdog's incident bundles, each carrying the scorer's quality
+        block (score / event counts / ban history)."""
+        out = []
+        for p in self.peers.values():
+            d = p.telemetry()
+            d["quality"] = self.scorer.peer_info(p.id)
+            out.append(d)
+        return out
 
     def quietest_peer_recv_age_s(self) -> float | None:
         """Seconds since the MOST RECENTLY heard-from peer last produced

@@ -124,12 +124,15 @@ async def net_info(env: Environment) -> dict:
     if sw is not None and getattr(sw, "peer_snapshot", None) is not None:
         peers = sw.peer_snapshot()
     n_outbound = sum(1 for p in peers if p.get("outbound"))
+    scorer = getattr(sw, "scorer", None)
+    bans = scorer.bans_snapshot() if scorer is not None else []
     return {"listening": env.node.listen_addr is not None,
             "listen_addr": env.node.listen_addr or "",
             "n_peers": len(peers),
             "n_outbound": n_outbound,
             "n_inbound": len(peers) - n_outbound,
-            "peers": peers}
+            "peers": peers,
+            "bans": bans}
 
 
 _GENESIS_CHUNK_SIZE = 16 * 1024 * 1024   # rpc/core/env.go:32
@@ -684,7 +687,12 @@ async def dump_incidents(env: Environment, limit=50, name=None) -> dict:
     if name is not None:
         if not incident_dir:
             raise RPCError(-32603, "no incident directory on this node")
-        bundle = load_incident(incident_dir, str(name))
+        # a bundle body can run megabytes of trace ring: read + parse
+        # in a worker thread — this route bypasses the admission gate
+        # (diagnostics must answer during overload), so it especially
+        # must not stall the event loop
+        bundle = await asyncio.to_thread(
+            load_incident, incident_dir, str(name))
         if bundle is None:
             raise RPCError(-32603, f"no incident bundle {name!r}")
         out["bundle"] = bundle

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import functools
 import hashlib
 import json
+import math
 import struct
 from urllib.parse import parse_qsl, unquote, urlsplit
 
@@ -32,6 +34,31 @@ from .json import jsonable
 
 _WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
 MAX_BODY = 10 << 20
+
+# Routes that bypass the overload-shedding admission gate: the node's
+# own diagnostics MUST answer while the node sheds a request flood —
+# an operator debugging the flood needs /status and /net_info most
+# exactly then.  All stay off the event-loop's critical path: cheap
+# in-memory reads, except dump_incidents' bundle fetch which runs its
+# disk read in a worker thread.
+UNGATED_METHODS = frozenset(
+    {"health", "status", "net_info", "dump_trace", "dump_incidents"})
+# POST bodies up to this size are parsed BEFORE the gate to check the
+# exemption; anything larger is gated unconditionally so a flood of fat
+# bodies can't buy a 10MB json.loads per shed request
+_GATE_PROBE_MAX_BODY = 4096
+# responses that can run megabytes: serialize in a worker thread
+_THREAD_ENCODE_METHODS = frozenset({"dump_incidents", "dump_trace"})
+
+
+@functools.cache
+def _gate_metrics():
+    from ..libs import metrics as _m
+
+    return _m.counter(
+        "rpc_requests_shed_total",
+        "HTTP requests rejected with 503 by the RPC admission gate "
+        "(concurrency limit hit AND the wait queue full)")
 
 
 def compile_query(q: str) -> Query:
@@ -117,6 +144,21 @@ class RPCServer:
         self._cors_headers = list(rpc_cfg.cors_allowed_headers)
         self._ssl_ctx = self._build_ssl(cfg)
         self._openapi_raw: bytes | None = None
+        # ---- overload-shedding admission gate -------------------------
+        # at most max_concurrent_requests handlers run at once; up to
+        # max_queued_requests more wait on the semaphore; past that the
+        # request is shed with 503 + Retry-After.  Diagnostic routes
+        # (UNGATED_METHODS) bypass the gate entirely.
+        self._gate_max = max(1, int(getattr(
+            rpc_cfg, "max_concurrent_requests", 64)))
+        self._gate_max_queued = max(0, int(getattr(
+            rpc_cfg, "max_queued_requests", 256)))
+        self._gate_retry_after = max(1, math.ceil(float(getattr(
+            rpc_cfg, "shed_retry_after_s", 1.0)) or 1))
+        self._gate_sem = asyncio.Semaphore(self._gate_max)
+        self._gate_active = 0
+        self._gate_queued = 0
+        self._m_shed = _gate_metrics()
 
     @staticmethod
     def _build_ssl(cfg):
@@ -245,6 +287,40 @@ class RPCServer:
             "paths": paths,
         }
 
+    # ------------------------------------------------------- admission gate
+
+    async def _gate_admit(self) -> bool:
+        """Enter the concurrency gate: returns False (shed) when the
+        run slots are full AND the wait queue is at capacity."""
+        if self._gate_active >= self._gate_max and \
+                self._gate_queued >= self._gate_max_queued:
+            self._m_shed.inc()
+            return False
+        self._gate_queued += 1
+        try:
+            await self._gate_sem.acquire()
+        finally:
+            self._gate_queued -= 1
+        self._gate_active += 1
+        return True
+
+    def _gate_done(self) -> None:
+        self._gate_active -= 1
+        self._gate_sem.release()
+
+    def _write_503(self, writer: asyncio.StreamWriter, cors: bytes) -> None:
+        body = json.dumps({
+            "jsonrpc": "2.0", "id": None,
+            "error": {"code": -32000,
+                      "message": "server overloaded; retry later",
+                      "data": ""}}).encode()
+        writer.write(
+            b"HTTP/1.1 503 Service Unavailable\r\n"
+            b"Content-Type: application/json\r\n" + cors +
+            b"Retry-After: " + str(self._gate_retry_after).encode() +
+            b"\r\nContent-Length: " + str(len(body)).encode() +
+            b"\r\nConnection: keep-alive\r\n\r\n" + body)
+
     # ------------------------------------------------------------- http
 
     async def _serve(self, reader: asyncio.StreamReader,
@@ -343,14 +419,62 @@ class RPCServer:
                     if headers.get("connection", "").lower() == "close":
                         return
                     continue
+                # overload shedding: every non-diagnostic request enters
+                # the admission gate; at capacity it gets 503+Retry-After
+                # while /status and friends keep answering.  The shed
+                # decision must stay cheap: only SMALL POST bodies are
+                # parsed pre-gate to check the exemption (a diagnostic
+                # call is never megabytes) — large bodies are gated
+                # unconditionally and parsed only once admitted.
+                req = parse_err = None
+                parsed = False
+                rpc_method = None
                 if method == "POST":
-                    resp = await self._handle_jsonrpc_body(body)
+                    if len(body) <= _GATE_PROBE_MAX_BODY:
+                        req, parse_err = self._parse_jsonrpc(body)
+                        parsed = True
+                        rpc_method = req.get("method") \
+                            if isinstance(req, dict) else None
+                        gated = parse_err is None and \
+                            rpc_method not in UNGATED_METHODS
+                    else:
+                        gated = True
                 elif method in ("GET", "HEAD"):
-                    resp = await self._handle_uri(target)
+                    rpc_method = path.strip("/")
+                    gated = rpc_method not in UNGATED_METHODS
                 else:
-                    resp = _rpc_error(None, -32600,
-                                      f"unsupported method {method}")
-                raw = json.dumps(resp).encode()
+                    gated = False        # error response, no handler runs
+                if gated and not await self._gate_admit():
+                    self._write_503(writer, cors)
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        return
+                    continue
+                try:
+                    if method == "POST":
+                        if not parsed:
+                            req, parse_err = self._parse_jsonrpc(body)
+                            if isinstance(req, dict):
+                                rpc_method = req.get("method")
+                        resp = parse_err if parse_err is not None else \
+                            await self._handle_jsonrpc_obj(req)
+                    elif method in ("GET", "HEAD"):
+                        resp = await self._handle_uri(target)
+                    else:
+                        resp = _rpc_error(None, -32600,
+                                          f"unsupported method {method}")
+                finally:
+                    if gated:
+                        self._gate_done()
+                if rpc_method in _THREAD_ENCODE_METHODS:
+                    # multi-MB diagnostic payloads (incident bundles,
+                    # trace dumps) serialize off the event loop — these
+                    # routes bypass the gate, so their encode especially
+                    # must not stall pings/consensus timers
+                    raw = await asyncio.to_thread(json.dumps, resp)
+                    raw = raw.encode()
+                else:
+                    raw = json.dumps(resp).encode()
                 writer.write(
                     b"HTTP/1.1 200 OK\r\n"
                     b"Content-Type: application/json\r\n" + cors +
@@ -367,11 +491,15 @@ class RPCServer:
             self._conn_tasks.discard(task)
             writer.close()
 
-    async def _handle_jsonrpc_body(self, body: bytes):
+    @staticmethod
+    def _parse_jsonrpc(body: bytes):
+        """(parsed request, None) or (None, error response)."""
         try:
-            req = json.loads(body)
+            return json.loads(body), None
         except json.JSONDecodeError as e:
-            return _rpc_error(None, -32700, f"parse error: {e}")
+            return None, _rpc_error(None, -32700, f"parse error: {e}")
+
+    async def _handle_jsonrpc_obj(self, req):
         if isinstance(req, list):
             # JSON-RPC batch (rpc/jsonrpc/server/http_json_handler.go:46);
             # notifications (no id) get no response entry
@@ -492,9 +620,28 @@ class _WsSession:
                 self._unsubscribe(q)
             await self._send_json({"jsonrpc": "2.0", "id": rid,
                                    "result": {}})
+        elif method in UNGATED_METHODS:
+            resp = await self.server._dispatch(rid, method, params)
+            if method in _THREAD_ENCODE_METHODS:
+                # multi-MB diagnostic payloads encode off the loop on
+                # the ws path too
+                raw = await asyncio.to_thread(json.dumps, resp)
+                await self._send_frame(1, raw.encode())
+            else:
+                await self._send_json(resp)
         else:
-            await self._send_json(await self.server._dispatch(
-                rid, method, params))
+            # the admission gate bounds handler concurrency NODE-WIDE:
+            # a flood over websockets must shed like one over HTTP
+            # (here as a JSON-RPC error — there is no 503 frame)
+            if not await self.server._gate_admit():
+                await self._send_json(_rpc_error(
+                    rid, -32000, "server overloaded; retry later"))
+                return
+            try:
+                resp = await self.server._dispatch(rid, method, params)
+            finally:
+                self.server._gate_done()
+            await self._send_json(resp)
 
     async def _subscribe(self, rid, query: str) -> None:
         try:

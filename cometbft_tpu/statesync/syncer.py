@@ -14,12 +14,32 @@ Flow (syncer.go SyncAny):
 from __future__ import annotations
 
 import asyncio
+import functools
 
 from ..libs import aio
 
 from ..abci import types as abci
 from ..libs import log as tmlog
 from .stateprovider import StateProvider
+
+
+@functools.cache
+def _ss_metrics():
+    from types import SimpleNamespace
+
+    from ..libs import metrics as m
+
+    return SimpleNamespace(
+        senders_banned=m.counter(
+            "statesync_senders_banned_total",
+            "snapshot senders the app rejected (REJECT_SENDER offers or "
+            "ApplySnapshotChunk reject_senders) — a stalled sync with "
+            "this climbing means the snapshot sources are bad, not "
+            "the network"),
+        formats_rejected=m.counter(
+            "statesync_formats_rejected_total",
+            "snapshot offers rejected with REJECT_FORMAT (final per "
+            "format for the whole sync)"))
 
 CHUNK_TIMEOUT = 10.0
 # Outstanding chunk requests per serving peer (the reference runs 4
@@ -166,10 +186,12 @@ class Syncer:
         self.app_conns = app_conns
         self.provider = state_provider
         self.reactor = reactor
+        self.name = name
         self.log = tmlog.logger("statesync", node=name)
         self._snapshots: dict[tuple, _PendingSnapshot] = {}
         self._chunks = _ChunkStore()     # idx -> (data, sender), on disk
         self._banned: set[str] = set()   # app-rejected senders
+        self._m = _ss_metrics()
         self._chunk_event = asyncio.Event()
         self._current = None
         # the event loop holds only weak refs to tasks; spool writes must
@@ -239,6 +261,23 @@ class Syncer:
             if peer_id in pending.peers:
                 pending.peers.remove(peer_id)
 
+    def _note_sender_banned(self, peer_id: str) -> None:
+        """One app-rejected sender: count it (a stalled sync must be
+        diagnosable from /metrics) and feed the p2p peer-quality scorer
+        so the node drops/bans the peer node-wide, not just for this
+        sync."""
+        self._banned.add(peer_id)
+        self._m.senders_banned.inc(node=self.name)
+        sw = getattr(self.reactor, "switch", None) \
+            if self.reactor is not None else None
+        if sw is not None and hasattr(sw, "report_peer"):
+            try:
+                sw.report_peer(peer_id, "bad_snapshot_chunk",
+                               detail="app rejected snapshot sender",
+                               disconnect=True)
+            except Exception:
+                pass
+
     # ------------------------------------------------------------- sync
 
     async def sync(self, discovery_time: float = DISCOVERY_TIME,
@@ -280,13 +319,14 @@ class Syncer:
                 except _RejectFormat:
                     # syncer.go:208 — skip every snapshot of this format
                     rejected_formats.add(best.snapshot.format)
+                    self._m.formats_rejected.inc(node=self.name)
                     self.log.warn("snapshot format rejected",
                                   format=best.snapshot.format)
                 except _RejectSender:
                     # syncer.go:212 — distrust every peer advertising it
                     banned = list(best.peers)
                     for p in banned:
-                        self._banned.add(p)
+                        self._note_sender_banned(p)
                         self.remove_peer(p)
                     self.log.warn("snapshot senders rejected",
                                   peers=len(banned))
@@ -438,7 +478,7 @@ class Syncer:
                 # syncer.go:438 — the app can name bad senders and ask
                 # for specific chunks again regardless of the result
                 for bad in resp.reject_senders:
-                    self._banned.add(bad)
+                    self._note_sender_banned(bad)
                     if bad in pending.peers:
                         pending.peers.remove(bad)
                     # chunks.DiscardSender: everything unapplied from the

@@ -21,6 +21,14 @@ class EvidenceError(Exception):
     pass
 
 
+class EvidenceNotApplicableError(EvidenceError):
+    """Evidence this node cannot currently judge (expired, from a height
+    below its block base / pruned validator sets, or no state yet).  Its
+    own type so the gossip reactor can DROP it without punishing the
+    sender: a freshly statesync'd node lacking old blocks must not ban
+    honest peers re-gossiping legitimate pending evidence."""
+
+
 class Evidence(ABC):
     @abstractmethod
     def height(self) -> int: ...

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""CI bad-peer smoke: a seeded 3-node net where ONE node's outbound
+links are armed with ``p2p.send.corrupt`` (the ``node=`` selector of the
+fault plane).  Asserts the peer-quality defense layer end to end:
+
+- the victim's scorer accumulates misbehavior for the corrupting peer
+  and issues a TIMED ban (visible in the scorer, the ban metric, and
+  /net_info's ``bans`` block),
+- the victim keeps committing off the good validator THROUGH the ban
+  (fork-free liveness),
+- the corruption schedule drains and the banned peer is READMITTED
+  after the TTL expires,
+- the fault schedule fired at its exact seeded call indices (the
+  same-seed reproduction contract — ``every=2`` over the bad node's
+  send stream only).
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow next to ``scripts/smoke_chaos.py``; runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_badpeer.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 20260811
+MAX_FIRES = 8
+SPEC = f"p2p.send.corrupt:node=bp-bad:every=2:max={MAX_FIRES}"
+
+
+async def scenario() -> None:
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc.core import Environment, net_info
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    F.reset()
+    F.configure(enabled=True, seed=SEED, faults=[SPEC])
+    pvs = [MockPV.from_secret(b"bp-%d" % i) for i in range(2)]
+    doc = GenesisDoc(chain_id="badpeer-smoke",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+
+    async def mk(name, pv, victim=False):
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.base.signature_backend = "cpu"
+        cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+        if victim:
+            cfg.p2p.quality_disconnect_score = 1.5
+            cfg.p2p.quality_ban_score = 3.5
+            cfg.p2p.quality_ban_ttl_s = 1.5
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+            node_key=NodeKey.from_secret(name.encode()), name=name)
+        await node.start()
+        return node
+
+    victim = await mk("bp-victim", pvs[0], victim=True)
+    good = await mk("bp-good", pvs[1])
+    bad = await mk("bp-bad", None)          # observer; its links corrupt
+    nodes = [victim, good, bad]
+    try:
+        await good.dial_peer(victim.listen_addr, persistent=True)
+        await bad.dial_peer(victim.listen_addr, persistent=True)
+        bad_id = bad.node_key.id
+        vsw = victim.switch
+
+        deadline = time.monotonic() + 20
+        while not all(n.height() >= 2 for n in (victim, good)):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no progress: {[n.height() for n in nodes]}")
+            await asyncio.sleep(0.1)
+
+        # score decay -> timed ban
+        deadline = time.monotonic() + 25
+        while vsw.scorer.bans_total < 1:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"victim never banned the corrupting peer: "
+                    f"scorer={vsw.scorer.snapshot()} "
+                    f"chaos={F.stats()['sites']}")
+            await asyncio.sleep(0.05)
+        info = vsw.scorer.peer_info(bad_id)
+        if info.get("ban_count", 0) < 1:
+            raise RuntimeError(f"ban did not target the bad peer: {info}")
+        ni = await net_info(Environment(victim))
+        if vsw.scorer.is_banned(bad_id) and \
+                not any(b["node_id"] == bad_id for b in ni["bans"]):
+            raise RuntimeError(f"/net_info bans block missing: {ni['bans']}")
+        bans_counter = m.counter("p2p_peer_bans_total")
+        bans = sum(bans_counter.value(node=victim.node_key.id[:8],
+                                      reason=r)
+                   for r in ("protocol_error", "malformed_frame",
+                             "invalid_vote", "invalid_part",
+                             "invalid_proposal", "pong_timeout"))
+        if bans < 1:
+            raise RuntimeError("p2p_peer_bans_total never incremented")
+
+        # liveness off the good peer through the ban
+        h_ban = victim.height()
+        deadline = time.monotonic() + 20
+        while victim.height() < h_ban + 3:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"victim stalled after the ban at {victim.height()}")
+            await asyncio.sleep(0.1)
+
+        # schedule drains -> ban expires -> readmission
+        deadline = time.monotonic() + 30
+        while True:
+            fired = F.stats()["sites"]["p2p.send.corrupt"]["fired"]
+            if fired >= MAX_FIRES and not vsw.scorer.is_banned(bad_id) \
+                    and bad_id in vsw.peers:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no readmission: fired={fired} "
+                    f"banned={vsw.scorer.is_banned(bad_id)} "
+                    f"connected={bad_id in vsw.peers}")
+            await asyncio.sleep(0.1)
+
+        # fork-free at every common height
+        common = min(victim.height(), good.height())
+        for h in range(1, common + 1):
+            hs = {n.block_store.load_block(h).hash()
+                  for n in (victim, good)
+                  if n.block_store.load_block(h) is not None}
+            if len(hs) != 1:
+                raise RuntimeError(f"fork at height {h}: {hs}")
+
+        # seeded-schedule reproduction: every=2 over the bad node's
+        # stream fires at exactly 2,4,...,2*MAX_FIRES
+        corrupts = sorted((n for s, n, _ in F.signature()
+                           if s == "p2p.send.corrupt"))
+        expected = [2 * k for k in range(1, MAX_FIRES + 1)]
+        if corrupts != expected:
+            raise RuntimeError(
+                f"corruption schedule drifted: {corrupts} != {expected}")
+        print(f"badpeer smoke ok: ban after "
+              f"{info.get('events_total', '?')} scored events, "
+              f"{common} heights fork-free, peer readmitted, "
+              f"{MAX_FIRES} faults at the seeded indices")
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        F.reset()
+
+
+def main() -> int:
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    except RuntimeError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

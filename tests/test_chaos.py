@@ -354,7 +354,7 @@ def _genesis(n, chain_id, secret=b"chaos"):
 
 
 async def _mk_node(doc, pv, i, *, home=None, watchdog=False,
-                   name_prefix="chaos"):
+                   name_prefix="chaos", tweak=None):
     from cometbft_tpu.abci.kvstore import KVStoreApplication
     from cometbft_tpu.config import Config, test_consensus_config
     from cometbft_tpu.node import Node
@@ -369,6 +369,8 @@ async def _mk_node(doc, pv, i, *, home=None, watchdog=False,
         cfg.instrumentation.watchdog_check_interval_s = 0.25
     else:
         cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+    if tweak is not None:
+        tweak(cfg)
     node = await Node.create(
         doc, KVStoreApplication(), priv_validator=pv, config=cfg,
         node_key=NodeKey.from_secret(b"%s-%d" % (name_prefix.encode(), i)),
@@ -598,3 +600,123 @@ def test_chaos_acceptance_4node_mixed_faults(tmp_path):
     assert len(corrupts) == 10
     # every=15 fires at exact call indices — the deterministic schedule
     assert [n for _, n, _ in corrupts] == [15 * k for k in range(1, 11)]
+
+
+# --------------------------------------------------------------------------
+# PR 9 acceptance: the chaos plane as forcing function for the peer-quality
+# defense layer — a seeded 3-node run where ONE peer's links are armed with
+# p2p.send.corrupt (node=<name> selector): the victim scores it down, issues
+# a timed ban, keeps committing off the good peer, and readmits the peer
+# after the ban expires; the fault log reproduces identically across two
+# same-seed runs.
+
+BADPEER_SEED = 90210
+BADPEER_MAX_FIRES = 8
+BADPEER_SPEC = f"p2p.send.corrupt:node=bqbad0:every=2:max={BADPEER_MAX_FIRES}"
+
+
+async def _badpeer_scenario() -> tuple:
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.rpc.core import Environment, net_info
+
+    doc, pvs = _genesis(2, "badpeer-net", secret=b"badpeer")
+    F.reset()
+    F.configure(enabled=True, seed=BADPEER_SEED, faults=[BADPEER_SPEC])
+
+    def victim_tweak(cfg):
+        # two scoring events (weight >= 1.5 each) ban; short TTL so the
+        # readmission leg fits the test budget
+        cfg.p2p.quality_disconnect_score = 1.5
+        cfg.p2p.quality_ban_score = 3.5
+        cfg.p2p.quality_ban_ttl_s = 1.5
+        cfg.p2p.quality_half_life_s = 600.0
+
+    victim = await _mk_node(doc, pvs[0], 0, name_prefix="bq",
+                            tweak=victim_tweak)
+    good = await _mk_node(doc, pvs[1], 1, name_prefix="bq")
+    # the corrupting node: a non-validator observer whose OUTBOUND links
+    # are armed via the node= selector (name "bqbad0" = chaos scope)
+    bad = await _mk_node(doc, None, 0, name_prefix="bqbad")
+    nodes = [victim, good, bad]
+    try:
+        await good.dial_peer(victim.listen_addr, persistent=True)
+        # persistent FROM the bad node's side: it keeps re-dialing after
+        # every disconnect/ban, which is what exercises readmission (on
+        # the VICTIM's side it is inbound and fully bannable)
+        await bad.dial_peer(victim.listen_addr, persistent=True)
+        bad_id = bad.node_key.id
+        vsw = victim.switch
+
+        await _wait_height([victim, good], 2, timeout=30.0)
+
+        # --- score decay -> timed ban ---------------------------------
+        deadline = time.monotonic() + 45
+        while vsw.scorer.bans_total < 1:
+            assert time.monotonic() < deadline, \
+                f"no ban; scorer={vsw.scorer.snapshot()} " \
+                f"chaos={F.stats()['sites']}"
+            await asyncio.sleep(0.05)
+        bans_metric = sum(
+            m.counter("p2p_peer_bans_total").value(
+                node=victim.node_key.id[:8], reason=r)
+            for r in ("malformed_frame", "protocol_error", "invalid_vote",
+                      "invalid_part", "invalid_proposal"))
+        assert bans_metric >= 1
+        ni = await net_info(Environment(victim))
+        if vsw.scorer.is_banned(bad_id):     # may already have expired
+            assert any(b["node_id"] == bad_id for b in ni["bans"])
+
+        # --- liveness off the good peer THROUGH the ban ---------------
+        h_ban = victim.height()
+        await _wait_height([victim, good], h_ban + 3, timeout=45.0)
+
+        # --- schedule drains; peer readmitted after expiry ------------
+        deadline = time.monotonic() + 60
+        while True:
+            fired = F.stats()["sites"]["p2p.send.corrupt"]["fired"]
+            if fired >= BADPEER_MAX_FIRES and \
+                    not vsw.scorer.is_banned(bad_id) and \
+                    bad_id in vsw.peers:
+                break
+            assert time.monotonic() < deadline, \
+                f"no readmission: fired={fired} " \
+                f"banned={vsw.scorer.is_banned(bad_id)} " \
+                f"connected={bad_id in vsw.peers}"
+            await asyncio.sleep(0.1)
+        # readmitted peer carries its quality history in /net_info
+        snap = {p["node_id"]: p for p in vsw.peer_snapshot()}
+        assert snap[bad_id]["quality"]["ban_count"] >= 1
+
+        # --- fork-free at every common height -------------------------
+        common = min(victim.height(), good.height())
+        hashes = []
+        for h in range(1, common + 1):
+            hs = {n.block_store.load_block(h).hash()
+                  for n in (victim, good)
+                  if n.block_store.load_block(h) is not None}
+            assert len(hs) == 1, f"fork at {h}"
+            hashes.append(hs.pop().hex())
+        return F.signature(), hashes
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        F.reset()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(400)
+def test_badpeer_acceptance_score_ban_readmit():
+    sig1, hashes1 = run(_badpeer_scenario())
+    sig2, hashes2 = run(_badpeer_scenario())
+    # same seed -> identical fault-log signature across the two runs
+    assert sig1 == sig2
+    corrupts = sorted(s for s in sig1 if s[0] == "p2p.send.corrupt")
+    assert len(corrupts) == BADPEER_MAX_FIRES
+    # every=2 over the BAD node's send stream only (node= selector):
+    # exact call indices, independent of the other nodes' traffic
+    assert [n for _, n, _ in corrupts] == \
+        [2 * k for k in range(1, BADPEER_MAX_FIRES + 1)]
+    assert len(hashes1) >= 5

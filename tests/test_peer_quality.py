@@ -1,0 +1,597 @@
+"""Peer quality subsystem: scorer decay/threshold math, timed addrbook
+bans (+persistence), the Switch-level disconnect → ban → readmission
+lifecycle over a real TCP net, the blocksync double-ban path, the RPC
+admission gate (503 + Retry-After while /status stays up), and mempool
+gossip backpressure."""
+
+import asyncio
+import json
+import time
+
+import msgpack
+import pytest
+
+from cometbft_tpu.p2p.addrbook import AddrBook
+from cometbft_tpu.p2p.quality import EVENT_WEIGHTS, PeerScorer
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------- scorer math
+
+def test_scorer_thresholds_and_actions():
+    s = PeerScorer(disconnect_score=5.0, ban_score=10.0,
+                   half_life_s=1000.0, ban_ttl_s=5.0)
+    # invalid_vote weighs 2.0: two tolerated, third crosses disconnect
+    assert s.report("p1", "invalid_vote") is None
+    assert s.report("p1", "invalid_vote") is None
+    assert s.report("p1", "invalid_vote") == "disconnect"
+    assert s.score("p1") == pytest.approx(6.0, rel=0.01)
+    # two bad blocks (5.0 each) cross the ban threshold
+    assert s.report("p2", "bad_block") == "disconnect"
+    assert s.report("p2", "bad_block") == "ban"
+    assert s.is_banned("p2")
+    assert not s.is_banned("p1")
+    # a ban resets the score: readmission starts clean
+    assert s.score("p2") == 0.0
+
+
+def test_scorer_decay():
+    s = PeerScorer(disconnect_score=5.0, ban_score=10.0,
+                   half_life_s=0.05)
+    s.report("p1", "invalid_vote")
+    s.report("p1", "invalid_vote")
+    assert s.score("p1") > 3.0
+    time.sleep(0.12)                      # > 2 half-lives
+    assert s.score("p1") < 1.5
+    # decayed past the threshold: the same event no longer disconnects
+    assert s.report("p1", "invalid_vote") is None
+
+
+def test_scorer_ban_ttl_escalates_per_repeat():
+    s = PeerScorer(disconnect_score=5.0, ban_score=5.0,
+                   half_life_s=1000.0, ban_ttl_s=10.0, ban_ttl_max_s=25.0)
+    assert s.report("p1", "bad_block") == "ban"
+    assert s._bans["p1"]["ttl_s"] == 10.0
+    assert s.report("p1", "bad_block") == "ban"
+    assert s._bans["p1"]["ttl_s"] == 20.0
+    assert s.report("p1", "bad_block") == "ban"
+    assert s._bans["p1"]["ttl_s"] == 25.0       # capped
+    info = s.peer_info("p1")
+    assert info["ban_count"] == 3
+    bans = s.bans_snapshot()
+    assert bans and bans[0]["node_id"] == "p1"
+
+
+def test_scorer_persistent_peers_never_banned():
+    s = PeerScorer(disconnect_score=2.0, ban_score=4.0,
+                   half_life_s=1000.0)
+    for _ in range(10):
+        action = s.report("pin", "bad_block", persistent=True)
+        assert action == "disconnect"     # never "ban"
+    assert not s.is_banned("pin")
+
+
+def test_scorer_unknown_event_and_ledger_bound():
+    s = PeerScorer(half_life_s=1000.0, max_tracked=4)
+    s.report("px", "brand_new_event")     # DEFAULT_WEIGHT, no crash
+    assert s.score("px") == pytest.approx(1.0, rel=0.01)
+    for i in range(10):
+        s.report(f"peer-{i}", "invalid_tx")
+    assert len(s._peers) <= 4
+
+
+def test_scorer_writes_timed_ban_into_addrbook(tmp_path):
+    book = AddrBook(str(tmp_path / "book.json"))
+    nid = "ab" * 20
+    book.add(nid, "1.2.3.4:26656")
+    s = PeerScorer(addr_book=book, disconnect_score=2.0, ban_score=3.0,
+                   half_life_s=1000.0, ban_ttl_s=0.1)
+    assert s.report(nid, "bad_block") == "ban"
+    assert book.is_banned(nid) and s.is_banned(nid)
+    assert not book.add(nid, "1.2.3.4:26656")    # refused while banned
+    time.sleep(0.12)
+    assert not s.is_banned(nid)                  # TTL expired: readmitted
+    assert book.add(nid, "1.2.3.4:26656")
+
+
+# --------------------------------------------------------- addrbook bans
+
+def nid(i):
+    return f"{i:040d}"
+
+
+def test_addrbook_ban_expires_and_readmits():
+    book = AddrBook()
+    book.mark_bad(nid(1), ttl=0.05)
+    assert book.is_banned(nid(1))
+    assert not book.add(nid(1), "1.1.1.1:1")
+    time.sleep(0.06)
+    assert not book.is_banned(nid(1))
+    assert book.add(nid(1), "1.1.1.1:1")
+
+
+def test_addrbook_ban_expiry_persists_across_restart(tmp_path):
+    path = str(tmp_path / "book.json")
+    book = AddrBook(path)
+    book.mark_bad(nid(1), ttl=3600.0)
+    book.mark_bad(nid(2), ttl=0.01)
+    time.sleep(0.02)
+    book.save()
+    with open(path) as f:
+        raw = json.load(f)
+    # schema: {node_id: expiry}; the already-expired ban is not written
+    assert isinstance(raw["banned"], dict)
+    assert nid(1) in raw["banned"] and nid(2) not in raw["banned"]
+    book2 = AddrBook(path)
+    assert book2.is_banned(nid(1))
+    assert not book2.is_banned(nid(2))
+    assert dict(book2.banned()).keys() == {nid(1)}
+
+
+# ------------------------------------------------- blocksync double ban
+
+def test_blockpool_redo_double_ban_and_refetch():
+    """reactor.py's _RedoBlock path calls redo_request(h) AND
+    redo_request(h+1): BOTH serving peers must be penalized with a
+    bad_block event and both heights re-requested from a fresh peer."""
+    from cometbft_tpu.blocksync.pool import BlockPool
+
+    class Blk:
+        def __init__(self, h):
+            self.header = type("H", (), {"height": h})()
+
+    async def main():
+        requests = []           # (peer_id, height)
+        errors = []             # (peer_id, reason, event)
+        pool = BlockPool(
+            1, lambda p, h: requests.append((p, h)),
+            lambda p, r, e: errors.append((p, r, e)))
+        pool.set_peer_range("A", 1, 10)
+        pool.set_peer_range("B", 1, 10)
+        pool.start()
+        try:
+            # wait for requesters at h1/h2 to pick peers and feed them
+            deadline = time.monotonic() + 5
+            while not ({h for _, h in requests} >= {1, 2}):
+                assert time.monotonic() < deadline, requests
+                await asyncio.sleep(0.01)
+            served = {h: p for p, h in requests}
+            assert served[1] != served[2], \
+                "test needs distinct serving peers"
+            pool.add_block(served[1], Blk(1))
+            pool.add_block(served[2], Blk(2))
+            await asyncio.sleep(0.05)
+            assert len(pool.peek_window(2)) == 2
+
+            # downstream verification failed at h1: double redo
+            requests.clear()
+            pool.set_peer_range("C", 1, 10)   # the fresh peer
+            assert pool.redo_request(1) == served[1]
+            assert pool.redo_request(2) == served[2]
+            # both penalized with the typed bad_block event
+            assert sorted((p, e) for p, _, e in errors) == \
+                sorted([(served[1], "bad_block"), (served[2], "bad_block")])
+            assert served[1] not in pool.peers
+            assert served[2] not in pool.peers
+            # both heights re-requested from the remaining fresh peer
+            deadline = time.monotonic() + 5
+            while not ({h for p, h in requests if p == "C"} >= {1, 2}):
+                assert time.monotonic() < deadline, requests
+                await asyncio.sleep(0.01)
+            assert pool.peek_window(2) == []   # held blocks discarded
+        finally:
+            await pool.stop()
+            await asyncio.sleep(0.05)   # let cancelled requesters settle
+
+    run(main())
+
+
+def test_blockpool_plain_removal_is_not_scored():
+    """A peer that merely disconnects (switch-initiated removal) must
+    not be reported as misbehavior."""
+    from cometbft_tpu.blocksync.pool import BlockPool
+
+    async def main():
+        errors = []
+        pool = BlockPool(1, lambda p, h: None,
+                         lambda p, r, e: errors.append((p, r, e)))
+        pool.set_peer_range("A", 1, 10)
+        pool.remove_peer("A", "peer left")       # event=None default
+        assert errors == []
+
+    run(main())
+
+
+# ------------------------------------------------- reactor event mapping
+
+def test_consensus_reactor_maps_handler_errors_to_events():
+    from cometbft_tpu.consensus.reactor import ConsensusReactor
+    from cometbft_tpu.types.part_set import PartSetError
+    from cometbft_tpu.types.vote_set import VoteSetError
+
+    class StubCS:
+        name = "stub"
+        rs = None
+        state = None
+
+    class StubSwitch:
+        def __init__(self):
+            self.reports = []
+
+        def report_peer(self, pid, event, detail="", **kw):
+            self.reports.append((pid, event))
+
+    async def main():
+        r = ConsensusReactor(StubCS())
+        sw = StubSwitch()
+        r.set_switch(sw)
+        r._on_peer_misbehavior("p1", "vote", VoteSetError("bad sig"))
+        r._on_peer_misbehavior("p1", "part", PartSetError("bad proof"))
+        r._on_peer_misbehavior("p1", "proposal",
+                               VoteSetError("bad proposal sig"))
+        # NON-validation failures raised while processing the message
+        # (app socket flaps, storage hiccups) must NOT blame the sender
+        r._on_peer_misbehavior("p1", "vote", ConnectionResetError())
+        r._on_peer_misbehavior("p1", "vote", ValueError("app burp"))
+        assert [e for _, e in sw.reports] == \
+            ["invalid_vote", "invalid_part", "invalid_proposal"]
+
+    run(main())
+
+
+def test_evidence_reactor_not_applicable_is_not_scored(monkeypatch):
+    from cometbft_tpu.evidence.reactor import EvidenceReactor
+    from cometbft_tpu.types import codec
+    from cometbft_tpu.types.evidence import (EvidenceError,
+                                             EvidenceNotApplicableError)
+    import msgpack as _mp
+
+    class StubPool:
+        on_evidence_added = None
+
+        def __init__(self, exc):
+            self.exc = exc
+
+        def add_evidence(self, ev):
+            raise self.exc
+
+    class StubSwitch:
+        def __init__(self):
+            self.reports = []
+
+        def report_peer(self, pid, event, detail="", **kw):
+            self.reports.append((pid, event))
+
+    class FakePeer:
+        id = "peer-e"
+
+    monkeypatch.setattr(codec, "unpack", lambda b: object())
+    msg = _mp.packb({"@": "ev", "e": b"x"}, use_bin_type=True)
+
+    # expired / below-base / no-state evidence: dropped without blame
+    r = EvidenceReactor(StubPool(EvidenceNotApplicableError("too old")))
+    sw = StubSwitch()
+    r.set_switch(sw)
+    r.receive(0x38, FakePeer(), msg)
+    assert sw.reports == []
+    # actually-invalid evidence: heavy score + disconnect
+    r2 = EvidenceReactor(StubPool(EvidenceError("bad signature")))
+    sw2 = StubSwitch()
+    r2.set_switch(sw2)
+    r2.receive(0x38, FakePeer(), msg)
+    assert sw2.reports == [("peer-e", "bad_evidence")]
+
+
+def test_statesync_sender_ban_feeds_metrics_and_scorer():
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.statesync.syncer import Syncer, _ss_metrics
+
+    class StubSwitch:
+        def __init__(self):
+            self.reports = []
+
+        def report_peer(self, pid, event, detail="", **kw):
+            self.reports.append((pid, event, kw.get("disconnect")))
+
+    class StubReactor:
+        switch = StubSwitch()
+
+    sy = Syncer(None, None, reactor=StubReactor(), name="ssq")
+    before = m.counter("statesync_senders_banned_total").value(node="ssq")
+    sy._note_sender_banned("evil-peer")
+    assert "evil-peer" in sy._banned
+    assert m.counter("statesync_senders_banned_total") \
+        .value(node="ssq") == before + 1
+    assert StubReactor.switch.reports == \
+        [("evil-peer", "bad_snapshot_chunk", True)]
+    assert _ss_metrics().formats_rejected is not None
+
+
+def test_switch_late_report_honors_persistent_exemption():
+    """Misbehavior reports landing AFTER a persistent peer disconnected
+    (queued consensus messages, in-flight CheckTx) must not ban it —
+    the exemption rides the remembered persistent id, not the live
+    Peer object."""
+    from cometbft_tpu.p2p import NodeKey, Switch, Transport
+
+    async def main():
+        sw = Switch(Transport(NodeKey.from_secret(b"late-report"),
+                              lambda: None))
+        pid = "ff" * 20
+        sw._persistent_ids.add(pid)       # as _add_peer(persistent=True)
+        # two bad blocks would ban (5+5 >= 10) a normal peer...
+        assert sw.report_peer(pid, "bad_block") == "disconnect"
+        assert sw.report_peer(pid, "bad_block") == "disconnect"
+        assert not sw.scorer.is_banned(pid)
+        # ...and does ban an unpinned one
+        assert sw.report_peer("aa" * 20, "bad_block") == "disconnect"
+        assert sw.report_peer("aa" * 20, "bad_block") == "ban"
+
+    run(main())
+
+
+# --------------------------------------------------- live-net lifecycle
+
+async def _mk_quality_node(i, doc, pv, *, tweak=None):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+
+    cfg = Config(consensus=test_consensus_config())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.base.signature_backend = "cpu"
+    cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+    if tweak is not None:
+        tweak(cfg)
+    node = await Node.create(
+        doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+        node_key=NodeKey.from_secret(b"pq-%d" % i), name=f"pq{i}")
+    await node.start()
+    return node
+
+
+def test_switch_ban_lifecycle_over_real_net():
+    """report_peer escalation on a live 2-node TCP net: score -> timed
+    ban -> redial refused -> TTL expiry -> readmitted.  Also checks the
+    /net_info quality/bans surfaces and the ban counter."""
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.rpc.core import Environment, net_info
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    pvs = [MockPV.from_secret(b"pq-val-%d" % i) for i in range(2)]
+    doc = GenesisDoc(chain_id="pq-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+
+    def tweak(cfg):
+        # one bad_block (5.0) disconnects, the second bans
+        cfg.p2p.quality_disconnect_score = 4.0
+        cfg.p2p.quality_ban_score = 8.0
+        cfg.p2p.quality_ban_ttl_s = 0.8
+        cfg.p2p.quality_half_life_s = 600.0
+
+    async def main():
+        a = await _mk_quality_node(0, doc, pvs[0], tweak=tweak)
+        b = await _mk_quality_node(1, doc, pvs[1], tweak=tweak)
+        try:
+            await b.switch.dial_peer(a.listen_addr, persistent=False)
+            # wait for A to see B
+            deadline = time.monotonic() + 10
+            while b.node_key.id not in a.switch.peers:
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.02)
+            bid = b.node_key.id
+            bans_before = m.counter("p2p_peer_bans_total").value(
+                node=a.node_key.id[:8], reason="bad_block")
+            # quality visible per-peer in the snapshot
+            snap = a.switch.peer_snapshot()
+            assert all("quality" in p for p in snap)
+
+            a.switch.report_peer(bid, "bad_block", detail="test bad block")
+            assert a.switch.report_peer(
+                bid, "bad_block", detail="again") == "ban"
+            assert a.switch.scorer.is_banned(bid)
+            assert m.counter("p2p_peer_bans_total").value(
+                node=a.node_key.id[:8], reason="bad_block") == \
+                bans_before + 1
+            deadline = time.monotonic() + 10
+            while bid in a.switch.peers:
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.02)
+            # /net_info carries the active ban
+            ni = await net_info(Environment(a))
+            assert any(x["node_id"] == bid for x in ni["bans"])
+            # A refuses the banned peer at the door — outbound (raises
+            # on OUR side) and inbound (B's dial lands no peer on A)
+            with pytest.raises(Exception, match="banned"):
+                await a.switch.dial_peer(b.listen_addr, persistent=False)
+            try:
+                await b.switch.dial_peer(a.listen_addr, persistent=False)
+            except Exception:
+                pass                 # A may close mid-handshake
+            await asyncio.sleep(0.2)
+            assert bid not in a.switch.peers
+            # ... and admitted again once the TTL expires
+            await asyncio.sleep(0.9)
+            assert not a.switch.scorer.is_banned(bid)
+            await a.switch.dial_peer(b.listen_addr, persistent=False)
+            assert bid in a.switch.peers
+        finally:
+            for n in (a, b):
+                try:
+                    await n.stop()
+                except Exception:
+                    pass
+
+    run(main())
+
+
+# ------------------------------------------------------ rpc admission gate
+
+def test_rpc_gate_sheds_503_while_status_stays_up():
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.rpc.server import RPCServer
+
+    release = asyncio.Event()
+
+    async def slow(env):
+        await release.wait()
+        return {"done": True}
+
+    async def fast_status(env):
+        return {"ok": True}
+
+    class StubNode:
+        config = Config()
+        config.rpc.max_concurrent_requests = 1
+        config.rpc.max_queued_requests = 0
+        config.rpc.shed_retry_after_s = 2.0
+
+    async def http_get(host, port, path):
+        r, w = await asyncio.open_connection(host, port)
+        w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                "Connection: close\r\n\r\n".encode())
+        await w.drain()
+        raw = await r.read()
+        w.close()
+        head, _, body = raw.partition(b"\r\n\r\n")
+        lines = head.decode().split("\r\n")
+        status = int(lines[0].split(" ")[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        return status, headers, body
+
+    async def main():
+        srv = RPCServer(StubNode(),
+                        routes={"slow": slow, "status": fast_status})
+        host, port = await srv.listen("127.0.0.1", 0)
+        try:
+            shed_before = m.counter("rpc_requests_shed_total").value()
+            t1 = asyncio.create_task(http_get(host, port, "/slow"))
+            # let the first request occupy the single gate slot
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if srv._gate_active >= 1:
+                    break
+            assert srv._gate_active == 1
+            # second gated request: queue depth 0 -> immediate 503
+            st2, hdr2, body2 = await http_get(host, port, "/slow")
+            assert st2 == 503
+            assert hdr2.get("retry-after") == "2"
+            assert b"overloaded" in body2
+            assert m.counter("rpc_requests_shed_total").value() == \
+                shed_before + 1
+            # the diagnostic route bypasses the gate entirely
+            st3, _, body3 = await http_get(host, port, "/status")
+            assert st3 == 200 and b"ok" in body3
+            release.set()
+            st1, _, _ = await asyncio.wait_for(t1, 10)
+            # gate drained: the next request is admitted again
+            st4, _, _ = await http_get(host, port, "/slow")
+            assert st1 == 200 and st4 == 200
+            assert srv._gate_active == 0
+        finally:
+            await srv.close()
+
+    run(main())
+
+
+# ------------------------------------------------ mempool backpressure
+
+def test_mempool_full_gossip_skips_checktx():
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.mempool.reactor import (MEMPOOL_CHANNEL,
+                                              MempoolReactor)
+
+    class Res:
+        is_ok = True
+        code = 0
+        log = ""
+        gas_wanted = 1
+
+    class CountingApp:
+        def __init__(self):
+            self.calls = 0
+
+        async def check_tx(self, tx, recheck=False):
+            self.calls += 1
+            return Res()
+
+    class FakePeer:
+        id = "peer-x"
+
+    async def main():
+        app = CountingApp()
+        mp = CListMempool(app, max_txs=1, metrics_node="mpq")
+        await mp.check_tx(b"tx-one")            # fill to capacity
+        assert app.calls == 1 and mp.size() == 1
+        reactor = MempoolReactor(mp)
+        skips = m.counter("mempool_gossip_full_skips_total")
+        before = skips.value(node="mpq")
+        reactor.receive(MEMPOOL_CHANNEL, FakePeer(),
+                        msgpack.packb({"txs": [b"tx-two", b"tx-three"]},
+                                      use_bin_type=True))
+        await asyncio.sleep(0.05)               # any spawned task runs
+        assert app.calls == 1, "full mempool must not invoke CheckTx"
+        assert skips.value(node="mpq") == before + 2
+
+    run(main())
+
+
+def test_mempool_invalid_gossip_scores_sender():
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+    from cometbft_tpu.mempool.reactor import (MEMPOOL_CHANNEL,
+                                              MempoolReactor)
+
+    class Res:
+        is_ok = False
+        code = 7
+        log = "nope"
+        gas_wanted = 0
+
+    class RejectingApp:
+        async def check_tx(self, tx, recheck=False):
+            return Res()
+
+    class StubSwitch:
+        def __init__(self):
+            self.reports = []
+
+        def report_peer(self, pid, event, detail="", **kw):
+            self.reports.append((pid, event))
+
+    class FakePeer:
+        id = "peer-y"
+
+    async def main():
+        mp = CListMempool(RejectingApp(), max_txs=100,
+                          metrics_node="mpq2")
+        reactor = MempoolReactor(mp)
+        sw = StubSwitch()
+        reactor.set_switch(sw)
+        reactor.receive(MEMPOOL_CHANNEL, FakePeer(),
+                        msgpack.packb({"txs": [b"bad-tx"]},
+                                      use_bin_type=True))
+        deadline = time.monotonic() + 5
+        while not sw.reports:
+            assert time.monotonic() < deadline
+            await asyncio.sleep(0.01)
+        assert sw.reports == [("peer-y", "invalid_tx")]
+
+    run(main())
