@@ -128,6 +128,13 @@ class BlocksyncReactor(Reactor):
 
     def _serve_block(self, peer, height: int) -> None:
         """reactor.go respondToPeer."""
+        if getattr(self.block_store, "is_dirty", None) is not None and \
+                self.block_store.is_dirty():
+            # salvaged-but-unverified store: salvage can resurrect stale
+            # records, so nothing here may be served until the doctor's
+            # deep verification clears the dirty marker
+            peer.send(BLOCKSYNC_CHANNEL, _pack("nores", h=height))
+            return
         block = self.block_store.load_block(height)
         if block is None:
             peer.send(BLOCKSYNC_CHANNEL, _pack("nores", h=height))

@@ -169,7 +169,8 @@ async def _start_async(args) -> int:
             return 1
         from ..privval.signer import RemoteSignerError
 
-        signer_listener = SignerListener()
+        signer_listener = SignerListener(
+            timeout_s=cfg.base.priv_validator_timeout_s)
         await signer_listener.listen(lhost or "127.0.0.1", int(lport))
         print(f"Waiting for remote signer on "
               f"{cfg.base.priv_validator_laddr} ...")
@@ -387,9 +388,18 @@ def cmd_unsafe_reset_all(args) -> int:
     state_file = _join(home, cfg.base.priv_validator_state_file)
     key_file = _join(home, cfg.base.priv_validator_key_file)
     if os.path.exists(key_file):
-        from ..privval import FilePV
+        from ..privval import FilePV, SignStateError
 
-        pv = FilePV.load(key_file, state_file)
+        try:
+            pv = FilePV.load(key_file, state_file)
+        except SignStateError:
+            # the operator EXPLICITLY asked for the reset: a corrupt
+            # state file must not block the one command whose job is
+            # resetting it (elsewhere that error is a hard refusal)
+            print(f"WARNING: discarding corrupt sign state {state_file}",
+                  file=sys.stderr)
+            os.unlink(state_file)
+            pv = FilePV.load(key_file, state_file)
         pv.height = pv.round = pv.step = 0
         pv.signature = pv.sign_bytes = pv.ext_signature = b""
         pv._save_state()
@@ -569,6 +579,54 @@ def cmd_compact_db(args) -> int:
         print(f"{name}: {before} -> {after} bytes")
     print(f"Reclaimed {total} bytes")
     return 0
+
+
+def cmd_doctor(args) -> int:
+    """Offline storage integrity doctor (node/doctor.py): the boot
+    cross-store consistency check plus an unconditional deep hash-chain
+    scan over the data dir, report-only by default, repairing with
+    ``--repair``.  Exit 0 when healthy (or fully repaired), 1 when
+    problems remain."""
+    from ..node.doctor import StorageDoctor
+    from ..storage import BlockStore, StateStore, open_db
+
+    home = args.home
+    cfg = _load_home(home)
+    lock = _lock_data_dir(home)
+    bs = BlockStore(open_db(cfg.storage.db_backend,
+                            os.path.join(home, "data", "blockstore.db")))
+    ss = StateStore(open_db(cfg.storage.db_backend,
+                            os.path.join(home, "data", "state.db")))
+    try:
+        doctor = StorageDoctor(
+            bs, ss,
+            wal_path=_join(home, cfg.consensus.wal_path),
+            privval_state_path=_join(home,
+                                     cfg.base.priv_validator_state_file),
+            deep_scan_window=cfg.storage.doctor_deep_scan_window)
+        # the offline tool always walks the chain (force_deep):
+        # boot_check sequences it before the WAL-lineage check so a
+        # truncating repair is immediately followed by the matching WAL
+        # quarantine
+        report = doctor.boot_check(repair=args.repair,
+                                   raise_on_refusal=False,
+                                   force_deep=True,
+                                   deep_window=args.window)
+        if args.repair and report.refused is None and \
+                report.deep_scan is not None and report.deep_scan.get("ok"):
+            bs.clear_dirty()
+            fn = getattr(ss.db, "clear_dirty", None)
+            if fn is not None:
+                fn()
+        if report.refused is None and report.deep_scan is not None and \
+                not report.deep_scan.get("ok"):
+            report.ok = False
+    finally:
+        bs.db.close()
+        ss.db.close()
+        lock.release()
+    print(json.dumps(report.to_dict(), indent=2))
+    return 0 if report.ok else 1
 
 
 def cmd_e2e_gen(args) -> int:
@@ -1028,6 +1086,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("compact-db",
                         help="force-compact the data-dir stores")
     sp.set_defaults(fn=cmd_compact_db)
+
+    sp = sub.add_parser("doctor", help="offline storage integrity check: "
+                        "cross-store consistency + deep hash-chain scan "
+                        "(report-only unless --repair)")
+    sp.add_argument("--repair", action="store_true",
+                    help="apply repairs: truncate to the last verified "
+                         "height, rebuild state, quarantine a WAL that "
+                         "ran ahead")
+    sp.add_argument("--window", type=int, default=None,
+                    help="deep-scan window in heights (default: config "
+                         "storage.doctor_deep_scan_window; 0 = whole "
+                         "store)")
+    sp.set_defaults(fn=cmd_doctor)
 
     from .abci import register as register_abci
 

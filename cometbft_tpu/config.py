@@ -175,6 +175,17 @@ class StateSyncConfig:
 class StorageConfig:
     db_backend: str = "logdb"         # logdb | native (C++ engine)
     discard_abci_responses: bool = False
+    # --- storage integrity doctor (node/doctor.py) --------------------
+    # boot-time cross-store consistency check (blockstore vs statestore
+    # vs WAL lineage vs privval last-sign-state) with automatic repair:
+    # ahead stores are rolled back to the max mutually-consistent height
+    # and blocksync re-fetches the difference.  A salvaged (mid-log
+    # corruption) store additionally triggers a deep hash-chain scan.
+    doctor_enable: bool = True
+    # heights the deep scan walks back from the tip verifying the block
+    # hash chain and app-hash lineage (0 = the whole store).  Clamped to
+    # the store base (pruned/statesync'd stores scan what they hold).
+    doctor_deep_scan_window: int = 128
 
 
 @dataclass
@@ -231,6 +242,11 @@ class BaseConfig:
     # remote signer that dials in instead of the file PV
     # (privval/signer_listener_endpoint.go)
     priv_validator_laddr: str = ""
+    # deadline on one remote-signer round trip (seconds; 0 disables).  A
+    # wedged signer process used to block consensus forever; with the
+    # deadline a hang costs one missed vote, a reconnect, and a
+    # privval_signer_timeouts_total tick instead
+    priv_validator_timeout_s: float = 5.0
     abci: str = "builtin"             # builtin | socket
     proxy_app: str = "kvstore"
     signature_backend: str = "auto"   # auto | tpu | jax | cpu  <- TPU seam
@@ -437,6 +453,12 @@ class Config:
         if self.base.vote_sched_verify_timeout_s < 0:
             raise ConfigError(
                 "base.vote_sched_verify_timeout_s must be >= 0")
+        if self.base.priv_validator_timeout_s < 0:
+            raise ConfigError(
+                "base.priv_validator_timeout_s must be >= 0")
+        if self.storage.doctor_deep_scan_window < 0:
+            raise ConfigError(
+                "storage.doctor_deep_scan_window must be >= 0")
         if self.chaos.log_size < 16:
             raise ConfigError("chaos.log_size must be >= 16")
         if self.chaos.enable:

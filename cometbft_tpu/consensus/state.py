@@ -360,9 +360,13 @@ class ConsensusState:
         rather than a transient handler error (count and continue).
         Provenance first — a dead WAL handle is definitive — then the
         storage errno class."""
+        from ..privval.file import SignStateError
         from .wal import WALError
 
-        if isinstance(e, WALError):
+        if isinstance(e, (WALError, SignStateError)):
+            # a sign-state persist failure is the same fsyncgate class:
+            # the double-sign guard on disk may not reflect memory, so
+            # signing anything further is unsafe until restart
             return True
         if isinstance(e, OSError):
             if self.wal is not None and \
@@ -664,6 +668,8 @@ class ConsensusState:
             await self.priv_validator.sign_proposal(self.state.chain_id,
                                                     proposal)
         except Exception as e:
+            if self._is_fatal_io_error(e):
+                raise        # privval fsyncgate: halt, see _sign_add_vote
             # a refusing signer skips the proposal, it does not crash the
             # round (defaultDecideProposal logs and returns on sign error)
             self.log.warn("sign_proposal refused", err=repr(e))
@@ -784,6 +790,41 @@ class ConsensusState:
         rs.step = STEP_PREVOTE
         self._note_round_step()
         await self._do_prevote(height, round_)
+        await self._recheck_step_thresholds()
+
+    async def _recheck_step_thresholds(self) -> None:
+        """Level-triggered catch-up for a validator that (re)enters a
+        step AFTER the round's 2/3 threshold was already crossed — a
+        mid-round restart rejoining a wedged height (the storage
+        doctor's repair-then-refetch path ends exactly here), or a
+        blocksync handoff into a live round.  Every transition below is
+        normally edge-triggered from ``_on_{prevote,precommit}_added``;
+        when the deciding votes landed while we were still in an
+        earlier step — and our own (re)vote de-duplicates away because
+        the privval returns the stored signature — no vote-add edge
+        will ever fire them again."""
+        rs = self.rs
+        if rs.step == STEP_PREVOTE:
+            prevotes = rs.votes.prevotes(rs.round)
+            if prevotes is not None:
+                maj, has_maj = prevotes.two_thirds_majority()
+                if has_maj and maj is not None and \
+                        (rs.proposal_complete() or maj.is_nil()):
+                    await self._enter_precommit(rs.height, rs.round)
+                elif prevotes.has_two_thirds_any():
+                    await self._enter_prevote_wait(rs.height, rs.round)
+        if rs.step == STEP_PRECOMMIT:
+            precommits = rs.votes.precommits(rs.round)
+            if precommits is None:
+                return
+            maj, has_maj = precommits.two_thirds_majority()
+            if has_maj and maj is not None:
+                if not maj.is_nil():
+                    await self._enter_commit(rs.height, rs.round)
+                else:
+                    await self._enter_precommit_wait(rs.height, rs.round)
+            elif precommits.has_two_thirds_any():
+                await self._enter_precommit_wait(rs.height, rs.round)
 
     async def _do_prevote(self, height: int, round_: int) -> None:
         """state.go:1380 defaultDoPrevote."""
@@ -858,6 +899,11 @@ class ConsensusState:
             return
         rs.step = STEP_PRECOMMIT
         self._note_round_step()
+        await self._do_precommit(height, round_)
+        await self._recheck_step_thresholds()
+
+    async def _do_precommit(self, height: int, round_: int) -> None:
+        rs = self.rs
         prevotes = rs.votes.prevotes(round_)
         maj, has_maj = (prevotes.two_thirds_majority()
                         if prevotes else (None, False))
@@ -1016,9 +1062,15 @@ class ConsensusState:
             await self.priv_validator.sign_vote(self.state.chain_id, vote,
                                                 sign_extension=sign_ext)
         except Exception as e:
-            # a refusing signer (double-sign protection) must not crash the
-            # state machine: skip the vote like the reference (state.go
-            # signAddVote logs and returns on sign error)
+            if self._is_fatal_io_error(e):
+                # the sign-state file failed to persist (privval
+                # fsyncgate): the signature was NOT released, and no
+                # further signature may be — halt, don't skip-and-retry
+                raise
+            # a refusing signer (double-sign protection) or a timed-out
+            # remote signer must not crash the state machine: skip the
+            # vote like the reference (state.go signAddVote logs and
+            # returns on sign error)
             self.log.warn("sign_vote refused", err=repr(e))
             return
         await self._handle("vote", vote, "", replay=False)
@@ -1119,23 +1171,30 @@ class ConsensusState:
 
     async def _on_precommit_added(self, vote: Vote) -> None:
         rs = self.rs
+        # snapshot the height THIS vote belongs to: any transition call
+        # below may cascade clear through commit into the next height
+        # (``_enter_precommit`` runs the level-triggered threshold
+        # re-check), and a follow-up call made with the live ``rs.height``
+        # would then target the NEW height with this height's round —
+        # passing its guard and corrupting the fresh round's state
+        h = rs.height
         precommits = rs.votes.precommits(vote.round)
         maj, has_maj = precommits.two_thirds_majority()
         if has_maj and maj is not None:
-            await self._enter_new_round(rs.height, vote.round)
-            await self._enter_precommit(rs.height, vote.round)
+            await self._enter_new_round(h, vote.round)
+            await self._enter_precommit(h, vote.round)
             if not maj.is_nil():
-                await self._enter_commit(rs.height, vote.round)
+                await self._enter_commit(h, vote.round)
                 # every precommit already in: start the next height now
                 # (state.go:2489 skipTimeoutCommit)
                 if self._skip_timeout_commit() and precommits.has_all():
                     await self._enter_new_round(self.rs.height, 0)
             else:
-                await self._enter_precommit_wait(rs.height, vote.round)
+                await self._enter_precommit_wait(h, vote.round)
         elif precommits.has_two_thirds_any():
             if vote.round >= rs.round:
-                await self._enter_new_round(rs.height, vote.round)
-                await self._enter_precommit_wait(rs.height, vote.round)
+                await self._enter_new_round(h, vote.round)
+                await self._enter_precommit_wait(h, vote.round)
 
 
 # --------------------------------------------------------- WAL wire helpers

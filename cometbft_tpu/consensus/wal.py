@@ -118,6 +118,44 @@ def iter_wal_records_readonly(path: str):
                            f"not decoded")
 
 
+def last_end_height(path: str) -> int | None:
+    """Read-only: the last EndHeight sentinel across all segments (the
+    storage doctor's WAL-lineage anchor).  Stops at the first corruption
+    like replay does — records past a corrupt span are unreachable by
+    any replay, so their sentinels must not anchor anything."""
+    last = None
+    for seg in wal_segments(path):
+        clean = False
+        for item in _iter_segment_file(seg):
+            if isinstance(item, bool):
+                clean = item
+                break
+            if item.get("#") == "endheight":
+                last = item["h"]
+        if not clean:
+            break
+    return last
+
+
+def quarantine_wal(path: str) -> list[str]:
+    """Move every WAL segment aside (``<seg>.quarantine``), returning
+    the new paths.  Used by the storage doctor when the WAL's lineage
+    runs AHEAD of the (repaired) stores: replaying records for heights
+    the stores no longer hold would feed consensus a stream from a
+    discarded timeline.  Double-sign safety does not depend on the WAL —
+    the privval last-sign-state survives untouched."""
+    moved = []
+    for seg in wal_segments(path):
+        dst = seg + ".quarantine"
+        i = 0
+        while os.path.exists(dst):
+            i += 1
+            dst = f"{seg}.quarantine.{i}"
+        os.replace(seg, dst)
+        moved.append(dst)
+    return moved
+
+
 class WAL:
     def __init__(self, path: str,
                  max_segment_bytes: int = DEFAULT_SEGMENT_BYTES):

@@ -59,14 +59,16 @@ _CONF_LOCK = threading.Lock()
 
 # rule keys with non-float values, everything else in a spec parses as
 # float (``prob=0.02``) with int-preservation (``at=40`` stays an int)
-_STR_KEYS = ("cut", "chan", "mode", "node")
+_STR_KEYS = ("cut", "chan", "mode", "node", "file")
 # str params that act as SELECTORS when present on a rule: the site
 # only counts/fires calls whose `detail` carries the same value, so
 # "p2p.send.corrupt:node=bad0:every=3" arms ONE node's links in an
-# in-proc ensemble and "chan=vote" one channel's packets.  Calls that
-# don't match don't advance the call index — the schedule is a pure
-# function of the MATCHING stream.
-_SELECTOR_KEYS = ("chan", "node")
+# in-proc ensemble, "chan=vote" one channel's packets, and
+# "db.replay.corrupt:file=blockstore.db" one store's log among the
+# several LogDB files a node opens.  Calls that don't match don't
+# advance the call index — the schedule is a pure function of the
+# MATCHING stream.
+_SELECTOR_KEYS = ("chan", "node", "file")
 
 
 class FaultSpecError(ValueError):

@@ -109,6 +109,7 @@ class Node:
         self.statesync_done = None
         self.statesync_error = None
         self.name = "node"
+        self.doctor_report = None
         self._started = False
         self._data_lock = None
         self._vote_sched = None
@@ -132,6 +133,15 @@ class Node:
         self.config = cfg
         self.genesis = genesis_doc
 
+        # arm the fault-injection plane BEFORE the stores open: sites
+        # that fire at open time (db.replay.corrupt feeding the salvage
+        # + doctor pipeline) must see a subprocess node's CMT_CHAOS env
+        # — start() would be too late (same process-wide/sticky
+        # discipline as tracing)
+        from ..libs import failures as _failures
+
+        _failures.configure_from_config(cfg.chaos)
+
         from ..storage import open_db
 
         def make_db(filename: str):
@@ -147,13 +157,43 @@ class Node:
             # refuse to double-open a home (and make offline tooling
             # refuse while this node runs)
             self._data_lock = DataDirLock(os.path.join(home, "data"))
-            wal = WAL(os.path.join(home, "data", "cs.wal"))
+            wal_path = os.path.join(home, "data", "cs.wal")
         else:
-            wal = None
+            wal_path = None
         bs_db = make_db("blockstore.db")
         ss_db = make_db("state.db")
         self.block_store = BlockStore(bs_db)
         self.state_store = StateStore(ss_db)
+
+        # storage integrity doctor: cross-store boot consistency (+ the
+        # deep hash-chain scan when a store was salvaged) BEFORE the WAL
+        # opens — a repair may quarantine WAL segments, and the check
+        # must see the on-disk lineage, not a fresh append handle.
+        # Raises DoctorError on the dangerous cases (privval sign state
+        # ahead of a clean store = double-sign tripwire).
+        if cfg.storage.doctor_enable:
+            from .doctor import DoctorError, StorageDoctor
+
+            try:
+                self.doctor_report = StorageDoctor(
+                    self.block_store, self.state_store, wal_path=wal_path,
+                    priv_validator=priv_validator,
+                    deep_scan_window=cfg.storage.doctor_deep_scan_window,
+                    name=name).boot_check(repair=True)
+            except DoctorError:
+                # refusal: close the store handles and release the home
+                # so inspect mode / the doctor CLI (and a fixed retry)
+                # can open it without racing two live append handles
+                for db_ in (bs_db, ss_db):
+                    try:
+                        db_.close()
+                    except Exception:
+                        pass
+                if self._data_lock is not None:
+                    self._data_lock.release()
+                    self._data_lock = None
+                raise
+        wal = WAL(wal_path) if wal_path is not None else None
 
         state = self.state_store.load() or State.from_genesis(genesis_doc)
 
@@ -383,12 +423,8 @@ class Node:
             _tracing.configure(
                 enabled=True,
                 ring_size=self.config.instrumentation.tracing_ring_size)
-        # arm the fault-injection plane before any subsystem runs its
-        # first instrumented operation (same process-wide/sticky
-        # discipline as tracing; CMT_CHAOS env overrides the section)
-        from ..libs import failures as _failures
-
-        _failures.configure_from_config(self.config.chaos)
+        # (the fault-injection plane was armed in create(), before the
+        # stores opened — open-time sites must see the schedule)
         host, port = _parse_laddr(self.config.p2p.laddr) \
             if self.config.p2p.laddr else ("127.0.0.1", 0)
         self.listen_addr = await self.transport.listen(host, port)

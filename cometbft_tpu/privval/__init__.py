@@ -1,5 +1,6 @@
-from .file import DoubleSignError, FilePV
-from .signer import RemoteSignerError, SignerClient, SignerServer
+from .file import DoubleSignError, FilePV, SignStateError
+from .signer import (RemoteSignerError, SignerClient, SignerServer,
+                     SignerTimeoutError)
 
-__all__ = ["FilePV", "DoubleSignError", "SignerClient", "SignerServer",
-           "RemoteSignerError"]
+__all__ = ["FilePV", "DoubleSignError", "SignStateError", "SignerClient",
+           "SignerServer", "RemoteSignerError", "SignerTimeoutError"]

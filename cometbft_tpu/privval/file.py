@@ -13,11 +13,13 @@ timestamp; anything else is refused."""
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 
 from ..crypto.keys import (PubKey, gen_priv_key,
                            priv_key_from_type_bytes)
+from ..libs import failures
 from ..types.canonical import canonical_vote_sign_bytes
 from ..types.priv_validator import PrivValidator
 from ..types.vote import PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote
@@ -33,6 +35,16 @@ class DoubleSignError(Exception):
     """Refusal to sign: would conflict with the last signed state."""
 
 
+class SignStateError(Exception):
+    """The last-sign-state file is unreadable, incomplete, or its handle
+    went dead after an IO failure.  NEVER auto-reset or delete the state
+    file to clear this: the last-sign state is the only thing standing
+    between a restarted validator and equivocation — resetting sign
+    state is how validators double-sign.  Restore the file from a
+    backup, or keep the validator offline until you can prove what this
+    key last signed."""
+
+
 class FilePV(PrivValidator):
     def __init__(self, priv_key, key_path: str,
                  state_path: str):
@@ -46,6 +58,11 @@ class FilePV(PrivValidator):
         self.signature = b""
         self.sign_bytes = b""
         self.ext_signature = b""
+        # fsyncgate for the sign-state file: after one failed persist the
+        # on-disk state may not reflect memory — every further sign
+        # attempt must refuse (recovery is an operator restart, which
+        # re-reads the file that DID land)
+        self._io_failed: Exception | None = None
 
     # ------------------------------------------------------------- file io
 
@@ -80,14 +97,26 @@ class FilePV(PrivValidator):
                                           bytes.fromhex(kd["priv_key"])),
                  key_path, state_path)
         if os.path.exists(state_path):
-            with open(state_path) as f:
-                sd = json.load(f)
-            pv.height = sd["height"]
-            pv.round = sd["round"]
-            pv.step = sd["step"]
-            pv.signature = bytes.fromhex(sd.get("signature", ""))
-            pv.sign_bytes = bytes.fromhex(sd.get("signbytes", ""))
-            pv.ext_signature = bytes.fromhex(sd.get("ext_signature", ""))
+            # a corrupt/truncated state file must be a TYPED refusal with
+            # the never-auto-reset warning, not a raw JSONDecodeError an
+            # operator might "fix" with unsafe-reset-all
+            try:
+                with open(state_path) as f:
+                    sd = json.load(f)
+                pv.height = int(sd["height"])
+                pv.round = int(sd["round"])
+                pv.step = int(sd["step"])
+                pv.signature = bytes.fromhex(sd.get("signature", ""))
+                pv.sign_bytes = bytes.fromhex(sd.get("signbytes", ""))
+                pv.ext_signature = bytes.fromhex(sd.get("ext_signature", ""))
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                raise SignStateError(
+                    f"priv_validator state file {state_path!r} is corrupt, "
+                    f"truncated, or unreadable ({e!r}).  Do NOT reset or "
+                    "delete it — resetting sign state is how validators "
+                    "double-sign.  Restore the file (or its permissions) "
+                    "from a backup, or keep this validator offline until "
+                    "you can prove what this key last signed.") from e
         return pv
 
     @classmethod
@@ -106,17 +135,37 @@ class FilePV(PrivValidator):
             "priv_key": self.priv_key.bytes().hex(),
         })
 
+    def _check_alive(self) -> None:
+        if self._io_failed is not None:
+            raise SignStateError(
+                "priv_validator sign state failed to persist earlier; "
+                "refusing every further signature until restart (the "
+                "on-disk state may not reflect memory)") \
+                from self._io_failed
+
     def _save_state(self) -> None:
         """fsync'd BEFORE the signature leaves this process (file.go:332
-        'signature is saved to disk before it is returned')."""
-        _atomic_write_json(self.state_path, {
-            "height": self.height,
-            "round": self.round,
-            "step": self.step,
-            "signature": self.signature.hex(),
-            "signbytes": self.sign_bytes.hex(),
-            "ext_signature": self.ext_signature.hex(),
-        })
+        'signature is saved to disk before it is returned').  An IO
+        failure here must NOT release the signature — the caller sees
+        the raised OSError before any signature is assigned to the vote
+        or proposal, and this handle goes dead (fsyncgate)."""
+        self._check_alive()
+        try:
+            fired = failures.fire("privval.state.fsync.eio")
+            if fired is not None:
+                raise OSError(
+                    errno.EIO, "chaos: injected privval state fsync EIO")
+            _atomic_write_json(self.state_path, {
+                "height": self.height,
+                "round": self.round,
+                "step": self.step,
+                "signature": self.signature.hex(),
+                "signbytes": self.sign_bytes.hex(),
+                "ext_signature": self.ext_signature.hex(),
+            })
+        except OSError as e:
+            self._io_failed = e
+            raise
 
     # ------------------------------------------------------------- signing
 
@@ -144,6 +193,7 @@ class FilePV(PrivValidator):
 
     async def sign_vote(self, chain_id: str, vote: Vote,
                         sign_extension: bool) -> None:
+        self._check_alive()
         self._check_bls_backend()
         step = _VOTE_STEP[vote.type]
         same_hrs = self._check_hrs(vote.height, vote.round, step)
@@ -175,6 +225,7 @@ class FilePV(PrivValidator):
             vote.extension_signature = ext_sig
 
     async def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        self._check_alive()
         self._check_bls_backend()
         same_hrs = self._check_hrs(proposal.height, proposal.round,
                                    STEP_PROPOSE)

@@ -13,22 +13,43 @@ SignedProposalResponse, Ping/Pong; errors travel as {"err": ...} replies
 from __future__ import annotations
 
 import asyncio
+import functools
 import struct
 
 import msgpack
 
 from ..crypto.keys import (ED25519_KEY_TYPE, PubKey,
                            pub_key_from_type_bytes)
+from ..libs import failures
 from ..types import codec
 from ..types.priv_validator import PrivValidator
 from ..types.vote import Proposal, Vote
 
 _LEN = struct.Struct("<I")
 MAX_MSG = 1 << 20
+# default bound on one signer round trip (config base.priv_validator_
+# timeout_s overrides; 0 disables).  A wedged signer process used to
+# block consensus FOREVER — with the deadline it costs one missed vote
+# and a reconnect instead.
+DEFAULT_ROUND_TRIP_TIMEOUT_S = 5.0
 
 
 class RemoteSignerError(Exception):
     pass
+
+
+class SignerTimeoutError(RemoteSignerError):
+    """One round trip exceeded the deadline: the signer is wedged or the
+    link is black-holing.  The listener treats this exactly like a
+    dropped connection (close + re-accept the signer's redial)."""
+
+
+@functools.cache
+def _signer_metrics():
+    from ..libs import metrics as m
+
+    return m.counter("privval_signer_timeouts_total",
+                     "remote-signer round trips abandoned on deadline")
 
 
 async def _send(writer: asyncio.StreamWriter, obj: dict) -> None:
@@ -102,19 +123,25 @@ class SignerClient(PrivValidator):
     """Node-side PrivValidator backed by a remote SignerServer."""
 
     def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter, pub_key: PubKey):
+                 writer: asyncio.StreamWriter, pub_key: PubKey,
+                 timeout_s: float = DEFAULT_ROUND_TRIP_TIMEOUT_S):
         self._reader = reader
         self._writer = writer
         self._pub_key = pub_key
         self._lock = asyncio.Lock()      # one in-flight request at a time
+        self.timeout_s = timeout_s
 
     @classmethod
-    async def connect(cls, host: str, port: int) -> "SignerClient":
+    async def connect(cls, host: str, port: int,
+                      timeout_s: float = DEFAULT_ROUND_TRIP_TIMEOUT_S
+                      ) -> "SignerClient":
         reader, writer = await asyncio.open_connection(host, port)
-        return await cls.from_streams(reader, writer)
+        return await cls.from_streams(reader, writer, timeout_s=timeout_s)
 
     @classmethod
-    async def from_streams(cls, reader, writer) -> "SignerClient":
+    async def from_streams(cls, reader, writer,
+                           timeout_s: float = DEFAULT_ROUND_TRIP_TIMEOUT_S
+                           ) -> "SignerClient":
         """Handshake over an already-open connection (either dial
         direction ends up here)."""
         await _send(writer, {"@": "pubkey_req"})
@@ -123,15 +150,36 @@ class SignerClient(PrivValidator):
             raise RemoteSignerError(f"bad pubkey response: {res}")
         pub = pub_key_from_type_bytes(res.get("type", ED25519_KEY_TYPE),
                                       res["pub"])
-        return cls(reader, writer, pub)
+        return cls(reader, writer, pub, timeout_s=timeout_s)
 
     async def close(self) -> None:
         self._writer.close()
 
     async def _round_trip(self, req: dict) -> dict:
-        async with self._lock:
-            await _send(self._writer, req)
-            res = await _recv(self._reader)
+        """One request/response, bounded by ``timeout_s`` (covering lock
+        wait, send, and receive: a request wedged behind another wedged
+        request must time out too, not queue forever)."""
+
+        async def go() -> dict:
+            async with self._lock:
+                fired = failures.fire("signer.round_trip.hang")
+                if fired is not None:
+                    # chaos: the signer process is wedged — nothing comes
+                    # back until (long after) the deadline
+                    await asyncio.sleep(float(fired.get("delay", 3600.0)))
+                await _send(self._writer, req)
+                return await _recv(self._reader)
+
+        if self.timeout_s and self.timeout_s > 0:
+            try:
+                res = await asyncio.wait_for(go(), self.timeout_s)
+            except asyncio.TimeoutError:
+                _signer_metrics().inc()
+                raise SignerTimeoutError(
+                    f"remote signer did not answer within "
+                    f"{self.timeout_s}s") from None
+        else:
+            res = await go()
         if res.get("@") == "err":
             raise RemoteSignerError(res.get("msg", "remote signer error"))
         return res
@@ -171,11 +219,13 @@ class SignerListener(PrivValidator):
     the signer's redial (the reference endpoint's WaitForConnection), so
     a signer restart does not halt the validator."""
 
-    def __init__(self, accept_timeout: float = 30.0):
+    def __init__(self, accept_timeout: float = 30.0,
+                 timeout_s: float = DEFAULT_ROUND_TRIP_TIMEOUT_S):
         self._server: asyncio.Server | None = None
         self._accepted: asyncio.Queue = asyncio.Queue()
         self._client: SignerClient | None = None
         self._accept_timeout = accept_timeout
+        self._timeout_s = timeout_s
         self._lock = asyncio.Lock()
 
     async def listen(self, host: str = "127.0.0.1",
@@ -206,7 +256,8 @@ class SignerListener(PrivValidator):
                     "timed out waiting for the remote signer to connect")
             try:
                 self._client = await asyncio.wait_for(
-                    SignerClient.from_streams(reader, writer),
+                    SignerClient.from_streams(reader, writer,
+                                              timeout_s=self._timeout_s),
                     min(5.0, max(0.1, remaining)))
                 return self._client
             except Exception:
@@ -219,14 +270,18 @@ class SignerListener(PrivValidator):
         return await self.wait_for_signer()
 
     async def _with_signer(self, op):
-        """Run op against the live client; on a dropped connection,
-        re-accept the signer's redial and retry once."""
+        """Run op against the live client; on a dropped connection OR a
+        round-trip timeout (a wedged signer is indistinguishable from a
+        dead link, and the abandoned request leaves the stream
+        unframed), close + re-accept the signer's redial and retry
+        once."""
         async with self._lock:
             if self._client is None:
                 await self.wait_for_signer()
             try:
                 return await op(self._client)
-            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            except (asyncio.IncompleteReadError, ConnectionError,
+                    SignerTimeoutError, OSError):
                 await self._reconnect()
                 return await op(self._client)
 

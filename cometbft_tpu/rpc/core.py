@@ -108,6 +108,12 @@ async def status(env: Environment) -> dict:
             "pub_key": pv.get_pub_key().bytes().hex() if pv else "",
         },
         "consensus_info": consensus_info,
+        # storage-doctor boot report (node/doctor.py): what the boot
+        # consistency check found and repaired; also served by inspect
+        # mode, where it is the post-mortem's first stop
+        "doctor": (node.doctor_report.to_dict()
+                   if getattr(node, "doctor_report", None) is not None
+                   else None),
     }
 
 

@@ -45,6 +45,27 @@ class InspectNode:
                 backend, os.path.join(home, "data", "tx_index.db")))
             self.block_indexer = BlockIndexer(open_db(
                 backend, os.path.join(home, "data", "block_index.db")))
+        # report-only storage-doctor pass: a crashed node's store
+        # inconsistency is exactly what inspect mode is for — never
+        # repairs, never refuses (the report carries the refusal text)
+        self.doctor_report = None
+        try:
+            from ..node.doctor import StorageDoctor
+
+            self.doctor_report = StorageDoctor(
+                self.block_store, self.state_store,
+                wal_path=os.path.join(home, config.consensus.wal_path)
+                if not os.path.isabs(config.consensus.wal_path)
+                else config.consensus.wal_path,
+                privval_state_path=os.path.join(
+                    home, config.base.priv_validator_state_file)
+                if not os.path.isabs(config.base.priv_validator_state_file)
+                else config.base.priv_validator_state_file,
+                deep_scan_window=config.storage.doctor_deep_scan_window,
+                name=name).boot_check(repair=False,
+                                      raise_on_refusal=False)
+        except Exception:
+            pass             # inspect must come up on ANY data dir
         # live-only surfaces: a falsy shim — `if node.consensus` guards
         # degrade gracefully, direct attribute access errors loudly
         self.consensus = _NoLiveSubsystem()

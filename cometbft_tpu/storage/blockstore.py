@@ -140,6 +140,43 @@ class BlockStore:
         self._save_bookkeeping()
         return pruned
 
+    def truncate_above(self, height: int) -> int:
+        """Remove every block above ``height`` (storage-doctor repair:
+        the tip region failed verification, blocksync re-fetches it).
+        Missing per-height records are tolerated — a salvaged store may
+        have lost exactly the records being truncated.  Returns the
+        number of heights removed."""
+        if height < 0 or (self._height and height > self._height):
+            raise ValueError(
+                f"cannot truncate to {height}: store at {self._height}")
+        removed = 0
+        while self._height > height:
+            h = self._height
+            for prefix in (K_BLOCK, K_META, K_COMMIT, K_EXT_COMMIT):
+                self.db.delete(_hkey(prefix, h))
+            self._height = h - 1
+            removed += 1
+        if self._height == 0:
+            self._base = 0
+        elif self._base > self._height:
+            self._base = self._height
+        if removed:
+            self._save_bookkeeping()
+        return removed
+
+    def is_dirty(self) -> bool:
+        """True when the backing store was salvaged after mid-log
+        corruption and the doctor's deep verification has not yet passed
+        — a dirty store must not serve blocks (salvage can resurrect
+        stale records)."""
+        fn = getattr(self.db, "is_dirty", None)
+        return bool(fn is not None and fn())
+
+    def clear_dirty(self) -> None:
+        fn = getattr(self.db, "clear_dirty", None)
+        if fn is not None:
+            fn()
+
     def remove_tip(self) -> None:
         """Delete the highest block (rollback --hard support; the
         reference pairs state/rollback.go with store.DeleteLatestBlock)."""

@@ -130,6 +130,13 @@ class StateStore:
             self.save_validators(state.last_block_height,
                                  state.last_validators)
 
+    def clear_state(self) -> None:
+        """Drop the latest-state snapshot (storage-doctor last resort:
+        no verified height remained, so the node restarts from genesis
+        and resyncs).  Per-height records are left in place — they are
+        overwritten as heights are re-applied."""
+        self.db.delete(K_STATE)
+
     # ----------------------------------------- validators/params by height
 
     def save_validators(self, height: int, vals: ValidatorSet) -> None:

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""CI doctor smoke: seeded mid-log blockstore corruption on a live
+2-validator net, single run —
+
+- commit to a target height, stop the victim (a REAL FilePV validator),
+- arm ``db.replay.corrupt`` (seeded bit-flip on the next blockstore
+  open, file-selected so the other stores are untouched),
+- restart the victim: LogDB salvage quarantines the corrupt span and
+  marks the store dirty, the storage doctor's deep hash-chain scan
+  gates it (truncating to the last verified height when the flip hit a
+  live chain record) and clears the dirty marker,
+- blocksync re-fetches, consensus rejoins (the level-triggered step
+  re-check + the FilePV's stored-signature replay make the mid-round
+  rejoin equivocation-free), both nodes advance,
+- every common height is fork-free and the fault log carries exactly
+  the seeded injection at call index 1.
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow next to smoke_chaos/smoke_badpeer; runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_doctor.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TARGET_HEIGHT = 5
+SEED = 77010
+SPEC = "db.replay.corrupt:file=blockstore.db:at=1:frac=0.5"
+
+
+async def mk_node(doc, pv, home, name, fast_sync=False):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+
+    cfg = Config(consensus=test_consensus_config())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.base.signature_backend = "cpu"
+    cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+    node = await Node.create(
+        doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+        node_key=NodeKey.from_secret(name.encode()), home=home, name=name,
+        fast_sync=fast_sync)
+    await node.start()
+    return node
+
+
+async def wait_heights(nodes, target, budget, what):
+    deadline = time.monotonic() + budget
+    while not all(n.height() >= target for n in nodes):
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"{what}: stuck below {target}: "
+                f"{[n.height() for n in nodes]}")
+        await asyncio.sleep(0.1)
+
+
+async def main_async(base_dir: str) -> None:
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    F.reset()
+    victim_home = os.path.join(base_dir, "victim")
+    key_path = os.path.join(base_dir, "victim_key.json")
+    state_path = os.path.join(victim_home, "data",
+                              "priv_validator_state.json")
+    good_pv = MockPV.from_secret(b"doctor-smoke-good")
+    victim_pv = FilePV.generate(key_path, state_path)
+    doc = GenesisDoc(chain_id="doctor-smoke-net",
+                     validators=[GenesisValidator(good_pv.get_pub_key(), 10),
+                                 GenesisValidator(victim_pv.get_pub_key(),
+                                                  10)])
+    good = await mk_node(doc, good_pv, None, "ds-good")
+    victim = await mk_node(doc, victim_pv, victim_home, "ds-victim")
+    nodes = [good, victim]
+    try:
+        await good.dial_peer(victim.listen_addr, persistent=True)
+        await wait_heights(nodes, TARGET_HEIGHT, 20, "initial commit")
+        h_stop = victim.height()
+        await victim.stop()
+
+        F.configure(enabled=True, seed=SEED, faults=[SPEC])
+        victim = await mk_node(doc, FilePV.load(key_path, state_path),
+                               victim_home, "ds-victim", fast_sync=True)
+        nodes[1] = victim
+        rep = victim.doctor_report.to_dict()
+        salv = rep["salvage"].get("blockstore", {})
+        if not salv.get("salvaged_this_open"):
+            raise RuntimeError(f"salvage never fired: {rep}")
+        if rep["deep_scan"] is None or not rep["ok"]:
+            raise RuntimeError(f"doctor did not gate the salvage: {rep}")
+        if victim.block_store.is_dirty():
+            raise RuntimeError("dirty marker survived a passing deep scan")
+
+        await victim.dial_peer(good.listen_addr, persistent=True)
+        await wait_heights(nodes, h_stop + 2, 25, "post-repair catch-up")
+        if victim.consensus.fatal_error is not None:
+            raise RuntimeError(
+                f"victim went fatal: {victim.consensus.fatal_error!r}")
+
+        common = min(n.height() for n in nodes)
+        for h in range(1, common + 1):
+            hs = {n.block_store.load_block(h).hash() for n in nodes
+                  if n.block_store.load_block(h) is not None}
+            if len(hs) != 1:
+                raise RuntimeError(f"fork at height {h}: {hs}")
+        sig = F.signature()
+        if sig != [("db.replay.corrupt", 1, 1)]:
+            raise RuntimeError(f"fault schedule drifted: {sig}")
+        trunc = rep["deep_scan"].get("truncated_to")
+        print(f"doctor smoke ok: salvage span {salv.get('spans')}, "
+              f"{'truncated to ' + str(trunc) if trunc is not None else 'chain verified intact'}, "
+              f"{common} common heights fork-free, seeded injection at "
+              f"call index 1")
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        F.reset()
+
+
+def main() -> int:
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="doctor-smoke-")
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(main_async(base))
+    except RuntimeError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        loop.close()
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -354,7 +354,7 @@ def _genesis(n, chain_id, secret=b"chaos"):
 
 
 async def _mk_node(doc, pv, i, *, home=None, watchdog=False,
-                   name_prefix="chaos", tweak=None):
+                   name_prefix="chaos", tweak=None, fast_sync=False):
     from cometbft_tpu.abci.kvstore import KVStoreApplication
     from cometbft_tpu.config import Config, test_consensus_config
     from cometbft_tpu.node import Node
@@ -374,7 +374,7 @@ async def _mk_node(doc, pv, i, *, home=None, watchdog=False,
     node = await Node.create(
         doc, KVStoreApplication(), priv_validator=pv, config=cfg,
         node_key=NodeKey.from_secret(b"%s-%d" % (name_prefix.encode(), i)),
-        home=home, name=f"{name_prefix}{i}")
+        home=home, name=f"{name_prefix}{i}", fast_sync=fast_sync)
     await node.start()
     return node
 
@@ -720,3 +720,173 @@ def test_badpeer_acceptance_score_ban_readmit():
     assert [n for _, n, _ in corrupts] == \
         [2 * k for k in range(1, BADPEER_MAX_FIRES + 1)]
     assert len(hashes1) >= 5
+
+
+# --------------------------------------------------------------------------
+# PR 10: storage integrity doctor + privval/signer hardening
+
+
+@pytest.mark.timeout(120)
+def test_privval_state_eio_halts_fatally_with_bundle(tmp_path):
+    """The privval fsyncgate satellite (via ``privval.state.fsync.eio``):
+    a failed sign-state persist must NOT release the signature — the
+    node halts fatally (watchdog bundles it) instead of signing on top
+    of an unknown on-disk guard; a restart on the same home recovers."""
+    from cometbft_tpu.privval import FilePV, SignStateError
+
+    home = str(tmp_path / "solo")
+    key_path = str(tmp_path / "pvkey.json")
+    state_path = os.path.join(home, "data", "priv_validator_state.json")
+    pv = FilePV.generate(key_path, state_path)
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    doc = GenesisDoc(chain_id="pv-eio-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+
+    async def crash_phase():
+        F.configure(enabled=True, seed=5,
+                    faults=["privval.state.fsync.eio:at=5"])
+        node = await _mk_node(doc, pv, 0, home=home, watchdog=True)
+        try:
+            deadline = time.monotonic() + 30
+            while node.consensus.fatal_error is None:
+                assert time.monotonic() < deadline, "never went fatal"
+                await asyncio.sleep(0.05)
+            err = node.consensus.fatal_error
+            assert isinstance(err, OSError) and err.errno == errno.EIO
+            # the privval handle is dead: every further sign refuses
+            from cometbft_tpu.types.block_id import BlockID
+            from cometbft_tpu.types.vote import PREVOTE_TYPE, Vote
+
+            dead_probe = Vote(
+                type=PREVOTE_TYPE, height=99, round=0,
+                block_id=BlockID(), timestamp_ns=1,
+                validator_address=pv.get_pub_key().address(),
+                validator_index=0)
+            with pytest.raises(SignStateError):
+                await pv.sign_vote(doc.chain_id, dead_probe,
+                                   sign_extension=False)
+            assert dead_probe.signature == b""    # never released
+            bundle = await asyncio.to_thread(
+                _find_bundle, node.incident_dir(), "consensus_fatal_error")
+            assert bundle is not None, "no incident bundle for the halt"
+            return node.height()
+        finally:
+            await node.stop()
+
+    h_crash = run(crash_phase())
+    F.reset()
+
+    async def recover_phase():
+        # restart reloads the sign state that DID land: double-sign
+        # protection intact, consensus resumes
+        pv2 = FilePV.load(key_path, state_path)
+        node = await _mk_node(doc, pv2, 0, home=home, watchdog=True)
+        try:
+            await _wait_height([node], h_crash + 2, timeout=60)
+            assert node.consensus.fatal_error is None
+        finally:
+            await node.stop()
+        return True
+
+    assert run(recover_phase())
+
+
+# --------------------------------------------------------------------------
+# PR 10 acceptance: seeded mid-log blockstore corruption -> boot-time
+# detection (salvage + doctor deep scan) -> repair (truncate to last
+# verified height) -> blocksync re-fetch -> fork-free, run twice with
+# identical fault signatures.  The victim is a REAL FilePV validator: its
+# persisted last-sign-state is what makes the mid-round rejoin
+# equivocation-free (re-signs return the stored signature).
+
+DOCTOR_SEED = 77010
+DOCTOR_SPEC = "db.replay.corrupt:file=blockstore.db:at=1:frac=0.5"
+
+
+async def _doctor_scenario(base_dir: str) -> tuple:
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    F.reset()
+    victim_home = os.path.join(base_dir, "victim")
+    pvs = [MockPV.from_secret(b"drv%d" % i) for i in range(2)]
+    victim_pv = FilePV.generate(
+        os.path.join(base_dir, "victim_key.json"),
+        os.path.join(victim_home, "data", "priv_validator_state.json"))
+    pvs.append(victim_pv)
+    doc = GenesisDoc(chain_id="doctor-acc-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+    nodes = []
+    for i in range(3):
+        nodes.append(await _mk_node(
+            doc, pvs[i], i, home=victim_home if i == 2 else None,
+            name_prefix="dr"))
+    try:
+        for i in range(3):
+            for j in range(i + 1, 3):
+                await nodes[i].dial_peer(nodes[j].listen_addr,
+                                         persistent=True)
+        await _wait_height(nodes, 6, timeout=45)
+        h_stop = nodes[2].height()
+        await nodes[2].stop()
+
+        # ---- arm the seeded bit-flip for the victim's NEXT blockstore
+        # open (at-rest bit-rot, file-selected so the other stores'
+        # opens don't consume the schedule)
+        F.configure(enabled=True, seed=DOCTOR_SEED, faults=[DOCTOR_SPEC])
+        victim_pv2 = FilePV.load(
+            os.path.join(base_dir, "victim_key.json"),
+            os.path.join(victim_home, "data",
+                         "priv_validator_state.json"))
+        victim = await _mk_node(doc, victim_pv2, 2, home=victim_home,
+                                name_prefix="dr", fast_sync=True)
+        nodes[2] = victim
+
+        # ---- boot-time detection: salvage fired, the doctor deep scan
+        # gated the salvaged store and repaired it
+        rep = victim.doctor_report.to_dict()
+        assert rep["salvage"].get("blockstore", {}).get(
+            "salvaged_this_open"), rep
+        assert rep["deep_scan"] is not None, rep
+        assert rep["ok"] and rep["refused"] is None, rep
+        repaired = rep["deep_scan"].get("truncated_to") is not None or \
+            any("ahead" in a for a in rep["actions"])
+        assert repaired or rep["deep_scan"]["ok"], rep
+        assert not victim.block_store.is_dirty()     # verified or rebuilt
+
+        # ---- blocksync re-fetch + consensus rejoin: all three advance
+        for j in (0, 1):
+            await victim.dial_peer(nodes[j].listen_addr, persistent=True)
+        target = max(h_stop, max(n.height() for n in nodes[:2])) + 2
+        await _wait_height(nodes, target, timeout=90)
+        assert victim.consensus.fatal_error is None
+
+        # ---- fork-free at EVERY common height
+        common = min(n.height() for n in nodes)
+        hashes = []
+        for h in range(1, common + 1):
+            hs = {n.block_store.load_block(h).hash() for n in nodes
+                  if n.block_store.load_block(h) is not None}
+            assert len(hs) == 1, f"fork at height {h}: {hs}"
+            hashes.append(hs.pop().hex())
+        return F.signature(), rep["deep_scan"].get("truncated_to"), hashes
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        F.reset()
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(400)
+def test_doctor_acceptance_corrupt_restart_repair_catchup(tmp_path):
+    sig1, trunc1, hashes1 = run(_doctor_scenario(str(tmp_path / "run1")))
+    sig2, trunc2, hashes2 = run(_doctor_scenario(str(tmp_path / "run2")))
+    # same seed -> the identical fault signature, at the exact call index
+    assert sig1 == sig2 == [("db.replay.corrupt", 1, 1)]
+    assert len(hashes1) >= 6 and len(hashes2) >= 6

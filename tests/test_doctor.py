@@ -1,0 +1,596 @@
+"""Storage integrity doctor (node/doctor.py) + LogDB mid-log salvage
+(storage/db.py).
+
+Fast tier only: salvage/quarantine/dirty-marker semantics, the
+``db.replay.corrupt`` / ``db.compact.eio`` chaos sites, the boot
+cross-store consistency matrix (ahead blockstore, ahead statestore, WAL
+lineage, privval-ahead refusal), the deep hash-chain scan with
+truncate-to-verified repair, the pruned-base / statesync-anchor edge
+cases, serving gated on a dirty store, and the doctor CLI.  The live
+corrupt-restart-blocksync acceptance run lives in test_chaos.py.
+"""
+
+import asyncio
+import errno
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from cometbft_tpu.libs import failures as F
+from cometbft_tpu.node.doctor import DoctorError, StorageDoctor
+from cometbft_tpu.storage import BlockStore, StateStore, open_db
+from cometbft_tpu.storage.blockstore import K_BLOCK
+from cometbft_tpu.storage.db import LogDB, height_key
+
+pytestmark = pytest.mark.timeout(120)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    F.reset()
+    yield
+    F.reset()
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# --------------------------------------------------------- LogDB salvage
+
+
+def _corrupt_at(path: str, marker: bytes, delta: int = 10) -> None:
+    raw = bytearray(open(path, "rb").read())
+    off = raw.find(marker)
+    assert off >= 0
+    raw[off + delta] ^= 0x40
+    open(path, "wb").write(bytes(raw))
+
+
+def test_logdb_mid_log_salvage_quarantines_and_flags_dirty(tmp_path):
+    p = str(tmp_path / "kv.db")
+    db = LogDB(p)
+    for i in range(10):
+        db.set(b"k%d" % i, b"v" * 50 + b"%d" % i)
+    db.close()
+    _corrupt_at(p, b"k5")
+    db2 = LogDB(p)
+    # the corrupt record is skipped; everything after it survives
+    assert db2.salvaged and len(db2.salvage_spans) == 1
+    assert db2.get(b"k5") is None
+    assert db2.get(b"k4") is not None and db2.get(b"k6") is not None
+    assert db2.is_dirty()
+    assert os.path.exists(p + ".quarantine")
+    db2.close()
+    # the log was rewritten clean: reopening does NOT re-salvage, but the
+    # dirty marker persists until deep verification clears it
+    db3 = LogDB(p)
+    assert not db3.salvaged and db3.is_dirty()
+    info = db3.dirty_info()
+    assert info and info.get("spans")
+    db3.clear_dirty()
+    assert not db3.is_dirty()
+    db3.close()
+
+
+def test_logdb_salvage_can_resurrect_stale_value_hence_dirty(tmp_path):
+    """The reason salvage alone is untrustworthy: losing the LATEST
+    record for a key silently resurrects the previous value (and losing
+    a tombstone resurrects a deleted key).  The dirty marker is what
+    forces the doctor's deep verification before anything is served."""
+    p = str(tmp_path / "kv.db")
+    db = LogDB(p)
+    db.set(b"key", b"OLDVALUE")
+    db.set(b"pad", b"p" * 40)
+    db.set(b"key", b"NEWVALUE")
+    db.set(b"gone", b"g" * 40)
+    db.delete(b"gone")
+    db.close()
+    _corrupt_at(p, b"NEWVALUE", delta=0)
+    db2 = LogDB(p)
+    assert db2.salvaged
+    assert db2.get(b"key") == b"OLDVALUE"      # stale resurrection!
+    assert db2.is_dirty()
+    db2.close()
+
+
+def test_logdb_torn_tail_still_truncates_without_dirty(tmp_path):
+    p = str(tmp_path / "kv.db")
+    db = LogDB(p)
+    db.set(b"a", b"1")
+    db.close()
+    with open(p, "ab") as f:
+        f.write(b"\xff" * 37)          # no valid record can follow
+    db2 = LogDB(p)
+    assert not db2.salvaged and not db2.is_dirty()
+    assert db2.get(b"a") == b"1"
+    db2.set(b"b", b"2")                # fresh handle writes fine
+    db2.close()
+
+
+def test_db_replay_corrupt_site_is_seeded_and_file_selected(tmp_path):
+    """The ``db.replay.corrupt`` chaos site: seeded bit-flip on open,
+    scoped to one file via the ``file=`` selector; same seed -> the
+    identical salvage span."""
+    def build(name):
+        p = str(tmp_path / name)
+        db = LogDB(p)
+        for i in range(20):
+            db.set(b"k%02d" % i, b"v" * 64)
+        db.close()
+        return p
+
+    p1, p2 = build("blockstore.db"), build("state.db")
+    spans = []
+    for _ in range(2):
+        shutil.copy(p1, p1 + ".bak")
+        F.configure(enabled=True, seed=99, faults=[
+            "db.replay.corrupt:file=blockstore.db:at=1:frac=0.5"])
+        db = LogDB(p1)
+        assert db.salvaged, "seeded flip must corrupt a record"
+        spans.append(tuple(db.salvage_spans))
+        db.close()
+        other = LogDB(p2)          # file selector: state.db untouched
+        assert not other.salvaged
+        other.close()
+        assert F.signature() == [("db.replay.corrupt", 1, 1)]
+        F.reset()
+        os.replace(p1 + ".bak", p1)
+        os.unlink(p1 + ".dirty")
+    assert spans[0] == spans[1]
+
+
+def test_logdb_compact_failure_goes_dead_not_valueerror(tmp_path):
+    """The compact fsyncgate satellite: an IO failure between the close
+    and the reopen must leave a DEAD handle (OSError on every later
+    write), not a closed-file ValueError; restart recovers."""
+    p = str(tmp_path / "kv.db")
+    db = LogDB(p)
+    db.set(b"a", b"1")
+    F.configure(enabled=True, seed=1, faults=["db.compact.eio:at=1"])
+    with pytest.raises(OSError) as ei:
+        db._compact()
+    assert ei.value.errno == errno.EIO
+    # dead handle: the OSError discipline, never ValueError
+    with pytest.raises(OSError) as ei2:
+        db.set(b"b", b"2")
+    assert ei2.value.errno == errno.EIO
+    db.close()
+    F.reset()
+    db2 = LogDB(p)
+    assert db2.get(b"a") == b"1"
+    db2.set(b"c", b"3")
+    db2.close()
+
+
+# ------------------------------------------------- solo home scaffolding
+
+
+HOME_SECRET = b"doctor-home-pv"
+
+
+def _doc_pv():
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    pv = MockPV.from_secret(HOME_SECRET)
+    doc = GenesisDoc(chain_id="doctor-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    return doc, pv
+
+
+async def _run_node(home, doc, pv, *, min_height=0, extra_heights=0,
+                    fast_sync=False):
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+
+    cfg = Config(consensus=test_consensus_config())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.base.signature_backend = "cpu"
+    cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+    node = await Node.create(doc, KVStoreApplication(), priv_validator=pv,
+                             config=cfg,
+                             node_key=NodeKey.from_secret(b"doctor-nk"),
+                             home=home, name="drhome",
+                             fast_sync=fast_sync)
+    await node.start()
+    target = max(min_height, node.height() + extra_heights)
+    deadline = time.monotonic() + 60
+    while node.height() < target:
+        assert time.monotonic() < deadline, \
+            f"stuck at {node.height()} < {target}"
+        await asyncio.sleep(0.02)
+    h = node.height()
+    report = node.doctor_report
+    await node.stop()
+    return h, report
+
+
+@pytest.fixture(scope="module")
+def solo_home(tmp_path_factory):
+    """One committed solo-validator home (height >= 6), copied per
+    test."""
+    home = str(tmp_path_factory.mktemp("doctor") / "home")
+    doc, pv = _doc_pv()
+    h, _ = run(_run_node(home, doc, pv, min_height=6))
+    return home, h
+
+
+@pytest.fixture
+def home_copy(solo_home, tmp_path):
+    src, h = solo_home
+    dst = str(tmp_path / "home")
+    shutil.copytree(src, dst)
+    return dst, h
+
+
+def _stores(home):
+    bs = BlockStore(open_db("logdb",
+                            os.path.join(home, "data", "blockstore.db")))
+    ss = StateStore(open_db("logdb",
+                            os.path.join(home, "data", "state.db")))
+    return bs, ss
+
+
+def _close(bs, ss):
+    bs.db.close()
+    ss.db.close()
+
+
+def _wal_path(home):
+    return os.path.join(home, "data", "cs.wal")
+
+
+# ----------------------------------------------------------- boot check
+
+
+def test_doctor_consistent_home_is_a_noop(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    rep = StorageDoctor(bs, ss, wal_path=_wal_path(home)).boot_check(
+        repair=True)
+    assert rep.ok and not rep.actions and not rep.findings
+    assert rep.heights["blockstore"] == h >= 6
+    scan = StorageDoctor(bs, ss).deep_scan(window=0)
+    assert scan["ok"] and not scan["bad"] and scan["verified_to"] == 1
+    json.dumps(rep.to_dict())          # report is JSON-serializable
+    _close(bs, ss)
+
+
+def test_doctor_blockstore_ahead_truncates_to_state_plus_one(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    # rebuild the state snapshot two heights back without touching the
+    # blockstore: the blockstore is now "ahead" beyond the one-block
+    # crash window the Handshaker covers
+    doctor = StorageDoctor(bs, ss)
+    from cometbft_tpu.node.doctor import DoctorReport
+
+    doctor._rebuild_state_at(DoctorReport(), ss.load(), h - 2, False)
+    assert ss.load().last_block_height == h - 2
+    rep = StorageDoctor(bs, ss, wal_path=_wal_path(home)).boot_check(
+        repair=True)
+    assert bs.height() == h - 1          # truncated to state + 1
+    assert any("ahead of state" in a for a in rep.actions)
+    _close(bs, ss)
+
+
+def test_doctor_state_ahead_rewinds_and_quarantines_wal(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    bs.remove_tip()
+    bs.remove_tip()                      # blockstore lost its tip
+    rep = StorageDoctor(bs, ss, wal_path=_wal_path(home)).boot_check(
+        repair=True)
+    assert rep.ok
+    assert ss.load().last_block_height == bs.height() == h - 2
+    assert any("state ahead" in a for a in rep.actions)
+    # the WAL's EndHeight lineage ran past the rolled-back stores
+    assert any("quarantined" in a for a in rep.actions)
+    from cometbft_tpu.consensus.wal import wal_segments
+
+    assert wal_segments(_wal_path(home)) == []
+    assert any(n.endswith(".quarantine")
+               for n in os.listdir(os.path.dirname(_wal_path(home)))
+               if n.startswith("cs.wal"))
+    _close(bs, ss)
+    # the repaired home boots and keeps committing
+    doc, pv = _doc_pv()
+    h2, rep2 = run(_run_node(home, doc, pv, extra_heights=2))
+    assert h2 >= h - 2 + 2 and rep2 is not None and rep2.ok
+
+
+def test_doctor_privval_ahead_refuses_with_double_sign_warning(home_copy):
+    home, h = home_copy
+    pv_state = os.path.join(home, "data", "pv_state.json")
+    with open(pv_state, "w") as f:
+        json.dump({"height": h + 50, "round": 0, "step": 3}, f)
+    bs, ss = _stores(home)
+    with pytest.raises(DoctorError) as ei:
+        StorageDoctor(bs, ss, privval_state_path=pv_state).boot_check(
+            repair=True)
+    assert "double-sign" in str(ei.value)
+    assert ei.value.report is not None and ei.value.report.refused
+    # report-only mode surfaces the refusal without raising
+    rep = StorageDoctor(bs, ss, privval_state_path=pv_state).boot_check(
+        repair=False, raise_on_refusal=False)
+    assert not rep.ok and "double-sign" in rep.refused
+    # ... but a salvaged (dirty) store EXPLAINS the gap: the repair +
+    # deep scan own the recovery, so the node may start and re-fetch
+    bs.db.mark_dirty()
+    rep2 = StorageDoctor(bs, ss, privval_state_path=pv_state).boot_check(
+        repair=True)
+    assert rep2.ok and rep2.refused is None
+    assert not bs.is_dirty()             # clean scan cleared the marker
+    _close(bs, ss)
+
+
+def test_doctor_privval_plus_one_is_normal(home_copy):
+    """The signer votes for height h+1 while the stores hold h — the
+    everyday crash window must NOT trip the double-sign refusal."""
+    home, h = home_copy
+    pv_state = os.path.join(home, "data", "pv_state.json")
+    with open(pv_state, "w") as f:
+        json.dump({"height": h + 1, "round": 0, "step": 3}, f)
+    bs, ss = _stores(home)
+    rep = StorageDoctor(bs, ss, privval_state_path=pv_state).boot_check(
+        repair=True)
+    assert rep.ok and rep.refused is None
+    _close(bs, ss)
+
+
+# ------------------------------------------------------------ deep scan
+
+
+def test_deep_scan_detects_mid_chain_corruption_and_truncates(home_copy):
+    home, h = home_copy
+    bad_h = h - 3
+    bs, ss = _stores(home)
+    bs.db.set(height_key(K_BLOCK, bad_h), b"garbage-not-a-block")
+    doctor = StorageDoctor(bs, ss, wal_path=_wal_path(home))
+    rep = doctor.boot_check(repair=True, force_deep=True)
+    scan = rep.deep_scan
+    assert scan["bad"] == [bad_h]
+    assert scan["truncated_to"] == bad_h - 1 and scan["ok"]
+    assert bs.height() == bad_h - 1
+    assert ss.load().last_block_height == bad_h - 1
+    # WAL ran past the truncation -> quarantined in the same pass
+    assert any("quarantined" in a for a in rep.actions)
+    _close(bs, ss)
+    # the repaired solo home re-proposes past its old tip
+    doc, pv = _doc_pv()
+    h2, _ = run(_run_node(home, doc, pv, min_height=bad_h + 1))
+    assert h2 >= bad_h + 1
+
+
+def test_deep_scan_report_only_leaves_store_untouched(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    bs.db.set(height_key(K_BLOCK, h - 1), b"junk")
+    scan = StorageDoctor(bs, ss).deep_scan(window=0, repair=False)
+    assert scan["bad"] == [h - 1] and not scan["ok"]
+    assert scan["truncated_to"] is None
+    assert bs.height() == h              # nothing was modified
+    _close(bs, ss)
+
+
+def test_deep_scan_window_clamps_at_pruned_base(home_copy):
+    """Satellite: prune_blocks + doctor interplay — the scan window
+    clamps to the pruned base, and a truncating repair above a base > 1
+    keeps the base."""
+    home, h = home_copy
+    bs, ss = _stores(home)
+    assert bs.prune_blocks(3) == 2       # base 1 -> 3
+    doctor = StorageDoctor(bs, ss)
+    scan = doctor.deep_scan(window=100)
+    assert scan["window"] == [3, h] and scan["ok"]
+    # corruption above the pruned base: normal truncate, base kept
+    bad_h = h - 1
+    bs.db.set(height_key(K_BLOCK, bad_h), b"junk")
+    scan2 = doctor.deep_scan(window=100, repair=True)
+    assert scan2["truncated_to"] == bad_h - 1
+    assert bs.base() == 3 and bs.height() == bad_h - 1
+    _close(bs, ss)
+
+
+def test_deep_scan_corruption_at_pruned_base_refuses(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    bs.prune_blocks(4)
+    bs.db.set(height_key(K_BLOCK, 4), b"junk")     # the base itself
+    from cometbft_tpu.node.doctor import DoctorReport
+
+    rep = DoctorReport()
+    scan = StorageDoctor(bs, ss).deep_scan(window=0, repair=True,
+                                           report=rep)
+    assert not scan["ok"]
+    assert rep.refused and "resync" in rep.refused
+    _close(bs, ss)
+
+
+def test_doctor_statesync_anchor_store_is_healthy(home_copy):
+    """Satellite: a statesync'd store (base == height > 1, no blocks,
+    just the trusted seen-commit + bookkeeping) passes both the boot
+    check and the deep scan."""
+    home, h = home_copy
+    bs, ss = _stores(home)
+    state = ss.load()
+    commit = bs.load_block_commit(h - 1) or bs.load_seen_commit()
+    import tempfile
+
+    d = tempfile.mkdtemp()
+    bs2 = BlockStore(open_db("logdb", os.path.join(d, "blockstore.db")))
+    ss2 = StateStore(open_db("logdb", os.path.join(d, "state.db")))
+    from dataclasses import replace as dc_replace
+
+    anchor_state = dc_replace(state, last_block_height=commit.height)
+    ss2.bootstrap(anchor_state)
+    bs2.bootstrap_statesync(commit.height, commit)
+    rep = StorageDoctor(bs2, ss2).boot_check(repair=True, force_deep=True)
+    assert rep.ok and rep.deep_scan.get("anchor_only")
+    _close(bs, ss)
+    _close(bs2, ss2)
+
+
+# ---------------------------------------------- serving gate + surfaces
+
+
+def test_blocksync_serving_gated_on_dirty_store(tmp_path):
+    from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+    import msgpack
+
+    bs = BlockStore(open_db("logdb", str(tmp_path / "blockstore.db")))
+    reactor = BlocksyncReactor(None, bs, None)
+
+    sent = []
+
+    class _Peer:
+        id = "p1"
+
+        def send(self, ch, msg):
+            sent.append(msgpack.unpackb(msg, raw=False))
+
+    bs.db.mark_dirty()
+    reactor._serve_block(_Peer(), 3)
+    assert sent and sent[0]["@"] == "nores"
+    bs.db.close()
+
+
+def test_inspect_mode_carries_doctor_report(home_copy):
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.rpc.inspect import InspectNode
+
+    home, h = home_copy
+    doc, _ = _doc_pv()
+    cfg = Config()
+    node = InspectNode(home, cfg, doc)
+    rep = node.doctor_report
+    assert rep is not None and rep.ok
+    assert rep.heights["blockstore"] == h
+    # inspect NEVER repairs: corrupt a record, re-open, report-only
+    node.block_store.db.set(height_key(K_BLOCK, h - 1), b"junk")
+    node.block_store.db.close()
+    node.state_store.db.close()
+    node2 = InspectNode(home, cfg, doc)
+    assert node2.doctor_report is not None
+    assert node2.block_store.height() == h     # untouched
+    node2.block_store.db.close()
+    node2.state_store.db.close()
+
+
+def test_status_route_surfaces_doctor_report(home_copy):
+    from cometbft_tpu.rpc.core import Environment, status
+
+    home, h = home_copy
+    doc, pv = _doc_pv()
+
+    async def main():
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.config import Config, test_consensus_config
+        from cometbft_tpu.node import Node
+        from cometbft_tpu.p2p import NodeKey
+
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.base.signature_backend = "cpu"
+        cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv, config=cfg,
+            node_key=NodeKey.from_secret(b"doctor-nk"), home=home,
+            name="drhome")
+        await node.start()
+        try:
+            st = await status(Environment(node))
+            assert st["doctor"] is not None and st["doctor"]["ok"]
+            json.dumps(st["doctor"])
+        finally:
+            await node.stop()
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def _write_config(home):
+    from cometbft_tpu.config import Config
+
+    Config().save(os.path.join(home, "config", "config.toml"))
+
+
+def test_doctor_cli_report_and_repair(home_copy, capsys):
+    from cometbft_tpu.cmd import main as cmd_main
+
+    home, h = home_copy
+    _write_config(home)
+    assert cmd_main(["--home", home, "doctor"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] and rep["deep_scan"]["ok"]
+
+    # corrupt a mid-chain block: report-only exits 1 and changes nothing
+    bs, ss = _stores(home)
+    bs.db.set(height_key(K_BLOCK, h - 2), b"junk")
+    _close(bs, ss)
+    assert cmd_main(["--home", home, "doctor"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["deep_scan"]["bad"] == [h - 2]
+
+    # --repair truncates to the last verified height and exits 0
+    assert cmd_main(["--home", home, "doctor", "--repair"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["ok"] and rep["deep_scan"]["truncated_to"] == h - 3
+    bs, ss = _stores(home)
+    assert bs.height() == h - 3 == ss.load().last_block_height
+    _close(bs, ss)
+
+
+def test_deep_scan_catches_stale_statestore_records(home_copy):
+    """The headers commit to the per-height statestore records
+    (validators_hash / consensus_hash): a salvaged statestore whose
+    record at some height was stale-resurrected must keep its dirty
+    marker and refuse repair (the content behind the hash is gone)."""
+    from cometbft_tpu.storage.statestore import K_VALS
+    from cometbft_tpu.types import codec
+
+    home, h = home_copy
+    bs, ss = _stores(home)
+    # simulate a stale resurrection: overwrite the valset record at h-2
+    # with a DIFFERENT (still decodable) validator set
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    wrong = ValidatorSet([Validator(
+        MockPV.from_secret(b"not-the-real-one").get_pub_key(), 10)])
+    ss.db.set(height_key(K_VALS, h - 2), codec.pack(wrong))
+    ss.db.mark_dirty()
+    doctor = StorageDoctor(bs, ss, wal_path=_wal_path(home))
+    rep = doctor.boot_check(repair=True, raise_on_refusal=False)
+    assert rep.refused and "resync" in rep.refused
+    assert rep.deep_scan["state_records_ok"] is False
+    assert ss.db.is_dirty()              # marker NOT cleared
+    assert any("validators_hash" in f for f in rep.findings)
+    _close(bs, ss)
+
+
+def test_deep_scan_clears_dirty_statestore_when_records_verify(home_copy):
+    home, h = home_copy
+    bs, ss = _stores(home)
+    ss.db.mark_dirty()
+    rep = StorageDoctor(bs, ss, wal_path=_wal_path(home)).boot_check(
+        repair=True)
+    assert rep.ok and rep.deep_scan["state_records_ok"] is True
+    assert not ss.db.is_dirty()
+    _close(bs, ss)

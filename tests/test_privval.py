@@ -285,3 +285,141 @@ def test_filepv_secp256k1_key_type(tmp_path):
         _json.dump(kd, f)
     pv4 = FilePV.load(str(tmp_path / "k3.json"), str(tmp_path / "s3.json"))
     assert pv4.get_pub_key().type() == "ed25519"
+
+
+# ----------------------------------------------- sign-state hardening
+
+
+def test_filepv_corrupt_state_file_raises_typed_error(tmp_path):
+    """A corrupt/truncated last-sign-state file must be a typed
+    SignStateError carrying the never-auto-reset warning, not a raw
+    JSONDecodeError an operator might "fix" with a reset."""
+    from cometbft_tpu.privval import SignStateError
+
+    pv = _pv(tmp_path)
+    run(pv.sign_vote(CHAIN, _vote(pv), sign_extension=False))
+    sp = str(tmp_path / "state.json")
+    for payload in ("{not json", "", '{"height": 5, "round": 0}',
+                    '{"height": "nan", "round": 0, "step": 2}'):
+        with open(sp, "w") as f:
+            f.write(payload)
+        if payload == "":
+            # empty file is still "exists": must refuse, not silently
+            # start from a zeroed state
+            pass
+        with pytest.raises(SignStateError) as ei:
+            FilePV.load(str(tmp_path / "key.json"), sp)
+        assert "double-sign" in str(ei.value)
+
+
+def test_privval_state_fsync_eio_withholds_signature(tmp_path):
+    """The privval.state.fsync.eio chaos site: a failed sign-state
+    persist must NOT release the signature, and the handle goes dead
+    (every further sign refuses) — the privval fsyncgate."""
+    import errno
+
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.privval import SignStateError
+
+    pv = _pv(tmp_path)
+    F.configure(enabled=True, seed=3,
+                faults=["privval.state.fsync.eio:at=1"])
+    try:
+        v = _vote(pv)
+        with pytest.raises(OSError) as ei:
+            run(pv.sign_vote(CHAIN, v, sign_extension=False))
+        assert ei.value.errno == errno.EIO
+        assert v.signature == b""          # never released
+        # dead handle: even with the fault disarmed, no further signing
+        F.reset()
+        with pytest.raises(SignStateError):
+            run(pv.sign_vote(CHAIN, _vote(pv, height=6),
+                             sign_extension=False))
+        # restart (reload from disk) recovers; the pre-failure state
+        # file is intact, so double-sign protection still holds
+        pv2 = FilePV.load(str(tmp_path / "key.json"),
+                          str(tmp_path / "state.json"))
+        v2 = _vote(pv2, height=6)
+        run(pv2.sign_vote(CHAIN, v2, sign_extension=False))
+        assert v2.signature
+    finally:
+        F.reset()
+
+
+# ------------------------------------------------- signer liveness
+
+
+def test_signer_client_round_trip_times_out(tmp_path):
+    """signer.round_trip.hang chaos site: a wedged signer trips the
+    deadline with a typed SignerTimeoutError + counter instead of
+    blocking forever."""
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.privval import SignerTimeoutError
+
+    pv = _pv(tmp_path)
+
+    async def main():
+        F.configure(enabled=True, seed=7,
+                    faults=["signer.round_trip.hang:at=1:delay=30"])
+        server = SignerServer(pv)
+        host, port = await server.listen()
+        client = await SignerClient.connect(host, port, timeout_s=0.3)
+        before = m.counter("privval_signer_timeouts_total").value()
+        try:
+            with pytest.raises(SignerTimeoutError):
+                await client.sign_vote(CHAIN, _vote(client),
+                                       sign_extension=False)
+            assert m.counter("privval_signer_timeouts_total").value() \
+                == before + 1
+            # at=1 exhausted: the next round trip answers (the stream
+            # is in an undefined frame state after an abandoned
+            # request, so reconnect first like the listener does)
+            client2 = await SignerClient.connect(host, port, timeout_s=5)
+            v = _vote(client2)
+            await client2.sign_vote(CHAIN, v, sign_extension=False)
+            assert client2.get_pub_key().verify_signature(
+                v.sign_bytes(CHAIN), v.signature)
+            await client2.close()
+        finally:
+            await client.close()
+            await server.close()
+            F.reset()
+        return True
+
+    assert run(main())
+
+
+def test_signer_listener_timeout_reconnects_and_retries(tmp_path):
+    """A hung round trip through the SignerListener behaves exactly
+    like a dropped connection: close + re-accept the signer's redial +
+    retry once — consensus sees a signed vote, not a wedge."""
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.privval.signer import SignerListener, serve_dialer
+
+    pv = _pv(tmp_path)
+
+    async def main():
+        F.configure(enabled=True, seed=7,
+                    faults=["signer.round_trip.hang:at=1:delay=30"])
+        listener = SignerListener(timeout_s=0.3)
+        host, port = await listener.listen()
+        dial_task = asyncio.create_task(
+            serve_dialer(pv, host, port, max_retries=50,
+                         retry_interval=0.05))
+        try:
+            await listener.wait_for_signer(timeout=10)
+            v = _vote(listener)
+            # first attempt hangs -> timeout -> reconnect -> retry OK
+            await listener.sign_vote(CHAIN, v, sign_extension=False)
+            assert listener.get_pub_key().verify_signature(
+                v.sign_bytes(CHAIN), v.signature)
+            assert any(e["site"] == "signer.round_trip.hang"
+                       for e in F.events())
+        finally:
+            await listener.close()
+            dial_task.cancel()
+            F.reset()
+        return True
+
+    assert run(main())
