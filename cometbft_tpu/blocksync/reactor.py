@@ -6,11 +6,22 @@ BlockResponse/NoBlockResponse — ``proto/cometbft/blocksync``).
 
 The TPU-first redesign is in the apply loop: where the reference verifies
 one commit per block sequentially (``reactor.go:495`` VerifyCommitLight per
-PeekTwoBlocks pair), this reactor peeks a contiguous *window* of fetched
-blocks and proves all their commits in ONE device batch
+PeekTwoBlocks pair), this reactor accumulates a contiguous *window* of
+fetched blocks and proves all their commits in ONE device batch
 (``types.validation.verify_commits_light_batched``), then applies them
 back-to-back with signature re-verification elided.  Cross-block batching
-is BASELINE configs[4] and the flagship throughput win of the port."""
+is BASELINE configs[4] and the flagship throughput win of the port.
+
+Since r13 the window is a double-buffered pipeline (ROADMAP item 1):
+while window K verifies on the dispatch worker (``asyncio.to_thread`` →
+the device-owner thread, ``patient`` queueing), the apply loop stages
+window K+1 — host packing (part sets, sign-bytes rows) and host→device
+transfer overlap the previous window's compute, so the mesh never idles
+between windows.  The window depth is the ``blocksync.verify_window``
+config knob (default ``BATCH_WINDOW``); verdicts demux per item, so one
+bad block costs the redo of exactly that height (+ its voucher) while
+the proven prefix still applies and the offending peer is scored
+through ``Switch.report_peer`` (``bad_block``)."""
 
 from __future__ import annotations
 
@@ -26,6 +37,7 @@ from ..types import codec
 from ..types.block_id import BlockID
 from ..types.part_set import PartSet
 from ..types.validation import (CommitVerificationError, ErrBatchItemInvalid,
+                                ErrInvalidSignature,
                                 verify_commits_light_batched)
 from ..p2p.reactor import ChannelDescriptor, Reactor
 from .pool import BlockPool
@@ -33,7 +45,9 @@ from .pool import BlockPool
 BLOCKSYNC_CHANNEL = 0x40
 STATUS_UPDATE_INTERVAL = 3.0     # reference statusUpdateIntervalSeconds (10)
 SWITCH_CHECK_INTERVAL = 0.2      # reference switchToConsensusIntervalSeconds
-BATCH_WINDOW = 32                # blocks per device batch (+1 for the tail)
+# default blocks per device batch (+1 for the vouching tail) — the
+# config knob blocksync.verify_window overrides per deployment
+BATCH_WINDOW = 32
 
 
 def _pack(tag: str, **fields) -> bytes:
@@ -45,7 +59,8 @@ class BlocksyncReactor(Reactor):
     def __init__(self, block_exec, block_store, state, *,
                  fast_sync: bool = False, switch_to_consensus=None,
                  backend: str | None = None,
-                 no_peers_grace: float = 5.0, name: str = "bs"):
+                 no_peers_grace: float = 5.0,
+                 verify_window: int | None = None, name: str = "bs"):
         super().__init__()
         self.block_exec = block_exec
         self.block_store = block_store
@@ -54,6 +69,9 @@ class BlocksyncReactor(Reactor):
         self.switch_to_consensus = switch_to_consensus
         self.backend = backend
         self.no_peers_grace = no_peers_grace
+        # accumulator depth: blocks whose commits fill one device batch
+        # (config blocksync.verify_window; Config.validate bounds it)
+        self.verify_window = max(2, int(verify_window or BATCH_WINDOW))
         self.name = name
         self.pool: BlockPool | None = None
         self._tasks: list[asyncio.Task] = []
@@ -179,27 +197,53 @@ class BlocksyncReactor(Reactor):
     # ---------------------------------------------------------- apply loop
 
     async def _apply_routine(self) -> None:
-        """reactor.go:319 poolRoutine, with windowed batch verification."""
+        """reactor.go:319 poolRoutine, rebuilt as a double-buffered
+        cross-block pipeline: one window verifies on the dispatch worker
+        while the next window is peeked, packed and dispatched behind it
+        — host staging overlaps device compute, so consecutive windows
+        keep the mesh full during catch-up."""
         pool = self.pool
         started = time.monotonic()
+        staged: _StagedWindow | None = None
         while True:
             if self._should_switch(started):
+                self._discard_staged(staged)
                 await self._do_switch()
                 return
-            window = pool.peek_window(BATCH_WINDOW + 1)
-            if len(window) < 2:
+            if staged is None:
+                try:
+                    staged = self._stage_window(0)
+                except _RedoBlock as e:
+                    pool.redo_request(e.height)
+                    pool.redo_request(e.height + 1)
+                    continue
+            if staged is None:
                 await asyncio.sleep(SWITCH_CHECK_INTERVAL)
                 continue
+            # double-buffer: stage the window BEHIND the in-flight one
+            # (its packing + host->device staging run while the first
+            # window's signatures verify; a valset boundary or an empty
+            # pool tail simply yields None — partial windows flush, they
+            # never wait for a full buffer; skip>0 never raises)
+            nxt = self._stage_window(staged.n_blocks)
             try:
-                applied = await self._verify_apply_window(window)
+                applied = await self._apply_staged(staged)
             except _RedoBlock as e:
                 # both the block AND the next block (whose last_commit
-                # vouched for it) are suspect (reference poolRoutine redoes
-                # first.Height and second.Height, reactor.go:505-512)
+                # vouched for it) are suspect (reference poolRoutine
+                # redoes first.Height and second.Height,
+                # reactor.go:505-512).  redo_request scores the serving
+                # peer (bad_block -> Switch.report_peer via the pool's
+                # error hook) and refetches; the speculative next window
+                # verified against heights now being refetched, so it is
+                # discarded wholesale.
                 pool.redo_request(e.height)
                 pool.redo_request(e.height + 1)
+                self._discard_staged(nxt)
+                staged = None
                 continue
-            if applied == 0:
+            staged = nxt
+            if applied == 0 and staged is None:
                 await asyncio.sleep(SWITCH_CHECK_INTERVAL)
 
     def _should_switch(self, started: float) -> bool:
@@ -218,37 +262,95 @@ class BlocksyncReactor(Reactor):
         if self.switch_to_consensus is not None:
             await self.switch_to_consensus(self.state)
 
-    async def _verify_apply_window(self, window) -> int:
-        """Batch-verify the longest same-valset prefix of ``window`` in one
-        device call, then apply those blocks (reactor.go:495-548; one
-        dispatch instead of len(window)-1)."""
+    # ------------------------------------------------- window accumulator
+
+    def _stage_window(self, skip: int) -> "_StagedWindow | None":
+        """Collect the longest same-valset run of fetched blocks starting
+        ``skip`` blocks past the pool head (skip>0 = the speculative
+        second buffer) and hand it to the dispatch worker: packing (part
+        sets, dense sign-bytes rows) and the device batch run off the
+        event loop while this loop keeps applying.
+
+        Returns None when there is nothing to stage.  Raises _RedoBlock
+        only for skip=0 with a valset mismatch at the very next block to
+        apply (the header lies or the chain advanced validators); at
+        skip>0 the same mismatch is just the rotation boundary the next
+        loop iteration handles with fresh state."""
+        window = self.pool.peek_window(
+            skip + self.verify_window + 1)[skip:]
+        if len(window) < 2:
+            return None
         state = self.state
         vals_hash = state.validators.hash()
-        prefix = []          # (block, parts, block_id, commit, ext)
-        items = []
+        raw = []                 # (block, vouching commit, ext)
         for i in range(len(window) - 1):
             first, ext = window[i]
             second, _ = window[i + 1]
             if first.header.validators_hash != vals_hash or \
                     second.last_commit is None:
                 break
+            raw.append((first, second.last_commit, ext))
+        if not raw:
+            if skip == 0:
+                raise _RedoBlock(self.pool.height)
+            return None
+        task = asyncio.create_task(asyncio.to_thread(
+            self._pack_verify_window, state, raw))
+        # a discarded buffer (redo, switch-over) must not surface
+        # "exception never retrieved" — reading the exception in a done
+        # callback is harmless for the awaited case
+        task.add_done_callback(lambda t: t.cancelled() or t.exception())
+        return _StagedWindow(task=task, n_blocks=len(raw),
+                             first_height=raw[0][0].header.height)
+
+    def _pack_verify_window(self, state, raw):
+        """Worker-thread body: pack part sets + block IDs, then prove
+        every commit of the window in one batched dispatch (``patient``:
+        queue behind the previous window on the device — that queueing
+        IS the transfer/compute overlap).  Returns ``(prefix, err)``
+        where prefix entries are apply-ready and ``err`` (an
+        ErrBatchItemInvalid with window-relative ``item``) marks the
+        first UNPROVEN item; entries before ``err.item`` are proven, so
+        the caller can apply them before redoing the bad height."""
+        prefix = []              # (block, parts, block_id, commit, ext)
+        items = []
+        for first, commit, ext in raw:
             parts = PartSet.from_data(codec.pack(first))
             fid = BlockID(first.hash(), parts.header())
-            items.append((fid, first.header.height, second.last_commit))
-            prefix.append((first, parts, fid, second.last_commit, ext))
-        if not prefix:
-            # valset rotates at the very next block — the header lies or the
-            # chain advanced validators; fall back to redoing this height
-            raise _RedoBlock(self.pool.height)
+            items.append((fid, first.header.height, commit))
+            prefix.append((first, parts, fid, commit, ext))
+        err = None
         try:
             verify_commits_light_batched(
-                state.chain_id, state.validators,
-                items, backend=self.backend)
+                state.chain_id, state.validators, items,
+                backend=self.backend, patient=True)
         except ErrBatchItemInvalid as e:
-            raise _RedoBlock(self.pool.height + e.item) from e
+            err = e
+            if e.item > 0 and not isinstance(e.cause, ErrInvalidSignature):
+                # pre-dispatch basics/tally failure: NO lane of any item
+                # was verified.  Prove the prefix separately so per-item
+                # demux can still apply the good blocks.  (A signature
+                # failure needs no second pass — the dense dispatch
+                # computes every verdict before raising, so items before
+                # the offender are already proven.)
+                try:
+                    verify_commits_light_batched(
+                        state.chain_id, state.validators, items[:e.item],
+                        backend=self.backend, patient=True)
+                except ErrBatchItemInvalid as e2:
+                    err = e2
+        return prefix, err
 
+    async def _apply_staged(self, staged: "_StagedWindow") -> int:
+        """Await the window's verdicts and apply the proven prefix
+        (reactor.go:495-548).  Per-item demux: a bad commit raises
+        _RedoBlock for exactly its height AFTER the proven neighbors
+        applied — one lying peer costs one refetch, not the window."""
+        prefix, err = await staged.task
+        good = prefix if err is None else prefix[:err.item]
         applied = 0
-        for first, parts, fid, commit, ext in prefix:
+        state = self.state
+        for first, parts, fid, commit, ext in good:
             h = first.header.height
             try:
                 # structural checks only: sigs proven in the batch above
@@ -272,7 +374,31 @@ class BlocksyncReactor(Reactor):
             self.state = state
             self.pool.pop_request()
             applied += 1
+        if err is not None:
+            raise _RedoBlock(err.height) from err
         return applied
+
+    @staticmethod
+    def _discard_staged(staged: "_StagedWindow | None") -> None:
+        """Drop a speculative buffer whose heights are being refetched
+        (or whose reactor is switching over).  The to_thread body cannot
+        be interrupted mid-dispatch; the done callback attached at stage
+        time consumes its result/exception."""
+        if staged is not None:
+            staged.task.cancel()
+
+
+class _StagedWindow:
+    """One buffer of the double-buffered verify pipeline: a window of
+    contiguous fetched blocks whose packing + batched commit
+    verification run on the dispatch worker."""
+
+    __slots__ = ("task", "n_blocks", "first_height")
+
+    def __init__(self, task, n_blocks: int, first_height: int):
+        self.task = task
+        self.n_blocks = n_blocks
+        self.first_height = first_height
 
 
 class _RedoBlock(Exception):

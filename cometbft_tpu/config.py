@@ -159,7 +159,13 @@ class RPCConfig:
 @dataclass
 class BlockSyncConfig:
     enable: bool = True
-    batch_size: int = 64              # cross-block sig batching window
+    batch_size: int = 64              # deprecated (never wired); kept so
+    #   configs written by older nodes still load.  Use verify_window.
+    # cross-block accumulator depth: blocks whose commits fill ONE
+    # device batch during catch-up (blocksync/reactor.py; the pipeline
+    # double-buffers two of these).  Deeper windows amortize dispatch
+    # and fill a bigger mesh; shallower ones bound memory and redo cost.
+    verify_window: int = 32
 
 
 @dataclass
@@ -261,6 +267,16 @@ class BaseConfig:
     # leaf count before merkle tree hashing considers the batched device
     # kernel (crypto/merkle; accelerator-gated either way)
     merkle_kernel_min_leaves: int = 2048
+    # AOT compile bundle (crypto/aotbundle): at start a device-backed
+    # node loads the versioned bundle of pre-compiled kernel executables
+    # (first dispatch runs at warm latency); a missing/stale bundle is
+    # rebuilt in the background and saved for the next boot.  Stale
+    # bundles (jax/plan fingerprint mismatch) are ignored with a logged
+    # warning + counter, never executed.
+    compile_bundle_enable: bool = True
+    # bundle directory; empty = <repo>/.jax_cache/aot beside the
+    # persistent XLA cache
+    compile_bundle_dir: str = ""
     # coalescing vote-verification scheduler (crypto/scheduler): gossiped
     # votes micro-batch through the batched verifier and seed a
     # verified-signature dedup cache that VerifyCommit* consults
@@ -459,6 +475,13 @@ class Config:
         if self.storage.doctor_deep_scan_window < 0:
             raise ConfigError(
                 "storage.doctor_deep_scan_window must be >= 0")
+        if not 2 <= self.blocksync.verify_window <= 4096:
+            # floor 2: the accumulator needs a vouching tail block;
+            # cap 4096: one window's commits already fill the largest
+            # lane bucket many times over — deeper windows only grow
+            # memory and the redo blast radius
+            raise ConfigError(
+                "blocksync.verify_window must be in [2, 4096]")
         if self.chaos.log_size < 16:
             raise ConfigError("chaos.log_size must be >= 16")
         if self.chaos.enable:

@@ -17,30 +17,37 @@ Backend selection: ``create_batch_verifier(backend=...)`` with "auto"
 choosing the device backend iff an accelerator is present (the
 ``config.Config``-driven selection point; falls back to CPU like the
 reference's pure-Go path).
+
+Since r13 the bucket tables, device set and routing thresholds are
+owned by the declarative device plan (``crypto/plan.py``) — shared with
+the coalescing scheduler — and every unpinned single-device dispatch
+consults the AOT compile bundle (``crypto/aotbundle.py``) before the
+jit caches, so a node booted from a prewarmed bundle runs its first
+dispatch at warm latency.
 """
 
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from abc import ABC, abstractmethod
 
 import numpy as np
 
+from . import plan as _plan
 from .keys import ED25519_KEY_TYPE, PubKey, verify_ed25519_zip215
 
-# Batch-size buckets (lanes pad up to the next one; beyond the last, chunks).
-# Capped at 4096: measured on TPU v5e, verify throughput peaks at 2048-4096
-# lanes (~30k sigs/s) and HALVES by 10240 — the (B,20,39) mul intermediates
-# outgrow VMEM and the kernel goes HBM-bound (docs/bench/r04-notes.md).
-# Oversized batches chunk at the cap instead of compiling bigger shapes.
-_LANE_BUCKETS = (16, 64, 256, 1024, 2048, 4096)
-# Valset TABLE row padding is bucketed separately: a cached per-valset
-# table must hold every validator (it cannot chunk — the gather indexes
-# into it), so its row dimension keeps growing past the lane cap.
-_TABLE_BUCKETS = _LANE_BUCKETS + (8192, 16384, 32768, 65536)
-# Hash-block buckets (a vote sign-bytes message is ~120 B -> 2 blocks).
-_BLOCK_BUCKETS = (2, 3, 4, 8, 16)
+# Bucket tables live in the declarative device plan (crypto/plan.py)
+# since r13 — one layer owns the lane/block/table bucketing, the device
+# set, and the routing thresholds for BOTH this module and the
+# coalescing scheduler.  The names below are READ-ONLY aliases of the
+# plan DEFAULTS for existing readers (bench, tests); dispatch reads the
+# ACTIVE plan, so assigning to these is a no-op — install a plan via
+# plan.set_plan/configure to change bucketing.
+_LANE_BUCKETS = _plan.LANE_BUCKETS
+_TABLE_BUCKETS = _plan.TABLE_BUCKETS
+_BLOCK_BUCKETS = _plan.BLOCK_BUCKETS
 
 
 class BatchVerifier(ABC):
@@ -134,13 +141,8 @@ def _host_verify_ed25519(items, lanes_metric, route: str) -> list[bool]:
     return [p.verify_signature(m, s) for p, m, s in items]
 
 
-def _bucket(n: int, buckets) -> int:
-    """Next bucket >= n; beyond the largest, the exact size (a fresh compile
-    for the rare oversized case beats crashing or silent truncation)."""
-    for b in buckets:
-        if n <= b:
-            return b
-    return n
+# one copy of the bucket math, in the plan layer
+_bucket = _plan.bucket
 
 
 @functools.cache
@@ -257,14 +259,18 @@ def _compiled_rlc_gather_sharded(devices: tuple):
 # (device-local partial sums + a replicated fold of O(windows) points
 # per verdict — ``ops/rlc.py make_verify_batch_rlc_sharded``), so a
 # multi-chip host no longer falls back to the ~3x-slower per-lane
-# kernel for large all-valid batches.
-_RLC_MIN_LANES = 128
+# kernel for large all-valid batches.  The threshold lives in the
+# device plan since r13.
+
+
+def _rlc_min_lanes() -> int:
+    return _plan.active().rlc_min_lanes
 
 
 def set_rlc_min_lanes(n: int) -> None:
-    """Config hook: minimum ed25519 lanes before the RLC fast path."""
-    global _RLC_MIN_LANES
-    _RLC_MIN_LANES = max(1, int(n))
+    """Config hook: minimum ed25519 lanes before the RLC fast path
+    (delegates to the device plan — the one routing layer)."""
+    _plan.configure(rlc_min_lanes=max(1, int(n)))
 
 
 def _rlc_args(bb: int, b: int):
@@ -320,7 +326,7 @@ def _valset_tables(pubs_full, devices: tuple):
     if ent is not None and ent[0] is pubs_full:
         return ent[1], ent[2], ent[3]
     n = pubs_full.shape[0]
-    nb = _bucket(n, _TABLE_BUCKETS)
+    nb = _bucket(n, _plan.active().table_buckets)
     if len(devices) > 1:
         nb += (-nb) % len(devices)
     padded = np.zeros((nb, 32), np.int32)
@@ -329,8 +335,17 @@ def _valset_tables(pubs_full, devices: tuple):
     if len(devices) == 1:
         # pinned single chip: build the table THERE, not on the default
         padded = _timed_put(padded, devices[0])
+    fn = None
+    if not devices:
+        # unpinned default-device build: a bundled table kernel skips
+        # the trace+compile on the first valset of a warm-booted node
+        from . import aotbundle as _aot
+
+        fn = _aot.lookup(f"tables:{nb}")
+    if fn is None:
+        fn = _compiled_prepare_tables()
     t0 = time.perf_counter()
-    tab, ok = _compiled_prepare_tables()(padded)
+    tab, ok = fn(padded)
     try:
         # force completion so the timing covers the table-build kernel,
         # not just its enqueue (runs once per valset, not per batch)
@@ -371,7 +386,7 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
     tab, ok, n_pad = _valset_tables(valset_pubs, devices)
     place = _single_device_place(device, devices)
     results = np.zeros((b,), bool)
-    cap = _LANE_BUCKETS[-1]
+    cap = _plan.active().lane_buckets[-1]
     for start in range(0, b, cap):
         end = min(start + cap, b)
         c = end - start
@@ -382,7 +397,8 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
         idx = np.zeros((bb,), np.int32)
         idx[:c] = np.asarray(scope[sl], np.int32)
         idx[c:] = idx[0]
-        if c >= _RLC_MIN_LANES:
+        nb_blocks = blocks.shape[1]
+        if c >= _rlc_min_lanes():
             # steady-state fast path: one RLC verdict over the cached
             # tables (lane-sharded over a multi-chip mesh); a reject
             # falls through to per-lane localization
@@ -391,10 +407,12 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
                 rfn = _compiled_rlc_gather_sharded(devices)
                 rkind = "rlc_gather_sharded"
             else:
-                rfn = _compiled_rlc_gather()
                 rkind = "rlc_gather"
-                if place is not None:
-                    rl_args = _timed_put(rl_args, place)
+                rfn = _aot_fn(f"rlc_gather:{n_pad}", bb, nb_blocks, place)
+                if rfn is None:
+                    rfn = _compiled_rlc_gather()
+                    if place is not None:
+                        rl_args = _timed_put(rl_args, place)
             t0 = time.perf_counter()
             verdict = bool(np.asarray(rfn(tab, ok, *rl_args)))
             _note_dispatch(rkind, bb, time.perf_counter() - t0)
@@ -404,9 +422,14 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
                 results[start:end] = True
                 continue
         lane_args = (idx, r32, s32, blocks, active)
-        if place is not None:
-            lane_args = _timed_put(lane_args, place)
-        fn = _compiled_verify_gather(devices)
+        if len(devices) > 1:
+            fn = _compiled_verify_gather(devices)
+        else:
+            fn = _aot_fn(f"gather:{n_pad}", bb, nb_blocks, place)
+            if fn is None:
+                fn = _compiled_verify_gather(devices)
+                if place is not None:
+                    lane_args = _timed_put(lane_args, place)
         t0 = time.perf_counter()
         out = np.asarray(fn(tab, ok, *lane_args))
         _note_dispatch("gather_sharded" if len(devices) > 1 else "gather",
@@ -415,59 +438,13 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
     return results
 
 
-_DEVICES: tuple | None = None    # explicit multi-device set (config hook)
-
-
-def set_devices(devices) -> None:
-    """Config/multihost hook: shard every device batch over these devices
-    (None or a single device restores single-chip dispatch).  The node
-    wires this from config; ``dryrun_multichip`` uses it so the driver
-    artifact exercises the production sharded path."""
-    global _DEVICES
-    _DEVICES = tuple(devices) if devices else None
-
-
-def _resolve_devices(device) -> tuple:
-    """Devices a batch should run on: an explicit single device wins,
-    then the configured set, else all visible accelerator chips (so a
-    multi-chip host shards automatically).  Empty tuple = jit default."""
-    if device is not None:
-        return (device,)
-    if _DEVICES is not None:
-        return _DEVICES
-    try:
-        import jax
-
-        accels = tuple(d for d in jax.devices() if d.platform != "cpu")
-        return accels if len(accels) > 1 else ()
-    except Exception:
-        return ()
-
-
-def bucket_for_lanes(n: int) -> int:
-    """The lane bucket a batch of ``n`` signatures compiles into — node
-    startup warms the bucket its configured validator-set size actually
-    lands in, so a freshly-woken chip doesn't pay the XLA compile on the
-    first real commit (a 10k-validator set needs the 4096-lane cap shape,
-    not the 256/1024 defaults).  Clamped to the largest bucket: the
-    dispatch path chunks bigger batches at that cap, so no larger shape
-    is ever compiled."""
-    return min(_bucket(max(1, n), _LANE_BUCKETS), _LANE_BUCKETS[-1])
-
-
-def buckets_for_batch(n: int) -> tuple:
-    """EVERY lane bucket a batch of ``n`` signatures will dispatch:
-    ``device_verify_ed25519`` splits past the largest bucket into
-    cap-sized chunks plus a remainder, so n=10000 runs the 4096 cap
-    shape AND the remainder's bucket — warmup must cover both."""
-    cap = _LANE_BUCKETS[-1]
-    if n <= cap:
-        return (bucket_for_lanes(n),)
-    out = {cap}
-    rem = n % cap
-    if rem:
-        out.add(_bucket(rem, _LANE_BUCKETS))
-    return tuple(sorted(out))
+# The device set and the bucket-selection math moved into the plan
+# layer (r13); these names stay as the public seam callers already use
+# (node wiring, dryrun_multichip, tests).
+set_devices = _plan.set_devices
+_resolve_devices = _plan.resolve_devices
+bucket_for_lanes = _plan.bucket_for_lanes
+buckets_for_batch = _plan.buckets_for_batch
 
 
 def warmup_device(lane_buckets=(256, 1024), block_buckets=(2,),
@@ -556,7 +533,7 @@ def device_verify_ed25519(pubs: np.ndarray, rs: np.ndarray, ss: np.ndarray,
         return np.zeros((0,), bool)
     results = np.zeros((b,), bool)
     # chunk anything beyond the largest bucket
-    cap = _LANE_BUCKETS[-1]
+    cap = _plan.active().lane_buckets[-1]
     for start in range(0, b, cap):
         end = min(start + cap, b)
         results[start:end] = _device_verify_chunk(
@@ -565,14 +542,7 @@ def device_verify_ed25519(pubs: np.ndarray, rs: np.ndarray, ss: np.ndarray,
     return results
 
 
-def _chunk_bucket(b: int, devices: tuple) -> int:
-    """Lane bucket for a chunk: next size bucket, rounded up so each chip
-    of a mesh takes an equal contiguous slab (power-of-two buckets
-    already divide power-of-two meshes; round up for odd sizes)."""
-    bb = _bucket(b, _LANE_BUCKETS)
-    if len(devices) > 1:
-        bb += (-bb) % len(devices)
-    return bb
+_chunk_bucket = _plan.chunk_bucket
 
 
 def _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb):
@@ -592,7 +562,8 @@ def _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb):
     lens[:b] = 64 + np.asarray(msg_lens, np.int64)
     hin[b:] = hin[0]
     lens[b:] = lens[0]
-    nb = _bucket(int(_sha.max_blocks_for_len(int(lens.max()))), _BLOCK_BUCKETS)
+    nb = _bucket(int(_sha.max_blocks_for_len(int(lens.max()))),
+                 _plan.active().block_buckets)
     blocks, active = _sha.host_pad(hin, lens, nb)
 
     def pad(a):
@@ -613,16 +584,30 @@ def _single_device_place(device, devices: tuple):
     return devices[0] if len(devices) == 1 else None
 
 
+def _aot_fn(kind: str, bb: int, nb: int, place):
+    """AOT compile-bundle consult for an unpinned single-device
+    dispatch: a bucket loaded from the versioned on-disk bundle skips
+    tracing, lowering AND compiling — the warm-boot path.  Pinned
+    placements and meshes keep their sharded jits (the serialized
+    executable is bound to the default device layout)."""
+    if place is not None:
+        return None
+    from . import aotbundle as _aot
+
+    return _aot.lookup(f"{kind}:{bb}x{nb}")
+
+
 def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     b = pubs.shape[0]
     devices = _resolve_devices(device)
     bb = _chunk_bucket(b, devices)
     args = _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb)
+    nb = args[3].shape[1]           # hash-block bucket of this dispatch
     if len(devices) > 1:
         # production multi-chip path: lane-sharded RLC verdict first
         # (device-local partial sums, O(windows) cross-chip points), per
         # lane sharded jit to localize a rejection
-        if b >= _RLC_MIN_LANES:
+        if b >= _rlc_min_lanes():
             rargs = args + (_rlc_args(bb, b),)
             t0 = time.perf_counter()
             verdict = bool(np.asarray(_compiled_rlc_sharded(devices)(*rargs)))
@@ -636,21 +621,26 @@ def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
         _note_dispatch("verify_sharded", bb, time.perf_counter() - t0)
         return out[:b]
     place = _single_device_place(device, devices)
-    if b >= _RLC_MIN_LANES:
+    if b >= _rlc_min_lanes():
         # one-shot RLC verdict first (the all-valid common case); a
         # reject falls through to the per-lane ladder for localization
         rargs = args + (_rlc_args(bb, b),)
-        if place is not None:
-            rargs = _timed_put(rargs, place)
+        rfn = _aot_fn("rlc", bb, nb, place)
+        if rfn is None:
+            rfn = _compiled_rlc()
+            if place is not None:
+                rargs = _timed_put(rargs, place)
         t0 = time.perf_counter()
-        verdict = bool(np.asarray(_compiled_rlc()(*rargs)))
+        verdict = bool(np.asarray(rfn(*rargs)))
         _note_dispatch("rlc", bb, time.perf_counter() - t0)
         if verdict:
             _metrics()[1].inc(b, route="device_rlc")
             return np.ones((b,), bool)
-    fn = _compiled_verify()
-    if place is not None:
-        args = _timed_put(args, place)
+    fn = _aot_fn("verify", bb, nb, place)
+    if fn is None:
+        fn = _compiled_verify()
+        if place is not None:
+            args = _timed_put(args, place)
     t0 = time.perf_counter()
     out = np.asarray(fn(*args))
     _note_dispatch("verify", bb, time.perf_counter() - t0)
@@ -752,6 +742,7 @@ _DEVICE_WAIT_S = 2.0             # max time a verify waits on the device:
 #   finishes on the worker thread and the device resumes on a later batch
 _DEVICE_POOL = None              # single dispatch thread owning the chip
 _DEVICE_INFLIGHT = None          # last submitted future (may be stuck)
+_DEVICE_SUBMIT_LOCK = threading.Lock()    # pool creation + submit order
 
 
 def set_device_wait(seconds: float) -> None:
@@ -778,9 +769,30 @@ def _device_health():
 
 
 _DEGRADED_LOGGED = False         # one-shot transition log, not per-batch
+_PATIENT_PREV_LANES = 0          # lanes of the last patient dispatch: the
+#   window the NEXT patient caller queues behind (double-buffer depth 2)
+_DEVICE_INFLIGHT_DEADLINE = 0.0  # when the in-flight dispatch is overdue
 
 
-def _device_call(fn):
+def patient_wait_s(lanes: int) -> float:
+    """How long a patient (catch-up) dispatch of ``lanes`` signatures
+    may wait on the device before host fallback: the fail-fast bound
+    plus the compute of its OWN window AND the window it queues behind
+    (the previous patient submission — adjacent windows can be wildly
+    asymmetric, so a small tail window must still wait out the deep one
+    ahead of it), at a deliberately pessimistic throughput floor.  The
+    timeout exists to catch a WEDGED device, not a busy one, so a deep
+    accumulated window must never outrun it; the work term is capped so
+    a real wedge during catch-up still falls back within a bounded
+    delay on top of the configured fail-fast wait."""
+    global _PATIENT_PREV_LANES
+    floor_sigs_per_s = 1000.0
+    total = lanes + _PATIENT_PREV_LANES
+    _PATIENT_PREV_LANES = lanes
+    return _DEVICE_WAIT_S * 2 + min(56.0, 2.0 * total / floor_sigs_per_s)
+
+
+def _device_call(fn, patient: float = 0.0):
     """Run ``fn`` (a device dispatch) on the single device-owner thread,
     waiting at most ``_DEVICE_WAIT_S``.  Returns ``fn()``'s result, or
     None when the device is unavailable: a previous call is still running
@@ -792,18 +804,40 @@ def _device_call(fn):
     a liveness dependency.  Every abandonment increments
     ``crypto_device_abandoned_total`` and holds ``crypto_device_degraded``
     at 1 (with a one-shot log line on the transition) so a node that
-    quietly became a CPU node is visible to operators."""
-    global _DEVICE_POOL, _DEVICE_INFLIGHT, _DEGRADED_LOGGED
+    quietly became a CPU node is visible to operators.
+
+    ``patient`` (seconds, 0 = off) is the blocksync accumulator's
+    double-buffered staging mode: the caller is a catch-up worker
+    thread, not the consensus loop, and WANTS to queue behind the
+    window currently verifying on the device (that queuing is the
+    transfer/compute overlap).  It skips the in-flight fast-fail and
+    waits up to the given bound — sized by the CALLER to the work it
+    submitted (:func:`patient_wait_s`), because a deep accumulated
+    window legitimately needs many seconds of device compute and must
+    not be misread as a wedge.  A genuinely wedged device still
+    degrades to host when the bound expires."""
+    global _DEVICE_POOL, _DEVICE_INFLIGHT, _DEGRADED_LOGGED, \
+        _DEVICE_INFLIGHT_DEADLINE
     import concurrent.futures as cf
 
     from ..libs import failures
 
     gauge, abandoned = _device_health()
-    if _DEVICE_POOL is None:
-        _DEVICE_POOL = cf.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="tpu-verify")
-    if _DEVICE_INFLIGHT is not None and not _DEVICE_INFLIGHT.done():
-        gauge.set(1)             # still wedged from an earlier abandonment
+    with _DEVICE_SUBMIT_LOCK:
+        # concurrent staging threads (the double-buffered accumulator)
+        # must agree on ONE device-owner executor — two would defeat the
+        # queue-behind-the-previous-window serialization
+        if _DEVICE_POOL is None:
+            _DEVICE_POOL = cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tpu-verify")
+    if _DEVICE_INFLIGHT is not None and not _DEVICE_INFLIGHT.done() \
+            and not patient:
+        # fail-fast callers never wait on a busy device; only flag it
+        # DEGRADED when the in-flight dispatch is past its own allowed
+        # window (a healthy patient catch-up dispatch legitimately holds
+        # the device for many seconds — that is busy, not wedged)
+        if time.perf_counter() > _DEVICE_INFLIGHT_DEADLINE:
+            gauge.set(1)
         return None
     if failures.is_enabled():
         # chaos sites wrap the dispatch ON the device-owner thread so
@@ -823,10 +857,13 @@ def _device_call(fn):
                     raise RuntimeError(
                         "chaos: injected device dispatch failure")
                 return inner()
-    fut = _DEVICE_POOL.submit(fn)
-    _DEVICE_INFLIGHT = fut
+    timeout = patient or _DEVICE_WAIT_S
+    with _DEVICE_SUBMIT_LOCK:
+        fut = _DEVICE_POOL.submit(fn)
+        _DEVICE_INFLIGHT = fut
+        _DEVICE_INFLIGHT_DEADLINE = time.perf_counter() + timeout
     try:
-        result = fut.result(timeout=_DEVICE_WAIT_S)
+        result = fut.result(timeout=timeout)
     except cf.TimeoutError:
         abandoned.inc()
         gauge.set(1)
@@ -1061,7 +1098,7 @@ def _backend_wants_device(backend: str, device, lanes: int | None = None
 
 
 def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None,
-                 valset_pubs=None, scope=None):
+                 valset_pubs=None, scope=None, patient: bool = False):
     """Dense-array verification behind the same backend dispatch as
     :func:`create_batch_verifier`: ``pubs`` (k,32) u8, ``sigs`` (k,64) u8,
     ``msgs`` (k,L) u8 zero-padded rows, ``lens`` (k,) int — the matrices
@@ -1074,7 +1111,10 @@ def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None,
     Returns ``(all_ok, oks ndarray)``, or None when no dense-capable
     backend exists (no native lib on a CPU box) — the caller falls back
     to the per-lane object path.  Device wedging degrades to the native
-    CPU batch under the same bounded wait as TpuBatchVerifier."""
+    CPU batch under the same bounded wait as TpuBatchVerifier.
+    ``patient`` queues behind an in-flight device dispatch instead of
+    host-falling-back (the blocksync accumulator's staging mode; see
+    :func:`_device_call`)."""
     import numpy as np
 
     from . import _native_ed25519 as _nat
@@ -1090,12 +1130,14 @@ def verify_dense(backend: str, pubs, sigs, msgs, lens, device=None,
         rs = np.ascontiguousarray(sigs[:, :32])
         ss = np.ascontiguousarray(sigs[:, 32:])
         t0 = _time.perf_counter()
+        wait = patient_wait_s(k) if patient else 0.0
         if valset_pubs is not None and scope is not None:
             out = _device_call(lambda: device_verify_ed25519_cached(
-                valset_pubs, scope, pubs, rs, ss, msgs, lens, device))
+                valset_pubs, scope, pubs, rs, ss, msgs, lens, device),
+                patient=wait)
         else:
             out = _device_call(lambda: device_verify_ed25519(
-                pubs, rs, ss, msgs, lens, device))
+                pubs, rs, ss, msgs, lens, device), patient=wait)
         if out is not None:
             _ROUTER.observe("device", k, _time.perf_counter() - t0)
             lanes.inc(k, route="device")

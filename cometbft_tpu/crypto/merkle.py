@@ -152,7 +152,9 @@ def hash_from_byte_slices_fast(items: list[bytes]) -> bytes:
 
 _KERNEL_MIN_LEAVES = 2048   # leaves before the device kernel is considered
 _PROOF_LEVEL_MIN = 64       # below: the tiny recursive reference path
-_LEVEL_LANE_BUCKETS = (256, 1024, 4096)   # padded kernel dispatch widths
+# padded kernel dispatch widths are owned by the declarative device
+# plan (crypto/plan.py merkle_buckets) since r13; _bucket_width reads
+# the ACTIVE plan so the AOT compile bundle and this dispatch agree
 _LEAF_KERNEL_MAX_LEN = 118  # 0x00 + item + 9B padding fits two SHA-256 blocks
 
 
@@ -271,10 +273,13 @@ _KERNEL_JITS = None
 
 
 def _bucket_width(n: int) -> int:
-    for b in _LEVEL_LANE_BUCKETS:
+    from . import plan as _plan
+
+    buckets = _plan.active().merkle_buckets
+    for b in buckets:
         if n <= b:
             return b
-    return _LEVEL_LANE_BUCKETS[-1]
+    return buckets[-1]
 
 
 def _kernel_leaf_words(items: list[bytes], jits):
@@ -297,7 +302,7 @@ def _kernel_leaf_words(items: list[bytes], jits):
     for i, it in enumerate(items):       # rows start with the 0x00 prefix
         msgs[i, 1:1 + len(it)] = np.frombuffer(it, np.uint8)
     out = np.empty((n, 32), np.uint8)
-    cap = _LEVEL_LANE_BUCKETS[-1]
+    cap = _bucket_width(1 << 30)           # plan's largest level width
     for start in range(0, n, cap):
         end = min(start + cap, n)
         c = end - start
@@ -317,8 +322,10 @@ def _kernel_levels_from_words(words, jits, keep_levels: bool):
     arrays, leaves first) when ``keep_levels``, else just the root row."""
     import numpy as np
 
+    from . import aotbundle as _aot
+
     jit_level, _, _s = jits
-    cap = _LEVEL_LANE_BUCKETS[-1]
+    cap = _bucket_width(1 << 30)           # plan's largest level width
     lv = words
     levels = [lv]
     while len(lv) > 1:
@@ -332,7 +339,10 @@ def _kernel_levels_from_words(words, jits, keep_levels: bool):
             lpad = np.zeros((bb, 8), np.uint32)
             rpad = np.zeros((bb, 8), np.uint32)
             lpad[:c], rpad[:c] = left[start:end], right[start:end]
-            out[start:end] = np.asarray(jit_level(lpad, rpad))[:c]
+            # AOT compile-bundle consult: a bundled level width skips
+            # tracing/compiling on the first dispatch (warm boot)
+            fn = _aot.lookup(f"merkle_level:{bb}") or jit_level
+            out[start:end] = np.asarray(fn(lpad, rpad))[:c]
         if len(lv) & 1:
             out = np.concatenate([out, lv[-1:]])
         lv = out

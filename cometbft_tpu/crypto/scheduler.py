@@ -42,6 +42,12 @@ refcounted through :func:`acquire_scheduler`/:func:`release_scheduler`
 from node start/stop.  With no scheduler registered every helper
 degrades to a direct ``pub.verify_signature`` call with zero overhead —
 no hashing, no locks on the common path.
+
+Since r13 the scheduler and the batched verifier are driven by ONE
+declarative device plan (``crypto/plan.py``): the lane-cap snapping
+below reads the plan's bucket tables (the same tables the dispatch pads
+to and the AOT compile bundle enumerates), so a reconfigured plan steers
+coalescing, padding, and pre-compilation together.
 """
 
 from __future__ import annotations
@@ -515,22 +521,10 @@ class VerificationScheduler(BaseService):
         }
 
 
-def snap_lane_cap(n: int) -> int:
-    """Largest ``crypto/batch`` lane bucket <= n (cap 4096): a
-    size-flushed batch must exactly fill a shape the kernel already
-    compiles, never force a new one.  Values BELOW the smallest bucket
-    are honored exactly — any batch that small pads into the 16-lane
-    shape regardless, so the operator's latency intent wins."""
-    from .batch import _LANE_BUCKETS
-
-    n = max(1, int(n))
-    if n <= _LANE_BUCKETS[0]:
-        return n
-    snapped = _LANE_BUCKETS[0]
-    for b in _LANE_BUCKETS:
-        if b <= n:
-            snapped = b
-    return snapped
+# snap_lane_cap moved into the declarative device plan (crypto/plan.py,
+# r13): the scheduler and the batched verifier now read ONE copy of the
+# bucket tables.  Re-exported here for existing importers.
+from .plan import snap_lane_cap  # noqa: E402  (re-export)
 
 
 # ------------------------------------------------- process-wide registry

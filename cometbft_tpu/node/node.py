@@ -110,6 +110,7 @@ class Node:
         self.statesync_error = None
         self.name = "node"
         self.doctor_report = None
+        self.compile_bundle_info = None
         self._started = False
         self._data_lock = None
         self._vote_sched = None
@@ -265,6 +266,7 @@ class Node:
             fast_sync=self.fast_sync,
             switch_to_consensus=self._switch_to_consensus,
             backend=cfg.base.signature_backend,
+            verify_window=cfg.blocksync.verify_window,
             name=f"{name}.bs")
         if self.fast_sync:
             self.consensus_reactor.wait_sync = True
@@ -467,8 +469,13 @@ class Node:
                 "disabled (instrumentation.loop_stall_threshold_s = 0): "
                 "overload shedding is inactive")
         from ..crypto import batch as cryptobatch
+        from ..crypto import plan as deviceplan
 
-        cryptobatch.set_min_device_lanes(self.config.base.min_device_lanes)
+        # the declarative device plan drives the batched verifier AND
+        # the coalescing scheduler (and is what the AOT bundle below is
+        # keyed by) — config lands here, not in per-module hooks
+        deviceplan.configure(
+            min_device_lanes=self.config.base.min_device_lanes)
         if self.config.base.device_wait_s > 0:
             cryptobatch.set_device_wait(self.config.base.device_wait_s)
         from ..crypto import merkle as cryptomerkle
@@ -509,32 +516,75 @@ class Node:
             # "auto" the device probe itself runs in the executor too
             # (it may block on accelerator discovery)
             backend = self.config.base.signature_backend
+            bundle_on = self.config.base.compile_bundle_enable
+            bundle_dir = self.config.base.compile_bundle_dir or None
 
             def _warm():
                 if backend == "auto" and \
                         cryptobatch._accelerator_device() is None:
+                    self.compile_bundle_info = {
+                        "status": "skipped_no_device"}
                     return          # CPU-only: nothing to pre-compile
-                # default hot shapes, plus the bucket the CURRENT valset
-                # size lands in — a large network's first commit must not
-                # pay a cold XLA compile (VERDICT r3 weak 1a)
+                from ..crypto import aotbundle
+
+                # default hot shapes, plus the buckets the CURRENT
+                # valset actually dispatches — a large network's first
+                # commit must not pay a cold XLA compile (VERDICT r3
+                # weak 1a).  The same shapes become the plan's warm set
+                # so the bundle covers the cached-gather route (the
+                # real commit hot path), keyed to this valset's TABLE
+                # bucket.
                 lanes = {256, 1024}
                 vsizes = ()
                 try:
                     st = self.state_store.load()
                     if st is not None:
                         n_vals = len(st.validators.validators)
-                        lanes.update(
-                            cryptobatch.buckets_for_batch(n_vals))
-                        # large sets also need the cached-gather shape
-                        # at the real TABLE bucket (table rows pad past
-                        # the lane cap; chunks don't cover it)
-                        if n_vals > max(lanes):
-                            vsizes = (n_vals,)
+                        if n_vals:
+                            lanes.update(
+                                cryptobatch.buckets_for_batch(n_vals))
+                            # the dense Light path dispatches the
+                            # ~2/3-power scope, not the full set
+                            lanes.update(cryptobatch.buckets_for_batch(
+                                (2 * n_vals) // 3 + 1))
+                            if n_vals > max(lanes):
+                                vsizes = (n_vals,)
+                            table = deviceplan.bucket(
+                                n_vals,
+                                deviceplan.active().table_buckets)
+                            deviceplan.configure(
+                                warm_lanes=tuple(sorted(lanes)),
+                                warm_tables=(table,))
                 except Exception:
                     pass
+                if bundle_on:
+                    # warm boot: load the versioned AOT bundle FIRST so
+                    # the warmup below (and the first real commit) finds
+                    # pre-compiled executables instead of paying
+                    # trace+lower+compile per shape
+                    try:
+                        self.compile_bundle_info = aotbundle.load(
+                            path=aotbundle.default_path(bundle_dir))
+                    except Exception as e:
+                        self.compile_bundle_info = {"status": "error",
+                                                    "error": repr(e)}
+                else:
+                    self.compile_bundle_info = {"status": "disabled"}
                 cryptobatch.warmup_device(
                     lane_buckets=tuple(sorted(lanes)),
                     valset_sizes=vsizes)
+                if bundle_on and \
+                        self.compile_bundle_info.get("status") != "loaded":
+                    # cold machine: build + save the bundle AFTER warmup
+                    # (consensus is already served by the jit caches) so
+                    # the NEXT boot — or a verify node spun up for a
+                    # traffic spike — starts warm
+                    try:
+                        self.compile_bundle_info = aotbundle.build(
+                            path=aotbundle.default_path(bundle_dir))
+                    except Exception as e:
+                        self.compile_bundle_info = {"status": "error",
+                                                    "error": repr(e)}
 
             asyncio.get_running_loop().run_in_executor(None, _warm)
         if self.syncer is not None:

@@ -114,6 +114,9 @@ async def status(env: Environment) -> dict:
         "doctor": (node.doctor_report.to_dict()
                    if getattr(node, "doctor_report", None) is not None
                    else None),
+        # AOT compile-bundle state (crypto/aotbundle): version, plan
+        # shape and per-bucket cold/warm — whether this node booted warm
+        "compile_bundle": getattr(node, "compile_bundle_info", None),
     }
 
 

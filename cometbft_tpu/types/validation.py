@@ -435,8 +435,8 @@ class ErrBatchItemInvalid(CommitVerificationError):
 
 
 def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
-                                 items: list, backend: str | None = None
-                                 ) -> int:
+                                 items: list, backend: str | None = None,
+                                 patient: bool = False) -> int:
     """VerifyCommitLight over MANY commits sharing one validator set in a
     single device batch — the blocksync cross-block batching seam
     (reference verifies one commit per block sequentially at
@@ -445,10 +445,21 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
 
     ``items`` is a list of ``(block_id, height, commit)``.  Returns the
     number of signatures verified.  Raises ErrBatchItemInvalid naming the
-    first offending item.
+    first offending item.  ``patient`` is the blocksync accumulator's
+    staging mode: the device dispatch queues behind an in-flight window
+    instead of host-falling-back (``crypto/batch._device_call``).
+
+    Demux contract for callers applying per item: when the raised
+    error's ``cause`` is :class:`ErrInvalidSignature`, every item BEFORE
+    ``err.item`` had all its selected lanes verified valid (lane order
+    is item order and the dispatch computes every verdict before
+    raising on the first bad lane).  Any other cause is a pre-dispatch
+    basics/tally failure — earlier items were NOT signature-checked and
+    need their own verification pass before being trusted.
     """
     n = _dense_verify_commits_batched(chain_id, vals, items,
-                                      backend or _DEFAULT_BACKEND)
+                                      backend or _DEFAULT_BACKEND,
+                                      patient=patient)
     if n is not None:
         return n
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
@@ -484,7 +495,8 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
 
 
 def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
-                                  items: list, backend: str) -> int | None:
+                                  items: list, backend: str,
+                                  patient: bool = False) -> int | None:
     """Vectorized core of :func:`verify_commits_light_batched`: per-commit
     basics/tally checks in item order (matching the loop's raise order),
     then ONE dense verification over every selected lane of every commit.
@@ -538,7 +550,8 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
         np.ascontiguousarray(np.concatenate(sel_sigs)),
         np.ascontiguousarray(np.concatenate(sel_msgs)),
         np.concatenate(sel_lens),
-        valset_pubs=pubs, scope=np.concatenate(sel_scope))
+        valset_pubs=pubs, scope=np.concatenate(sel_scope),
+        patient=patient)
     if res is None:
         return None
     ok, oks = res

@@ -1,0 +1,149 @@
+"""AOT compile-bundle smoke: build a tiny plan's bundle on CPU, round-trip
+it through save/load, and prove a SECOND process's first dispatch is warm.
+
+What it checks (the r13 acceptance bar, scaled to a CI budget):
+
+1. build: AOT-lower + compile the tiny plan's one merkle-level bucket,
+   serialize it into a versioned bundle file (measures the build time —
+   that is the cost the bundle saves every later process).
+2. staleness guard: a load under a DIFFERENT plan hash must be ignored
+   with status "stale" and a `crypto_compile_bundle_stale_total` tick —
+   never a crash, never a wrong executable.
+3. second process: a fresh interpreter loads the bundle, dispatches the
+   bucket through `aotbundle.timed_call` (which records the PR 5
+   `crypto_kernel_first_dispatch_seconds` gauge), asserts the output
+   matches the hashlib reference, and asserts the first-dispatch gauge
+   is warm-dispatch-sized — a fraction of the parent's measured
+   trace+compile time — proving cold-start-with-bundle ~= warm.
+
+The merkle-level kernel keeps the smoke inside a CI minute; the bundle
+machinery (enumerate -> lower -> serialize -> version-check -> load ->
+dispatch) is exactly the path the verify/RLC buckets take on a device
+host, where the same load replaces a ~110 s compile (PR 5 measurement).
+
+Runs on CPU (JAX_PLATFORMS=cpu), ~10 s.  Exit 0 = pass.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+LANES = 256
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", flush=True)
+    sys.exit(1)
+
+
+def ok(msg: str) -> None:
+    print(f"ok: {msg}", flush=True)
+
+
+def tiny_plan():
+    from cometbft_tpu.crypto import plan as P
+
+    return dataclasses.replace(P.DevicePlan(), warm_kinds=(),
+                               warm_merkle=(LANES,))
+
+
+def expected_root() -> bytes:
+    return hashlib.sha256(b"\x01" + b"\x00" * 64).digest()
+
+
+def child(path: str, t_build: float) -> None:
+    """The 'spun-up verify node': fresh process, prewarmed bundle."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import aotbundle
+    from cometbft_tpu.libs import metrics
+
+    info = aotbundle.load(path=path, plan=tiny_plan())
+    if info["status"] != "loaded":
+        fail(f"child expected a loaded bundle, got {info['status']!r}")
+    key = f"merkle_level:{LANES}"
+    if info["buckets"].get(key) != "warm":
+        fail(f"bucket {key} not warm in child: {info['buckets']}")
+    left = np.zeros((LANES, 8), np.uint32)
+    out = np.asarray(aotbundle.timed_call(key, left, left))
+    got = b"".join(int(w).to_bytes(4, "big") for w in out[0])
+    if got != expected_root():
+        fail("bundled executable computed a wrong inner-node hash")
+    g = metrics.gauge("crypto_kernel_first_dispatch_seconds", "")
+    first = g.value(kind="merkle_level", lanes=str(LANES))
+    # warm bar: a fraction of the parent's trace+compile time, and small
+    # in absolute terms (a compile would pay lowering alone >bar)
+    bar = max(0.25, t_build / 2)
+    if not 0 <= first < bar:
+        fail(f"first dispatch {first:.3f}s not warm (bar {bar:.3f}s, "
+             f"build was {t_build:.3f}s)")
+    warm_n = metrics.gauge("crypto_compile_bundle_info", "").value(
+        version=str(info["version"]), status="loaded")
+    if warm_n < 1:
+        fail("crypto_compile_bundle_info gauge missing the warm bucket")
+    print(f"CHILD-OK first_dispatch={first * 1e3:.2f}ms "
+          f"build_was={t_build:.2f}s", flush=True)
+
+
+def main() -> None:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        child(sys.argv[2], float(sys.argv[3]))
+        return
+
+    from cometbft_tpu.crypto import aotbundle
+    from cometbft_tpu.libs import metrics
+
+    plan = tiny_plan()
+    with tempfile.TemporaryDirectory(prefix="smoke-bundle-") as td:
+        path = os.path.join(td, "bundle.aot")
+        t0 = time.perf_counter()
+        info = aotbundle.build(plan=plan, path=path)
+        t_build = time.perf_counter() - t0
+        if info["status"] != "built":
+            fail(f"build status {info['status']!r}")
+        if not os.path.exists(path):
+            fail("bundle file missing after build")
+        ok(f"built + serialized bundle in {t_build:.2f}s "
+           f"({os.path.getsize(path)} bytes, version {info['version']})")
+
+        # staleness guard: a different plan hash must refuse the file
+        other = dataclasses.replace(plan, rlc_min_lanes=7)
+        ctr = metrics.counter("crypto_compile_bundle_stale_total", "")
+        before = ctr.value(reason="version")
+        aotbundle.reset()
+        sinfo = aotbundle.load(path=path, plan=other)
+        if sinfo["status"] != "stale":
+            fail(f"stale bundle not refused: {sinfo['status']!r}")
+        if ctr.value(reason="version") != before + 1:
+            fail("stale refusal did not tick "
+                 "crypto_compile_bundle_stale_total{reason=version}")
+        if aotbundle.lookup(f"merkle_level:{LANES}") is not None:
+            fail("stale bundle leaked an executable into the table")
+        ok("version-mismatched bundle ignored with warning + counter")
+
+        # second process: first dispatch must be warm
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", path,
+             f"{t_build:.4f}"],
+            env=env, timeout=120, capture_output=True, text=True)
+        sys.stderr.write(proc.stderr)
+        print(proc.stdout, end="", flush=True)
+        if proc.returncode != 0 or "CHILD-OK" not in proc.stdout:
+            fail(f"child process rc={proc.returncode}")
+        ok("second-process first dispatch served warm from the bundle")
+    print("PASS: AOT compile-bundle smoke", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -108,15 +108,23 @@ def test_dense_entry_empty_and_chunked(monkeypatch):
         np.zeros((0, 32), np.uint8), np.zeros((0, 1), np.uint8),
         np.zeros((0,), np.int64)).shape == (0,)
 
-    # exercise the lane-chunking path with tiny buckets
-    from cometbft_tpu.crypto import batch as batch_mod
-    monkeypatch.setattr(batch_mod, "_LANE_BUCKETS", (4, 8))
-    items = make_sigs(21, bad={0, 9, 20})
-    bv = TpuBatchVerifier()
-    for p, m, s in items:
-        bv.add(p, m, s)
-    ok, oks = bv.verify()
-    assert not ok and oks == [i not in (0, 9, 20) for i in range(21)]
+    # exercise the lane-chunking path with tiny buckets (the dispatch
+    # reads the declarative device plan since r13)
+    import dataclasses
+
+    from cometbft_tpu.crypto import plan as plan_mod
+    saved = plan_mod.active()
+    plan_mod.set_plan(dataclasses.replace(saved, lane_buckets=(4, 8)),
+                      push_min_lanes=False)
+    try:
+        items = make_sigs(21, bad={0, 9, 20})
+        bv = TpuBatchVerifier()
+        for p, m, s in items:
+            bv.add(p, m, s)
+        ok, oks = bv.verify()
+        assert not ok and oks == [i not in (0, 9, 20) for i in range(21)]
+    finally:
+        plan_mod.set_plan(saved, push_min_lanes=False)
 
 
 @pytest.mark.slow   # jitted device kernels, ~1 min each on CPU

@@ -1,0 +1,290 @@
+"""The declarative device plan (crypto/plan.py) and the AOT
+compile-bundle cache (crypto/aotbundle.py): bucket math unification,
+compile-bucket enumeration, bundle save/load round-trip, and the
+staleness guard (a mismatched or corrupt bundle is ignored with a
+counter, never a crash or a wrong executable)."""
+
+import dataclasses
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import aotbundle
+from cometbft_tpu.crypto import batch as B
+from cometbft_tpu.crypto import plan as P
+
+pytestmark = pytest.mark.timeout(120)
+
+
+@pytest.fixture(autouse=True)
+def clean_plan():
+    saved = P.active()
+    yield
+    P.set_plan(saved, push_min_lanes=False)
+    aotbundle.reset()
+
+
+# ------------------------------------------------------------------ plan
+
+
+def test_plan_defaults_match_legacy_tables():
+    plan = P.DevicePlan()
+    assert plan.lane_buckets == B._LANE_BUCKETS
+    assert plan.table_buckets == B._TABLE_BUCKETS
+    assert plan.block_buckets == B._BLOCK_BUCKETS
+    assert plan.lane_buckets[-1] == 4096
+
+
+def test_bucket_math_reads_active_plan():
+    assert P.bucket_for_lanes(300) == 1024
+    assert P.buckets_for_batch(9000) == (1024, 4096)
+    assert P.snap_lane_cap(300) == 256
+    P.set_plan(dataclasses.replace(P.active(), lane_buckets=(4, 8)),
+               push_min_lanes=False)
+    assert P.bucket_for_lanes(300) == 8          # clamped to the new cap
+    assert P.snap_lane_cap(300) == 8
+    # batch's re-exports follow the plan too
+    assert B.bucket_for_lanes(300) == 8
+
+
+def test_chunk_bucket_rounds_to_mesh():
+    assert P.chunk_bucket(100, ()) == 256
+    # 4 fake devices: bucket already divides power-of-two meshes
+    assert P.chunk_bucket(100, (1, 2, 3, 4)) == 256
+    # odd mesh: round up so each chip takes an equal slab
+    assert P.chunk_bucket(100, (1, 2, 3)) == 258
+
+
+def test_mesh_occupancy():
+    assert P.mesh_occupancy(0) == 0.0
+    assert P.mesh_occupancy(4096) == 1.0
+    assert P.mesh_occupancy(2048) == 1.0         # exact bucket
+    assert abs(P.mesh_occupancy(3000) - 3000 / 4096) < 1e-9
+    # chunked past the cap: 5000 -> 4096 + 1024-bucket remainder
+    assert abs(P.mesh_occupancy(5000) - 5000 / (4096 + 1024)) < 1e-9
+
+
+def test_configure_and_legacy_hooks_are_one_layer():
+    B.set_rlc_min_lanes(77)
+    assert P.active().rlc_min_lanes == 77
+    P.configure(rlc_min_lanes=128)
+    assert P.active().rlc_min_lanes == 128
+    # min_device_lanes pushes the live class register only when named
+    saved = B.TpuBatchVerifier.MIN_DEVICE_LANES
+    try:
+        P.configure(min_device_lanes=9)
+        assert B.TpuBatchVerifier.MIN_DEVICE_LANES == 9
+        B.TpuBatchVerifier.MIN_DEVICE_LANES = 3      # direct poke
+        P.configure(rlc_min_lanes=50)                # unrelated change
+        assert B.TpuBatchVerifier.MIN_DEVICE_LANES == 3   # untouched
+    finally:
+        B.TpuBatchVerifier.MIN_DEVICE_LANES = saved
+
+
+def test_enumerate_buckets_and_keys():
+    keys = [b.key for b in P.enumerate_buckets()]
+    assert "verify:4096x2" in keys and "rlc:256x2" in keys
+    assert all(":" in k for k in keys)
+    tiny = dataclasses.replace(P.active(), warm_kinds=(),
+                               warm_merkle=(64,))
+    mk = [b.key for b in P.enumerate_buckets(tiny)]
+    assert mk == ["merkle_level:64"]
+    only = [b.key for b in P.enumerate_buckets(kinds=("merkle_level",))]
+    assert all(k.startswith("merkle_level:") for k in only)
+
+
+def test_plan_hash_sensitivity():
+    h0 = P.plan_hash()
+    assert h0 == P.plan_hash()                   # stable
+    changed = dataclasses.replace(P.active(), rlc_min_lanes=1)
+    assert P.plan_hash(changed) != h0
+    changed = dataclasses.replace(P.active(), warm_lanes=(16,))
+    assert P.plan_hash(changed) != h0
+
+
+def test_describe_shape():
+    d = P.describe()
+    for k in ("hash", "lane_buckets", "table_buckets", "rlc_min_lanes",
+              "min_device_lanes", "warm_buckets", "mesh_axis"):
+        assert k in d
+    assert d["hash"] == P.plan_hash()
+
+
+# ---------------------------------------------------------------- bundle
+
+
+def _tiny_plan():
+    """A plan whose warm set is one cheap merkle bucket (compiles in
+    well under a second on CPU) — the bundle machinery under test is
+    kernel-agnostic."""
+    return dataclasses.replace(
+        P.active(), warm_kinds=(), warm_merkle=(16,))
+
+
+def _stale_counter():
+    from cometbft_tpu.libs import metrics
+
+    return metrics.counter("crypto_compile_bundle_stale_total", "")
+
+
+def test_bundle_build_save_load_roundtrip(tmp_path):
+    plan = _tiny_plan()
+    path = str(tmp_path / "bundle.aot")
+    info = aotbundle.build(plan=plan, path=path)
+    assert info["status"] == "built"
+    assert info["buckets"] == {"merkle_level:16": "warm"}
+    assert os.path.exists(path)
+
+    # a fresh "process": drop the live table, load from disk
+    aotbundle.reset()
+    assert aotbundle.lookup("merkle_level:16") is None
+    info = aotbundle.load(path=path, plan=plan)
+    assert info["status"] == "loaded"
+    assert info["buckets"]["merkle_level:16"] == "warm"
+    assert info["version"] == aotbundle.bundle_version(plan)
+
+    # the deserialized executable computes the real inner-node hash
+    left = np.zeros((16, 8), np.uint32)
+    out = np.asarray(aotbundle.timed_call("merkle_level:16", left, left))
+    expect = hashlib.sha256(b"\x01" + b"\x00" * 64).digest()
+    got = b"".join(int(w).to_bytes(4, "big") for w in out[0])
+    assert got == expect
+    # first-dispatch gauge recorded a warm (sub-compile) time
+    from cometbft_tpu.libs import metrics
+
+    g = metrics.gauge("crypto_kernel_first_dispatch_seconds", "")
+    assert 0 <= g.value(kind="merkle_level", lanes="16") < 1.0
+
+
+def test_bundle_version_mismatch_ignored_with_counter(tmp_path):
+    plan = _tiny_plan()
+    path = str(tmp_path / "bundle.aot")
+    aotbundle.build(plan=plan, path=path)
+    aotbundle.reset()
+    # a different plan (different hash) must refuse the same file
+    other = dataclasses.replace(plan, rlc_min_lanes=1)
+    before = _stale_counter().value(reason="version")
+    info = aotbundle.load(path=path, plan=other)
+    assert info["status"] == "stale"
+    assert aotbundle.lookup("merkle_level:16") is None
+    assert _stale_counter().value(reason="version") == before + 1
+
+
+def test_bundle_corrupt_file_ignored_with_counter(tmp_path):
+    path = str(tmp_path / "bundle.aot")
+    with open(path, "wb") as f:
+        f.write(b"\x00garbage" * 100)
+    before = _stale_counter().value(reason="corrupt")
+    info = aotbundle.load(path=path, plan=_tiny_plan())
+    assert info["status"] == "corrupt"
+    assert _stale_counter().value(reason="corrupt") == before + 1
+
+
+def test_bundle_absent_is_absent(tmp_path):
+    info = aotbundle.load(path=str(tmp_path / "nope.aot"),
+                          plan=_tiny_plan())
+    assert info["status"] == "absent"
+    assert aotbundle.info()["status"] == "absent"
+
+
+def test_bundle_bad_bucket_payload_skipped(tmp_path):
+    import msgpack
+
+    plan = _tiny_plan()
+    path = str(tmp_path / "bundle.aot")
+    aotbundle.build(plan=plan, path=path)
+    with open(path, "rb") as f:
+        doc = msgpack.unpackb(f.read(), raw=False)
+    doc["buckets"]["merkle_level:16"]["trees"] = b"not a pickle"
+    with open(path, "wb") as f:
+        f.write(msgpack.packb(doc, use_bin_type=True))
+    aotbundle.reset()
+    before = _stale_counter().value(reason="bucket")
+    info = aotbundle.load(path=path, plan=plan)
+    assert info["status"] == "loaded"            # header was fine
+    assert info["buckets"]["merkle_level:16"] == "failed"
+    assert aotbundle.lookup("merkle_level:16") is None
+    assert _stale_counter().value(reason="bucket") == before + 1
+
+
+def test_merkle_level_dispatch_consults_bundle(tmp_path):
+    """The merkle kernel loop picks the bundled executable for a loaded
+    width (the warm-boot path the smoke proves cross-process)."""
+    plan = dataclasses.replace(P.active(), warm_kinds=(),
+                               warm_merkle=(16,), merkle_buckets=(16,))
+    path = str(tmp_path / "bundle.aot")
+    aotbundle.build(plan=plan, path=path)
+    aotbundle.reset()
+    aotbundle.load(path=path, plan=plan)
+    assert aotbundle.lookup("merkle_level:16") is not None
+    P.set_plan(plan, push_min_lanes=False)
+    from cometbft_tpu.crypto import merkle as M
+
+    words = np.arange(4 * 8, dtype=np.uint32).reshape(4, 8)
+    jits = (aotbundle.lookup("merkle_level:16"), None, __import__(
+        "cometbft_tpu.ops.sha256", fromlist=["x"]))
+    out = M._kernel_levels_from_words(words.copy(), jits,
+                                      keep_levels=False)
+    # reference: hash pairs with hashlib down to the root
+    def h(l_, r_):
+        return hashlib.sha256(b"\x01" + l_ + r_).digest()
+
+    rows = [b"".join(int(w).to_bytes(4, "big") for w in row)
+            for row in words]
+    expect = h(h(rows[0], rows[1]), h(rows[2], rows[3]))
+    got = b"".join(int(w).to_bytes(4, "big") for w in np.asarray(out)[0])
+    assert got == expect
+
+
+def test_block_buckets_honored_by_padding():
+    """The plan's block_buckets steer dispatch padding (a configured
+    plan must never be a dead knob that only invalidates bundles)."""
+    P.set_plan(dataclasses.replace(P.active(), block_buckets=(4, 8)),
+               push_min_lanes=False)
+    z = np.zeros((4, 32), np.uint8)
+    msgs = np.zeros((4, 120), np.uint8)
+    lens = np.full((4,), 120, np.int64)
+    args = B._padded_lane_args(z, z, z, msgs, lens, 4)
+    assert args[3].shape[1] == 4          # 2 needed -> 4-block bucket
+
+
+def test_patient_wait_scales_with_lanes():
+    """The patient device wait grows with the submitted window (a deep
+    accumulated window must not be misread as a wedge) and stays
+    bounded so a real wedge still falls back."""
+    small = B.patient_wait_s(256)
+    big = B.patient_wait_s(50_000)
+    assert small >= 2 * B._DEVICE_WAIT_S
+    assert big > small
+    # the work term is capped on top of the configured fail-fast wait
+    assert B.patient_wait_s(10_000_000) <= 2 * B._DEVICE_WAIT_S + 56.0
+
+
+def test_enumerate_gather_buckets_and_sample_shapes():
+    """warm_tables adds the cached-valset route (tables + gather +
+    rlc_gather) to the bundle, and the gather sample args match the
+    runtime dispatch protocol (tab/ok avals straight from the
+    table-build kernel)."""
+    plan = dataclasses.replace(P.active(), warm_lanes=(16,),
+                               warm_blocks=(2,), warm_tables=(64,))
+    keys = [b.key for b in P.enumerate_buckets(plan)]
+    assert "tables:64" in keys
+    assert "gather:64:16x2" in keys and "rlc_gather:64:16x2" in keys
+    gb = next(b for b in P.enumerate_buckets(plan)
+              if b.key == "gather:64:16x2")
+    args = aotbundle.sample_args(gb)
+    tab, ok, idx, r32, s32, blocks, active = args
+    # tab is the ops.group Cached pytree: (16, 20, rows) components
+    assert all(leaf.shape[-1] == 64 for leaf in tab)
+    assert ok.shape == (64,)
+    assert idx.shape == (16,) and idx.dtype == np.int32
+    assert r32.shape == (16, 32) and blocks.shape[:2] == (16, 2)
+    tb = next(b for b in P.enumerate_buckets(plan)
+              if b.key == "tables:64")
+    (pad,) = aotbundle.sample_args(tb)
+    assert pad.shape == (64, 32) and pad.dtype == np.int32
+    # warm_tables changes the plan hash (bundle re-keyed per valset
+    # bucket)
+    assert P.plan_hash(plan) != P.plan_hash()
