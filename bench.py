@@ -31,6 +31,10 @@ so the driver always records a result.
              (BASELINE configs[3], light/client.go:609 redesign)
 - merkle:    10k-leaf root+proofs + part-set proof build through the
              level-order dispatch vs the recursive hashlib reference
+- light-serve: one validator serving a simulated skipping-client fleet
+             through the light/serve.py tier — proofs/s + request p99
+             with /status probed throughout, vs the per-proof re-hash
+             baseline
 """
 
 from __future__ import annotations
@@ -644,6 +648,233 @@ def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def _child_lightserve(n_clients: int, n_conns: int, n_txs: int,
+                      proofs_per_req: int) -> None:
+    """Light-serving tier under a simulated skipping-client fleet: one
+    validator node serves ``n_clients`` logical light clients (each a
+    coroutine doing the real bootstrap round trips — a batched
+    ``light_blocks`` fetch, a ``light_proofs`` batch, and a
+    ``light_verify`` trust-anchor check — multiplexed over ``n_conns``
+    keep-alive connections), while a prober hits ``/status`` throughout.
+
+    Reports proofs/s and request p50/p99, the /status latency under
+    load (the admission gate + worker-thread discipline is what keeps it
+    flat), the tier's cache hit tallies, and ``vs_baseline``: the
+    server-side cost of the SAME proof workload through the per-proof
+    re-hash baseline (one reference tree build per proof — the seed's
+    ``_tx_proof_provider`` shape without a cache) over the tier's
+    cached-tree batch path."""
+    import asyncio
+
+    def note(msg):
+        print(f"[bench:light-serve] {msg}", file=sys.stderr, flush=True)
+
+    from cometbft_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+    import numpy as np
+
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    async def drive() -> dict:
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.mempool.size = max(20000, n_txs * 2)
+        cfg.base.signature_backend = "cpu"
+        pv = MockPV.from_secret(b"bench-lightserve")
+        doc = GenesisDoc(chain_id="bench-ls",
+                         validators=[GenesisValidator(pv.get_pub_key(),
+                                                      10)])
+        node = await Node.create(doc, KVStoreApplication(),
+                                 priv_validator=pv, config=cfg,
+                                 name="bench-ls")
+        await node.start()
+        try:
+            note(f"seeding a block with {n_txs} txs")
+            for i in range(n_txs):
+                await node.mempool.check_tx(b"bls%d=v" % i)
+            deadline = time.monotonic() + 60
+            tx_height, tx_count = None, 0
+            while time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+                if node.mempool.size() == 0 and \
+                        node.block_store.height() >= 2:
+                    for h in range(1, node.block_store.height() + 1):
+                        blk = node.block_store.load_block(h)
+                        if blk is not None and len(blk.data.txs) > tx_count:
+                            tx_height, tx_count = h, len(blk.data.txs)
+                    break
+            if tx_height is None:
+                raise RuntimeError("seed txs never committed")
+            # one more height so tx_height's commit is canonical
+            target = node.block_store.height() + 1
+            while node.block_store.height() < target and \
+                    time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            note(f"serving block: height {tx_height} with {tx_count} txs")
+
+            host, port = node.rpc_addr
+            tip = node.block_store.height()
+            boot_heights = list(range(max(1, tip - 7), tip + 1))
+            cli0 = HTTPClient(host, port)
+            ent = await cli0.call("light_block", height=tx_height)
+            hot_anchors = [{"height": tx_height,
+                            "commit": ent["light_block"]["commit"]}]
+            rng = np.random.default_rng(2026)
+            idx_sets = [sorted(rng.choice(tx_count,
+                                          size=min(proofs_per_req,
+                                                   tx_count),
+                                          replace=False).tolist())
+                        for _ in range(64)]
+
+            lat = {"light_blocks": [], "light_proofs": [],
+                   "light_verify": []}
+            served = {"proofs": 0}
+            clients = [HTTPClient(host, port) for _ in range(n_conns)]
+
+            async def one_client(i: int) -> None:
+                cli = clients[i % n_conns]
+                t0 = time.perf_counter()
+                await cli.call("light_blocks", heights=boot_heights)
+                lat["light_blocks"].append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                pr = await cli.call("light_proofs", height=tx_height,
+                                    kind="tx",
+                                    indexes=idx_sets[i % len(idx_sets)])
+                lat["light_proofs"].append(time.perf_counter() - t0)
+                served["proofs"] += len(pr["proofs"])
+                t0 = time.perf_counter()
+                await cli.call("light_verify",
+                               anchors=[hot_anchors[0]])
+                lat["light_verify"].append(time.perf_counter() - t0)
+
+            status_lat = []
+            stop_probe = asyncio.Event()
+
+            async def probe_status() -> None:
+                pc = HTTPClient(host, port)
+                while not stop_probe.is_set():
+                    t0 = time.perf_counter()
+                    await pc.call("status")
+                    status_lat.append(time.perf_counter() - t0)
+                    try:
+                        await asyncio.wait_for(stop_probe.wait(), 0.05)
+                    except asyncio.TimeoutError:
+                        pass
+                await pc.close()
+
+            note(f"driving {n_clients} simulated skipping clients over "
+                 f"{n_conns} connections (3 RPCs each)")
+            prober = asyncio.create_task(probe_status())
+            t_wall = time.perf_counter()
+            await asyncio.gather(*(one_client(i)
+                                   for i in range(n_clients)))
+            t_wall = time.perf_counter() - t_wall
+            stop_probe.set()
+            await prober
+            for c in clients:
+                await c.close()
+
+            st = await cli0.call("status")
+            ls_stats = st.get("light_serve") or {}
+            await cli0.close()
+
+            # ---- server-side baseline: per-proof re-hash ----------------
+            note("server-side baseline: per-proof re-hash vs cached tree")
+            from cometbft_tpu.types.header import tx_hash as _txh
+
+            blk = node.block_store.load_block(tx_height)
+            leaves = [_txh(t) for t in blk.data.txs]
+            idxs = idx_sets[0]
+            tier = node.light_serve
+            reps = 20
+
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                tier.proofs(tx_height, "tx", idxs)
+            t_cached = (time.perf_counter() - t0) / reps
+
+            t0 = time.perf_counter()
+            for _ in range(3):
+                for i in idxs:           # one full re-hash PER PROOF
+                    _root, prs = merkle.proofs_from_byte_slices_reference(
+                        leaves)
+                    _ = prs[i]
+            t_rehash = (time.perf_counter() - t0) / 3
+
+            all_lat = sorted(lat["light_blocks"] + lat["light_proofs"]
+                             + lat["light_verify"])
+            nreq = len(all_lat)
+
+            def pct(v, q):
+                return float(np.percentile(v, q)) if v else 0.0
+
+            return {
+                "metric": f"light-serve proofs/s ({n_clients} simulated "
+                          f"skipping clients, {tx_count}-tx block, "
+                          f"{len(idxs)} proofs/req)",
+                "value": round(served["proofs"] / t_wall, 1),
+                "unit": "proofs/s",
+                # per-proof re-hash baseline vs the cached-tree batch
+                # path, same proof workload, measured server-side
+                "vs_baseline": round(t_rehash / t_cached, 2),
+                "requests_per_s": round(nreq / t_wall, 1),
+                "p50_request_ms": round(pct(all_lat, 50) * 1e3, 2),
+                "p99_request_ms": round(pct(all_lat, 99) * 1e3, 2),
+                "p99_bootstrap_ms": round(
+                    pct(lat["light_blocks"], 99) * 1e3, 2),
+                "p99_proofs_ms": round(
+                    pct(lat["light_proofs"], 99) * 1e3, 2),
+                "p99_verify_ms": round(
+                    pct(lat["light_verify"], 99) * 1e3, 2),
+                "status_p99_ms": round(pct(status_lat, 99) * 1e3, 2),
+                "status_max_ms": round(
+                    max(status_lat) * 1e3 if status_lat else 0.0, 2),
+                "status_samples": len(status_lat),
+                "wall_s": round(t_wall, 3),
+                "proofs_served": served["proofs"],
+                "cached_batch_ms": round(t_cached * 1e3, 3),
+                "rehash_batch_ms": round(t_rehash * 1e3, 3),
+                "header_cache_hit_rate": round(
+                    ls_stats.get("header_hits", 0)
+                    / max(1, ls_stats.get("header_hits", 0)
+                          + ls_stats.get("header_misses", 0)), 4),
+                "verify_memo_hit_rate": round(
+                    ls_stats.get("verify_hits", 0)
+                    / max(1, ls_stats.get("verify_hits", 0)
+                          + ls_stats.get("verify_misses", 0)), 4),
+                "proof_cache_hits": ls_stats.get("proof_hits", 0),
+                "clients": n_clients,
+                "connections": n_conns,
+                "txs_in_block": tx_count,
+                "backend": "cpu",
+            }
+        finally:
+            await node.stop()
+
+    result = asyncio.run(drive())
+    out_path = os.environ.get(
+        "BENCH_OUT", os.path.join(REPO, "docs", "bench",
+                                  "r14-light-serve-cpu.json"))
+    try:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        note(f"wrote {out_path}")
+    except OSError as e:
+        note(f"could not write {out_path}: {e}")
+    print(json.dumps(result), flush=True)
+
+
 def _child_votegossip(backend: str, n_vals: int, dup_k: int,
                       n_slots: int) -> None:
     """Synthetic N-peer vote-gossip storm: every validator's precommit
@@ -828,6 +1059,12 @@ def _child_main(backend: str, nsig: int) -> None:
         return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
                            float(os.environ.get("BENCH_DURATION", "20")),
                            int(os.environ.get("BENCH_TX_SIZE", "256")))
+    if mode == "light-serve":
+        return _child_lightserve(
+            int(os.environ.get("BENCH_LS_CLIENTS", "10000")),
+            int(os.environ.get("BENCH_LS_CONNS", "32")),
+            int(os.environ.get("BENCH_LS_TXS", "512")),
+            int(os.environ.get("BENCH_LS_PROOFS", "8")))
     if mode == "light":
         return _child_light(backend,
                             int(os.environ.get("BENCH_HEADERS", "1000")),
@@ -1048,10 +1285,10 @@ def main() -> None:
     forced = os.environ.get("BENCH_BACKEND", "").strip().lower()
     platforms = os.environ.get("JAX_PLATFORMS", "")
     want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
-    if os.environ.get("BENCH_MODE") == "node":
-        # the node child hard-forces CPU (the full-stack throughput
-        # measurement has no device leg): skip the accelerator probe
-        # and the redundant tpu-labeled attempt
+    if os.environ.get("BENCH_MODE") in ("node", "light-serve"):
+        # these children hard-force CPU (full-stack measurements whose
+        # bottleneck is the node, not a device leg): skip the
+        # accelerator probe and the redundant tpu-labeled attempt
         want_tpu = False
         forced = "cpu"
 
@@ -1143,6 +1380,8 @@ def main() -> None:
         "node": ("single-node end-to-end throughput", "tx/s"),
         "vote-gossip": ("vote-gossip verification storm, arrivals/sec",
                         "events/s"),
+        "light-serve": ("light-serve proofs/s under simulated "
+                        "skipping clients", "proofs/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

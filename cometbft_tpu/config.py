@@ -169,6 +169,34 @@ class BlockSyncConfig:
 
 
 @dataclass
+class LightServeConfig:
+    """Light-client serving tier (light/serve.py): batched proof/header
+    RPC for fleet-scale bootstrap.  The tier is passive (no background
+    tasks); these knobs bound its memory and per-request work."""
+
+    enable: bool = True
+    # signed header + canonical commit + validator set LRU entries;
+    # entries whose header leaves the trust period are evicted on sight
+    header_cache_size: int = 4096
+    # approximate byte budget for the header LRU (commit JSON dominates
+    # at large validator counts; 0 = count-bounded only)
+    header_cache_bytes: int = 256 * 1024 * 1024
+    # per-block merkle proof trees retained ((height, kind) entries —
+    # a 10k-leaf tree is ~640 kB of nodes)
+    proof_cache_blocks: int = 64
+    # whole-commit verdict memo entries for client-supplied trust
+    # anchors (positive verdicts only)
+    verify_cache_size: int = 4096
+    # trusting period that keys the header LRU window; defaults to the
+    # statesync trust period (the same clients consume both)
+    trust_period_ns: int = 168 * 3600 * NS
+    # per-request bounds: heights per light_blocks / anchors per
+    # light_verify, and proofs per light_proofs
+    max_batch: int = 128
+    max_proofs: int = 4096
+
+
+@dataclass
 class StateSyncConfig:
     enable: bool = False
     trust_height: int = 0
@@ -328,6 +356,7 @@ class Config:
     rpc: RPCConfig = field(default_factory=RPCConfig)
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    lightserve: LightServeConfig = field(default_factory=LightServeConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(
@@ -344,11 +373,14 @@ class Config:
 
         lines = ["# cometbft_tpu node configuration", ""]
         for section_name in ("base", "consensus", "mempool", "p2p", "rpc",
-                             "blocksync", "statesync", "storage", "tx_index",
-                             "instrumentation", "chaos"):
+                             "blocksync", "statesync", "lightserve",
+                             "storage", "tx_index", "instrumentation",
+                             "chaos"):
             section = getattr(self, section_name)
             lines.append(f"[{section_name}]")
             for f_ in dataclasses.fields(section):
+                if (section_name, f_.name) in _DEPRECATED_KEYS:
+                    continue   # load-compat only; never re-emitted
                 v = getattr(section, f_.name)
                 lines.append(f"{f_.name} = {_toml_value(v)}")
             lines.append("")
@@ -385,6 +417,17 @@ class Config:
                     raise ConfigError(
                         f"unknown config key {section_name}.{k}")
                 setattr(section, k, v)
+        if "batch_size" in doc.get("blocksync", {}):
+            # dead knob kept only so configs written by older nodes still
+            # load — it was never wired, and silence teaches operators it
+            # tunes something.  The accumulator depth they want is
+            # blocksync.verify_window.
+            from .libs import log as _tmlog
+
+            _tmlog.logger("config").warn(
+                "blocksync.batch_size is deprecated and has no effect; "
+                "use blocksync.verify_window to size the cross-block "
+                "verification window", path=path)
         cfg.validate()
         return cfg
 
@@ -475,6 +518,17 @@ class Config:
         if self.storage.doctor_deep_scan_window < 0:
             raise ConfigError(
                 "storage.doctor_deep_scan_window must be >= 0")
+        ls = self.lightserve
+        if ls.header_cache_size < 0 or ls.proof_cache_blocks < 0 or \
+                ls.verify_cache_size < 0 or ls.header_cache_bytes < 0:
+            raise ConfigError(
+                "lightserve cache sizes must be >= 0")
+        if ls.trust_period_ns <= 0:
+            raise ConfigError("lightserve.trust_period_ns must be positive")
+        if ls.max_batch < 1:
+            raise ConfigError("lightserve.max_batch must be >= 1")
+        if ls.max_proofs < 1:
+            raise ConfigError("lightserve.max_proofs must be >= 1")
         if not 2 <= self.blocksync.verify_window <= 4096:
             # floor 2: the accumulator needs a vouching tail block;
             # cap 4096: one window's commits already fill the largest
@@ -497,6 +551,11 @@ class Config:
 
 class ConfigError(Exception):
     pass
+
+
+# keys kept on the dataclasses so configs written by older nodes still
+# load, but never re-emitted and warned about when a file sets them
+_DEPRECATED_KEYS = {("blocksync", "batch_size")}
 
 
 def _toml_value(v) -> str:

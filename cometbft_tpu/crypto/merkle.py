@@ -519,6 +519,68 @@ def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
     return _proofs_from_levels(_build_levels(items), total)
 
 
+class TreeCache:
+    """Retained per-level node cache of one tree: build the levels ONCE
+    (through the same engine dispatch as :func:`proofs_from_byte_slices`),
+    then emit inclusion proofs for ARBITRARY leaf indexes by pure index
+    arithmetic over the cached levels — zero re-hashing per proof.
+
+    This is the light-serving seam: a block's tx/validator tree is built
+    on the first proof request and every later request (any subset of
+    indexes, any order, any number of clients) is a gather.  Unlike
+    :func:`proofs_from_byte_slices` it does not materialize all N proofs
+    up front, so a 10k-leaf block whose clients only ever ask for a few
+    hundred leaves never pays the full assembly.
+
+    Proofs are bit-identical to the reference builder (aunts bottom-up,
+    promoted odd-tail nodes skipped), pinned by tests."""
+
+    __slots__ = ("levels", "total")
+
+    def __init__(self, levels: list[list[bytes]], total: int):
+        self.levels = levels
+        self.total = total
+
+    @classmethod
+    def build(cls, items: list[bytes]) -> "TreeCache":
+        n = len(items)
+        if n == 0:
+            return cls([[_sha(b"")]], 0)
+        if n < _PROOF_LEVEL_MIN:
+            return cls(_levels_hashlib(items), n)
+        return cls(_build_levels(items), n)
+
+    @property
+    def root(self) -> bytes:
+        return self.levels[-1][0]
+
+    def nbytes(self) -> int:
+        """Approximate retained size (cache accounting): 32 bytes per
+        node across every level."""
+        return 32 * sum(len(lv) for lv in self.levels)
+
+    def proof(self, index: int) -> Proof:
+        """Inclusion proof for leaf ``index`` (raises IndexError when out
+        of range).  The ancestor of leaf i at level l is node i >> l, its
+        sibling (i >> l) ^ 1 — absent exactly when the sibling index
+        falls off the level's width (promoted odd tail)."""
+        total = self.total
+        if not 0 <= index < total:
+            raise IndexError(f"leaf {index} out of range (total {total})")
+        if total == 1:
+            return Proof(1, 0, self.levels[0][0], ())
+        aunts = []
+        for lvl_i in range(len(self.levels) - 1):
+            nodes = self.levels[lvl_i]
+            sib = (index >> lvl_i) ^ 1
+            if sib < len(nodes):
+                aunts.append(nodes[sib])
+        return Proof(total, index, self.levels[0][index], tuple(aunts))
+
+    def proofs(self, indexes) -> list[Proof]:
+        return [self.proof(i) for i in indexes]
+
+
 # ------------------------------------------------------------- proof ops
 # (crypto/merkle/proof_op.go + proof_value.go: composable proof chains for
 # multi-store queries — ProofOperators.Verify walks ops leaf-to-root,

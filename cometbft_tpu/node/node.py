@@ -111,6 +111,7 @@ class Node:
         self.name = "node"
         self.doctor_report = None
         self.compile_bundle_info = None
+        self.light_serve = None
         self._started = False
         self._data_lock = None
         self._vote_sched = None
@@ -342,6 +343,26 @@ class Node:
             self.indexer_service = IndexerService(
                 self.event_bus, sink, self.block_indexer,
                 name=f"{name}.idx")
+
+        if cfg.lightserve.enable:
+            # light-client serving tier (light/serve.py): passive — no
+            # background tasks, read by the light_* RPC routes in worker
+            # threads.  Constructed here (not at RPC start) so in-proc
+            # tooling can drive it without a listener.
+            from ..light.serve import LightServeTier
+
+            ls_cfg = cfg.lightserve
+            self.light_serve = LightServeTier(
+                self.block_store, self.state_store, genesis_doc.chain_id,
+                backend=cfg.base.signature_backend,
+                header_cache_size=ls_cfg.header_cache_size,
+                header_cache_bytes=ls_cfg.header_cache_bytes,
+                proof_cache_blocks=ls_cfg.proof_cache_blocks,
+                verify_cache_size=ls_cfg.verify_cache_size,
+                trust_period_ns=ls_cfg.trust_period_ns,
+                max_batch=ls_cfg.max_batch,
+                max_proofs=ls_cfg.max_proofs,
+                name=name)
 
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.switch.add_reactor("consensus", self.consensus_reactor)

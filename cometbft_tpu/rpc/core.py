@@ -117,6 +117,11 @@ async def status(env: Environment) -> dict:
         # AOT compile-bundle state (crypto/aotbundle): version, plan
         # shape and per-bucket cold/warm — whether this node booted warm
         "compile_bundle": getattr(node, "compile_bundle_info", None),
+        # light-serving tier tallies (light/serve.py): cache hit/miss/
+        # eviction counts, proofs and blocks served, anchor verdicts
+        "light_serve": (node.light_serve.stats()
+                        if getattr(node, "light_serve", None) is not None
+                        else None),
     }
 
 
@@ -339,6 +344,68 @@ async def consensus_params(env: Environment, height=None) -> dict:
     if params is None:
         raise RPCError(-32603, f"no consensus params at height {h}")
     return {"block_height": h, "consensus_params": _params_jsonable(params)}
+
+
+# --------------------------------------------------------- light serving
+# (light/serve.py LightServeTier: batched proof/header RPC for
+# fleet-scale light-client bootstrap.  Every handler runs the tier's
+# synchronous, thread-safe work in a worker thread — proof-tree builds
+# and commit verification must never stall the event loop — and every
+# route is behind the admission gate, so overload sheds with 503 +
+# Retry-After while the diagnostics stay responsive.)
+
+def _light_serve(env: Environment):
+    tier = getattr(env.node, "light_serve", None)
+    if tier is None:
+        raise RPCError(-32601, "light-client serving tier is disabled "
+                       "(lightserve.enable = false)")
+    return tier
+
+
+async def _ls_call(env: Environment, method: str, *args) -> dict:
+    from ..light.serve import LightServeError
+
+    tier = _light_serve(env)
+    try:
+        return await asyncio.to_thread(getattr(tier, method), *args)
+    except LightServeError as e:
+        raise RPCError(e.code, str(e)) from e
+
+
+async def light_block(env: Environment, height=None) -> dict:
+    """One signed header + canonical commit + validator set — everything
+    a light client needs to verify a height — served out of the tier's
+    trust-period LRU.  ``canonical: false`` marks a tip answered from the
+    seen-commit (not yet superseded by the next block)."""
+    return await _ls_call(env, "light_block", height)
+
+
+async def light_blocks(env: Environment, heights=None) -> dict:
+    """Batched light-block bootstrap: many heights in ONE request (list
+    or comma-separated string), each entry either a light block or a
+    per-height error.  Bounded by ``lightserve.max_batch``."""
+    return await _ls_call(env, "light_blocks", heights)
+
+
+async def light_proofs(env: Environment, height=None, kind="tx",
+                       indexes=None) -> dict:
+    """Batched merkle inclusion proofs for one block: the per-level node
+    cache is built once per (height, kind) and every requested index is
+    gathered out of it with zero re-hashing.  ``kind`` is ``tx`` (leaves
+    under the header's data_hash) or ``validator`` (leaves under
+    validators_hash); ``indexes`` is a list or comma-separated string
+    (omitted = every leaf, bounded by ``lightserve.max_proofs``)."""
+    return await _ls_call(env, "proofs", height, str(kind), indexes)
+
+
+async def light_verify(env: Environment, anchors=None) -> dict:
+    """Batched server-side verification of client-supplied trust
+    anchors (``[{height, commit}, ...]``): per anchor, attest that the
+    commit is a valid > 2/3 commit of THIS chain's block at that height.
+    Same-valset anchors verify in single batched dispatches riding the
+    verified-signature dedup cache; identical hot anchors hit a
+    whole-commit verdict memo (``cached: true``)."""
+    return await _ls_call(env, "verify_commits", anchors)
 
 
 # ------------------------------------------------------------- consensus
@@ -774,6 +841,10 @@ ROUTES = {
     "check_tx": check_tx,
     "dump_trace": dump_trace,
     "dump_incidents": dump_incidents,
+    "light_block": light_block,
+    "light_blocks": light_blocks,
+    "light_proofs": light_proofs,
+    "light_verify": light_verify,
 }
 
 # registered only when config rpc.unsafe is set (rpc/core/routes.go:57-62)

@@ -47,8 +47,13 @@ UNGATED_METHODS = frozenset(
 # exemption; anything larger is gated unconditionally so a flood of fat
 # bodies can't buy a 10MB json.loads per shed request
 _GATE_PROBE_MAX_BODY = 4096
-# responses that can run megabytes: serialize in a worker thread
-_THREAD_ENCODE_METHODS = frozenset({"dump_incidents", "dump_trace"})
+# responses that can run megabytes: serialize in a worker thread (the
+# light-serve routes ship whole proof sets / light-block batches, and
+# even a single light_block embeds the full valset JSON — ~1 MB at 10k
+# validators on the provider's preferred single-round-trip path)
+_THREAD_ENCODE_METHODS = frozenset(
+    {"dump_incidents", "dump_trace",
+     "light_block", "light_blocks", "light_proofs", "light_verify"})
 
 
 @functools.cache

@@ -436,7 +436,8 @@ class ErrBatchItemInvalid(CommitVerificationError):
 
 def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
                                  items: list, backend: str | None = None,
-                                 patient: bool = False) -> int:
+                                 patient: bool = False,
+                                 use_cache: bool = False) -> int:
     """VerifyCommitLight over MANY commits sharing one validator set in a
     single device batch — the blocksync cross-block batching seam
     (reference verifies one commit per block sequentially at
@@ -444,26 +445,40 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
     TPU dispatch, BASELINE configs[4]).
 
     ``items`` is a list of ``(block_id, height, commit)``.  Returns the
-    number of signatures verified.  Raises ErrBatchItemInvalid naming the
-    first offending item.  ``patient`` is the blocksync accumulator's
-    staging mode: the device dispatch queues behind an in-flight window
-    instead of host-falling-back (``crypto/batch._device_call``).
+    number of signatures proven (dispatched + cache-proven).  Raises
+    ErrBatchItemInvalid naming the first offending item.  ``patient`` is
+    the blocksync accumulator's staging mode: the device dispatch queues
+    behind an in-flight window instead of host-falling-back
+    (``crypto/batch._device_call``).
+
+    ``use_cache`` consults and seeds the verified-signature dedup cache
+    (``crypto/scheduler``) per lane: a commit re-verified for the second
+    client (the light-serving tier's hot-anchor workload) costs dict
+    hits instead of scalar multiplications.  Default False — blocksync
+    and light-client callers verify commits never gossiped here, and
+    evidence-grade callers must never trust a cache.
 
     Demux contract for callers applying per item: when the raised
     error's ``cause`` is :class:`ErrInvalidSignature`, every item BEFORE
-    ``err.item`` had all its selected lanes verified valid (lane order
-    is item order and the dispatch computes every verdict before
-    raising on the first bad lane).  Any other cause is a pre-dispatch
-    basics/tally failure — earlier items were NOT signature-checked and
-    need their own verification pass before being trusted.
+    ``err.item`` had all its selected lanes proven valid (lane order is
+    item order; the dispatch computes every verdict before raising on
+    the first bad lane, and cache-proven lanes hold positive verdicts by
+    construction).  Any other cause is a pre-dispatch basics/tally
+    failure — earlier items were NOT signature-checked and need their
+    own verification pass before being trusted.
     """
+    from ..crypto import scheduler as _vsched
+
     n = _dense_verify_commits_batched(chain_id, vals, items,
                                       backend or _DEFAULT_BACKEND,
-                                      patient=patient)
+                                      patient=patient, use_cache=use_cache)
     if n is not None:
         return n
     bv = cryptobatch.create_batch_verifier(backend or _DEFAULT_BACKEND)
     lanes: list[tuple[int, int]] = []      # (item idx, commit-sig idx)
+    seeds: list[tuple] = []
+    cache_on = use_cache and _vsched.cache_active()
+    n_hits = 0
     needed = vals.total_voting_power() * 2 // 3
     for k, (block_id, height, commit) in enumerate(items):
         try:
@@ -475,9 +490,15 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
             if not cs.is_commit():
                 continue
             val = vals.get_by_index(idx)
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx),
-                   cs.signature)
-            lanes.append((k, idx))
+            msg = commit.vote_sign_bytes(chain_id, idx)
+            if cache_on and _vsched.cache_lookup(val.pub_key.bytes(), msg,
+                                                 cs.signature):
+                n_hits += 1            # proven before: free lane
+            else:
+                bv.add(val.pub_key, msg, cs.signature)
+                lanes.append((k, idx))
+                if cache_on:
+                    seeds.append((val.pub_key.bytes(), msg, cs.signature))
             tally += val.voting_power
             if tally > needed:
                 break
@@ -491,19 +512,24 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
             k, idx = lanes[oks.index(False)]
             raise ErrBatchItemInvalid(k, items[k][1],
                                       ErrInvalidSignature(idx))
-    return len(lanes)
+        for s in seeds:
+            _vsched.cache_seed(*s)
+    return len(lanes) + n_hits
 
 
 def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
                                   items: list, backend: str,
-                                  patient: bool = False) -> int | None:
+                                  patient: bool = False,
+                                  use_cache: bool = False) -> int | None:
     """Vectorized core of :func:`verify_commits_light_batched`: per-commit
     basics/tally checks in item order (matching the loop's raise order),
-    then ONE dense verification over every selected lane of every commit.
-    Returns the lane count, or None when not applicable (caller loops)."""
+    then ONE dense verification over every selected lane of every commit
+    (minus verified-sig-cache hits when ``use_cache``).  Returns the lane
+    count, or None when not applicable (caller loops)."""
     import numpy as np
 
     from ..crypto import _native_ed25519 as nat
+    from ..crypto import scheduler as _vsched
 
     dense = vals.dense()
     if dense is None or not nat.available():
@@ -545,19 +571,40 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
     # pad defensively if a template ever differs
     sel_msgs = [m if m.shape[1] == stride else np.pad(
         m, ((0, 0), (0, stride - m.shape[1]))) for m in sel_msgs]
-    res = cryptobatch.verify_dense(
-        backend, np.ascontiguousarray(np.concatenate(sel_pubs)),
-        np.ascontiguousarray(np.concatenate(sel_sigs)),
-        np.ascontiguousarray(np.concatenate(sel_msgs)),
-        np.concatenate(sel_lens),
-        valset_pubs=pubs, scope=np.concatenate(sel_scope),
-        patient=patient)
-    if res is None:
-        return None
-    ok, oks = res
-    if not ok:
-        k, idx = lanes[int(np.nonzero(~oks)[0][0])]
-        raise ErrBatchItemInvalid(k, items[k][1], ErrInvalidSignature(idx))
+    pubs_all = np.ascontiguousarray(np.concatenate(sel_pubs))
+    sigs_all = np.ascontiguousarray(np.concatenate(sel_sigs))
+    msgs_all = np.ascontiguousarray(np.concatenate(sel_msgs))
+    lens_all = np.concatenate(sel_lens)
+    scope_all = np.concatenate(sel_scope)
+    keys = None
+    if use_cache and _vsched.cache_active():
+        # per-lane dedup-cache consult (same key material as the single-
+        # commit dense paths): hit lanes hold positive verdicts and drop
+        # out of the dispatch — a hot anchor commit re-verified for the
+        # k-th light client costs k-1 dict sweeps, not k dispatches.
+        # Gated on cache_active (not dense_cache_active): opt-in callers
+        # are the serving tier, whose FIRST verification must seed.
+        mask, keys = _cache_split(pubs_all, sigs_all, msgs_all, lens_all)
+        live = np.nonzero(~mask)[0]
+    else:
+        live = np.arange(len(lanes))
+    if live.size:
+        res = cryptobatch.verify_dense(
+            backend, np.ascontiguousarray(pubs_all[live]),
+            np.ascontiguousarray(sigs_all[live]),
+            np.ascontiguousarray(msgs_all[live]), lens_all[live],
+            valset_pubs=pubs, scope=scope_all[live],
+            patient=patient)
+        if res is None:
+            return None
+        ok, oks = res
+        if not ok:
+            k, idx = lanes[int(live[np.nonzero(~oks)[0][0]])]
+            raise ErrBatchItemInvalid(k, items[k][1],
+                                      ErrInvalidSignature(idx))
+        if keys is not None:
+            for j in live:
+                _vsched.cache_seed(*keys[int(j)])
     return len(lanes)
 
 

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""CI light-serving smoke: boot one validator, then drive the serving
+tier the way a bootstrapping light-client fleet would —
+
+- ``light_blocks`` batch bootstrap over every height in one request,
+- ``light_proofs`` over a block that carries txs, each proof verified
+  CLIENT-SIDE against the header's data_hash,
+- repeated ``light_block`` / ``light_verify`` calls must hit the header
+  LRU and the whole-commit verdict memo (cache hits asserted via the
+  /status light_serve block),
+- a concurrent burst against a tightened admission gate must shed with
+  503 + Retry-After while GET /status keeps answering 200.
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow (`.github/workflows/lint.yml`); runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_lightserve.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+async def raw_get(host: str, port: int, path: str):
+    """(status, headers, body) over a one-shot connection."""
+    r, w = await asyncio.open_connection(host, port)
+    w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+            "Connection: close\r\n\r\n".encode())
+    await w.drain()
+    raw = await r.read()
+    w.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    lines = head.decode().split("\r\n")
+    status = int(lines[0].split(" ")[1])
+    headers = {}
+    for ln in lines[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+async def main() -> int:
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.crypto import merkle
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.header import tx_hash
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    cfg = Config(consensus=test_consensus_config())
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    # tight gate so the burst below actually sheds: 2 concurrent slots,
+    # no wait queue.  The sequential driving before it never holds more
+    # than one slot.
+    cfg.rpc.max_concurrent_requests = 2
+    cfg.rpc.max_queued_requests = 0
+    cfg.rpc.shed_retry_after_s = 1.0
+
+    pv = MockPV.from_secret(b"smoke-lightserve")
+    doc = GenesisDoc(chain_id="smoke-ls",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    node = await Node.create(doc, KVStoreApplication(), priv_validator=pv,
+                             config=cfg, name="smoke-ls")
+    await node.start()
+    try:
+        host, port = node.rpc_addr
+        cli = HTTPClient(host, port)
+
+        # a block with several txs for the proof workload
+        txs = [b"smk%d=v%d" % (i, i) for i in range(8)]
+        for t in txs:
+            await cli.call("broadcast_tx_sync", tx=t.hex())
+        deadline = time.monotonic() + 30
+        tx_height = None
+        while time.monotonic() < deadline and tx_height is None:
+            await asyncio.sleep(0.05)
+            for h in range(1, node.block_store.height() + 1):
+                blk = node.block_store.load_block(h)
+                if blk is not None and len(blk.data.txs) >= len(txs):
+                    tx_height = h
+                    break
+        if tx_height is None:
+            return fail("txs never landed in one block")
+        # one more height so tx_height's commit is canonical
+        target = node.block_store.height() + 1
+        while time.monotonic() < deadline and \
+                node.block_store.height() < target:
+            await asyncio.sleep(0.05)
+
+        # ---- batched light-block bootstrap --------------------------------
+        tip = node.block_store.height()
+        heights = list(range(1, min(tip, 64) + 1))
+        out = await cli.call("light_blocks", heights=heights)
+        bad = [e for e in out["light_blocks"] if "error" in e]
+        if bad:
+            return fail(f"light_blocks returned errors: {bad[:2]}")
+        print(f"[smoke-ls] bootstrap: {len(heights)} light blocks in "
+              f"one request (tip {tip})")
+
+        # ---- batched proofs, verified client-side -------------------------
+        blk = node.block_store.load_block(tx_height)
+        data_hash = blk.header.data_hash
+        pr = await cli.call("light_proofs", height=tx_height, kind="tx")
+        if pr["total"] != len(blk.data.txs):
+            return fail(f"proof total {pr['total']} != {len(blk.data.txs)}")
+        if bytes.fromhex(pr["root"]) != data_hash:
+            return fail("proof root != header data_hash")
+        for p in pr["proofs"]:
+            proof = merkle.Proof(
+                p["total"], p["index"], bytes.fromhex(p["leaf_hash"]),
+                tuple(bytes.fromhex(a) for a in p["aunts"]))
+            if not proof.verify(data_hash, tx_hash(blk.data.txs[p["index"]])):
+                return fail(f"proof {p['index']} failed verification")
+        print(f"[smoke-ls] {len(pr['proofs'])} tx proofs verified against "
+              "data_hash")
+
+        # ---- cache hits: header LRU + verdict memo ------------------------
+        ent = await cli.call("light_block", height=tx_height)
+        anchor = {"height": tx_height,
+                  "commit": ent["light_block"]["commit"]}
+        v1 = await cli.call("light_verify", anchors=[anchor])
+        if v1["ok"] != 1 or v1["results"][0]["cached"]:
+            return fail(f"first anchor verify wrong: {v1}")
+        v2 = await cli.call("light_verify", anchors=[anchor])
+        if not v2["results"][0].get("cached"):
+            return fail("second anchor verify missed the verdict memo")
+        await cli.call("light_block", height=tx_height)
+        st = await cli.call("status")
+        ls = st.get("light_serve") or {}
+        if not ls.get("header_hits"):
+            return fail(f"no header cache hits in /status: {ls}")
+        if not ls.get("verify_hits"):
+            return fail(f"no verify memo hits in /status: {ls}")
+        print(f"[smoke-ls] cache hits: header={ls['header_hits']} "
+              f"verify={ls['verify_hits']} proofs_served="
+              f"{ls['proofs_served']}")
+
+        # ---- overload: burst sheds 503, /status stays up ------------------
+        orig = node.light_serve.proofs
+
+        def slow_proofs(*a, **kw):
+            time.sleep(0.5)          # hold the gate slot
+            return orig(*a, **kw)
+
+        node.light_serve.proofs = slow_proofs
+        try:
+            burst = [raw_get(host, port,
+                             f"/light_proofs?height={tx_height}&kind=tx")
+                     for _ in range(8)]
+            status_probe = raw_get(host, port, "/status")
+            results = await asyncio.gather(*burst, status_probe)
+            codes = [r[0] for r in results[:-1]]
+            st_code, _, _ = results[-1]
+            sheds = codes.count(503)
+            if sheds < 1:
+                return fail(f"burst never shed (codes {codes})")
+            shed_headers = [r[1] for r in results[:-1] if r[0] == 503]
+            if any("retry-after" not in h for h in shed_headers):
+                return fail("503 without Retry-After")
+            if st_code != 200:
+                return fail(f"/status -> {st_code} during the burst")
+            print(f"[smoke-ls] burst: {sheds}/8 shed with 503+Retry-After, "
+                  "/status stayed 200")
+        finally:
+            node.light_serve.proofs = orig
+        await cli.close()
+        print("[smoke-ls] OK")
+        return 0
+    finally:
+        await node.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

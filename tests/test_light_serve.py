@@ -1,0 +1,469 @@
+"""Light-client serving tier (light/serve.py + the light_* RPC routes):
+merkle TreeCache equivalence, header-LRU hit/miss/evict semantics under
+valset churn and trust-period expiry, trusted-store pruning, batched
+anchor verification (memo, dedup-cache seeding, bad-commit demux), and
+one live-node end-to-end pass over the new routes."""
+
+import asyncio
+import copy
+from types import SimpleNamespace
+
+import pytest
+
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.light.serve import (LightServeError,
+                                      LightServeRequestError,
+                                      LightServeTier)
+from cometbft_tpu.light.store import TrustedStore
+from cometbft_tpu.rpc.json import jsonable
+from cometbft_tpu.testing import make_light_chain
+
+pytestmark = pytest.mark.timeout(120)
+
+CHAIN = "light-chain"
+NS = 1_000_000_000
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# --------------------------------------------------------- stub stores
+
+class StubBlockStore:
+    """Minimal blockstore view over a make_light_chain chain; per-height
+    tx lists are synthesized so the tx proof kind has leaves."""
+
+    def __init__(self, chain, txs_per_block=0):
+        self.by_height = {lb.height: lb for lb in chain}
+        self.txs = {
+            lb.height: [b"tx-%d-%d" % (lb.height, i)
+                        for i in range(txs_per_block)]
+            for lb in chain}
+        self.loads = 0
+
+    def base(self):
+        return min(self.by_height)
+
+    def height(self):
+        return max(self.by_height)
+
+    def load_block(self, h):
+        lb = self.by_height.get(h)
+        if lb is None:
+            return None
+        self.loads += 1
+        return SimpleNamespace(header=lb.header,
+                               data=SimpleNamespace(txs=self.txs[h]))
+
+    def load_block_commit(self, h):
+        lb = self.by_height.get(h)
+        return lb.commit if lb is not None else None
+
+    def load_block_meta(self, h):
+        lb = self.by_height.get(h)
+        if lb is None:
+            return None
+        return SimpleNamespace(block_id=lb.commit.block_id)
+
+    def load_seen_commit(self):
+        return None
+
+
+class StubStateStore:
+    def __init__(self, chain):
+        self.by_height = {lb.height: lb.validators for lb in chain}
+
+    def load_validators(self, h):
+        return self.by_height.get(h)
+
+
+def _tier(chain, *, txs_per_block=0, now_ns=None, **kw):
+    bs = StubBlockStore(chain, txs_per_block=txs_per_block)
+    ss = StubStateStore(chain)
+    kw.setdefault("backend", "cpu")
+    if now_ns is None:
+        def now_ns():
+            return chain[-1].header.time_ns + 60 * NS
+    return LightServeTier(bs, ss, CHAIN, now_ns=now_ns, **kw), bs
+
+
+# ------------------------------------------------------------ TreeCache
+
+def test_tree_cache_matches_reference_builder():
+    for n in (1, 2, 3, 5, 8, 9, 63, 64, 65, 100, 130):
+        items = [b"leaf%d" % i for i in range(n)]
+        root, ref = merkle.proofs_from_byte_slices_reference(items)
+        tc = merkle.TreeCache.build(items)
+        assert tc.root == root
+        assert tc.total == n
+        for i in (0, n // 2, n - 1):
+            assert tc.proof(i) == ref[i]
+            assert tc.proof(i).verify(root, items[i])
+        assert tc.proofs(range(n)) == ref
+    with pytest.raises(IndexError):
+        merkle.TreeCache.build([b"x"]).proof(1)
+
+
+# ------------------------------------------------- header LRU semantics
+
+def test_light_block_cache_hit_miss_and_lru_eviction():
+    chain = make_light_chain(10, n_vals=4)
+    tier, bs = _tier(chain, header_cache_size=4)
+    for h in range(1, 11):
+        res = tier.light_block(h)
+        assert res["height"] == h and res["canonical"]
+        assert res["light_block"]["total_voting_power"] == 40
+    st = tier.stats()
+    assert st["header_misses"] == 10 and st["header_hits"] == 0
+    assert st["evictions_lru"] == 6          # 10 inserts into 4 slots
+    assert st["header_cache_entries"] == 4
+    loads = bs.loads
+    tier.light_block(10)                     # newest: cached
+    assert tier.stats()["header_hits"] == 1
+    assert bs.loads == loads                 # no store touch
+    tier.light_block(1)                      # oldest: evicted -> miss
+    assert tier.stats()["header_misses"] == 11
+
+
+def test_header_cache_byte_budget_evicts():
+    """The header LRU is byte-bounded too: commit JSON dominates at
+    large validator counts, so counting entries alone would let the
+    cache eat gigabytes."""
+    chain = make_light_chain(6, n_vals=4)
+    # each entry estimates 2048 + 200*4 bytes; budget for ~2 entries
+    tier, _bs = _tier(chain, header_cache_size=100,
+                      header_cache_bytes=6000)
+    for h in range(1, 7):
+        tier.light_block(h)
+    st = tier.stats()
+    assert st["header_cache_entries"] == 2
+    assert st["header_cache_bytes"] <= 6000
+    assert st["evictions_lru"] == 4
+
+
+def test_light_block_under_valset_churn():
+    """Rotating validator sets: every height's entry carries ITS OWN
+    valset (hash-checked against the header), and eviction under churn
+    re-loads the right one."""
+    chain = make_light_chain(8, n_vals=4, rotate_every=2)
+    tier, _bs = _tier(chain, header_cache_size=2)
+    from cometbft_tpu.rpc.json import from_jsonable
+
+    for h in (1, 4, 7, 1, 4, 7):             # churn through 2 slots
+        res = tier.light_block(h)
+        vals = from_jsonable(res["light_block"]["validators"])
+        assert vals.hash() == chain[h - 1].header.validators_hash
+    st = tier.stats()
+    assert st["header_misses"] >= 5          # slot churn forced reloads
+    assert st["evictions_lru"] >= 3
+
+
+def test_trust_period_window_evicts_expired_entries():
+    chain = make_light_chain(3, n_vals=4)
+    now = {"ns": chain[-1].header.time_ns + 60 * NS}
+    tier, _bs = _tier(chain, trust_period_ns=3600 * NS,
+                      now_ns=lambda: now["ns"])
+    tier.light_block(2)
+    assert tier.stats()["header_cache_entries"] == 1
+    tier.light_block(2)
+    assert tier.stats()["header_hits"] == 1
+    # the header leaves the trusting period: evicted on sight, still
+    # served (historic queries work), NOT re-cached
+    now["ns"] = chain[1].header.time_ns + 3601 * NS
+    res = tier.light_block(2)
+    assert res["height"] == 2
+    st = tier.stats()
+    assert st["evictions_trust_period"] == 1
+    assert st["header_cache_entries"] == 0
+
+
+def test_light_blocks_batch_and_per_item_errors():
+    chain = make_light_chain(5, n_vals=4)
+    tier, _bs = _tier(chain, max_batch=8)
+    res = tier.light_blocks([1, 3, 99])
+    assert res["latest"] == 5 and res["base"] == 1
+    ok = [e for e in res["light_blocks"] if "light_block" in e]
+    bad = [e for e in res["light_blocks"] if "error" in e]
+    assert [e["height"] for e in ok] == [1, 3]
+    assert bad[0]["height"] == 99 and "not available" in bad[0]["error"]
+    # comma-string heights (URI-style GET)
+    res2 = tier.light_blocks("1,2")
+    assert [e["height"] for e in res2["light_blocks"]] == [1, 2]
+    with pytest.raises(LightServeRequestError):
+        tier.light_blocks(list(range(1, 11)))      # > max_batch
+    with pytest.raises(LightServeRequestError):
+        tier.light_blocks([])
+
+
+# ------------------------------------------------------------- proofs
+
+def test_proofs_served_from_one_tree_build():
+    chain = make_light_chain(3, n_vals=4)
+    tier, _bs = _tier(chain, txs_per_block=40)
+    res = tier.proofs(2, "tx", [0, 7, 39])
+    leaves = [b"tx-2-%d" % i for i in range(40)]
+    from cometbft_tpu.types.header import tx_hash
+
+    root = merkle.hash_from_byte_slices([tx_hash(t) for t in leaves])
+    assert bytes.fromhex(res["root"]) == root
+    assert res["total"] == 40
+    for p, i in zip(res["proofs"], (0, 7, 39)):
+        proof = merkle.Proof(p["total"], p["index"],
+                             bytes.fromhex(p["leaf_hash"]),
+                             tuple(bytes.fromhex(a) for a in p["aunts"]))
+        assert proof.verify(root, tx_hash(leaves[i]))
+    # second request hits the cached tree
+    tier.proofs(2, "tx", "1,2,3")
+    st = tier.stats()
+    assert st["proof_misses"] == 1 and st["proof_hits"] == 1
+    assert st["proofs_served"] == 6
+
+
+def test_validator_proofs_anchor_to_validators_hash():
+    chain = make_light_chain(2, n_vals=7)
+    tier, _bs = _tier(chain)
+    res = tier.proofs(1, "validator")
+    lb = chain[0]
+    assert bytes.fromhex(res["root"]) == lb.header.validators_hash
+    assert res["total"] == 7
+    v3 = lb.validators.validators[3]
+    p = res["proofs"][3]
+    proof = merkle.Proof(p["total"], p["index"],
+                         bytes.fromhex(p["leaf_hash"]),
+                         tuple(bytes.fromhex(a) for a in p["aunts"]))
+    assert proof.verify(lb.header.validators_hash, v3.simple_encode())
+
+
+def test_proof_tree_lru_eviction():
+    chain = make_light_chain(4, n_vals=4)
+    tier, _bs = _tier(chain, txs_per_block=8, proof_cache_blocks=2)
+    for h in (1, 2, 3):
+        tier.proofs(h, "tx", [0])
+    assert tier.stats()["proof_cache_entries"] == 2
+    tier.proofs(1, "tx", [0])                 # evicted: rebuilt
+    st = tier.stats()
+    assert st["proof_misses"] == 4 and st["evictions_lru"] >= 1
+    tier.proofs(1, "tx", [1])                 # fresh again: hit
+    assert tier.stats()["proof_hits"] == 1
+
+
+def test_proofs_request_validation():
+    chain = make_light_chain(2, n_vals=4)
+    tier, _bs = _tier(chain, txs_per_block=4, max_proofs=3)
+    with pytest.raises(LightServeRequestError):
+        tier.proofs(1, "bogus", [0])
+    with pytest.raises(LightServeRequestError):
+        tier.proofs(1, "tx", [4])             # out of range
+    with pytest.raises(LightServeRequestError):
+        tier.proofs(1, "tx", None)            # 4 leaves > max_proofs=3
+    with pytest.raises(LightServeRequestError):
+        tier.proofs(1, "tx", [0, 1, 2, 3])    # > max_proofs
+    with pytest.raises(LightServeError):
+        tier.proofs(77, "tx", [0])            # height unavailable
+
+
+# ------------------------------------------------- anchor verification
+
+def _anchor(lb):
+    return {"height": lb.height, "commit": jsonable(lb.commit)}
+
+
+def _tampered(lb):
+    bad = copy.deepcopy(lb.commit)
+    sig = bytearray(bad.signatures[0].signature)
+    sig[0] ^= 0xFF
+    bad.signatures[0].signature = bytes(sig)
+    return {"height": lb.height, "commit": jsonable(bad)}
+
+
+def test_verify_commits_batched_memo_and_demux():
+    chain = make_light_chain(4, n_vals=4)
+    tier, _bs = _tier(chain)
+    anchors = [_anchor(chain[0]), _tampered(chain[1]), _anchor(chain[2])]
+    res = tier.verify_commits(anchors)
+    assert res["ok"] == 2 and res["failed"] == 1
+    r1, r2, r3 = res["results"]
+    assert r1 == {"height": 1, "ok": True, "cached": False}
+    assert r2["ok"] is False and "signature" in r2["error"]
+    assert r3 == {"height": 3, "ok": True, "cached": False}
+    # second pass: good anchors hit the whole-commit verdict memo, the
+    # bad one re-verifies (negative verdicts are never cached)
+    res2 = tier.verify_commits(anchors)
+    assert res2["results"][0]["cached"] is True
+    assert res2["results"][2]["cached"] is True
+    assert res2["results"][1]["ok"] is False
+    st = tier.stats()
+    assert st["verify_hits"] == 2
+    assert st["anchors_ok"] == 4 and st["anchors_bad"] == 2
+
+
+def test_verify_commits_rejects_foreign_fork_commit():
+    chain = make_light_chain(4, n_vals=4)
+    fork = make_light_chain(4, n_vals=4, fork_at=2, fork_skew_ns=7 * NS)
+    tier, _bs = _tier(chain)
+    res = tier.verify_commits([_anchor(fork[3])])
+    assert res["failed"] == 1
+    assert "different block" in res["results"][0]["error"]
+    # and a commit claiming the wrong height is caught pre-dispatch
+    wrong = {"height": 2, "commit": jsonable(chain[2].commit)}
+    res2 = tier.verify_commits([wrong])
+    assert res2["failed"] == 1 and "height" in res2["results"][0]["error"]
+    # a non-Commit codec payload is refused per-anchor, not a crash
+    from cometbft_tpu.types.vote import PRECOMMIT_TYPE, Vote
+
+    vote = Vote(type=PRECOMMIT_TYPE, height=2, round=0,
+                block_id=chain[1].commit.block_id, timestamp_ns=1,
+                validator_address=b"\x01" * 20, validator_index=0)
+    res3 = tier.verify_commits([{"height": 2, "commit": jsonable(vote)},
+                                _anchor(chain[0])])
+    assert res3["failed"] == 1 and res3["ok"] == 1
+    assert "not a Commit" in res3["results"][0]["error"]
+
+
+def test_verify_commits_mixed_valsets_group_and_verify():
+    chain = make_light_chain(8, n_vals=4, rotate_every=2)
+    tier, _bs = _tier(chain)
+    anchors = [_anchor(chain[i]) for i in (0, 2, 3, 6)]
+    res = tier.verify_commits(anchors)
+    assert res["ok"] == 4 and res["failed"] == 0
+
+
+def test_batched_use_cache_consults_and_seeds_dedup_cache():
+    from cometbft_tpu.crypto import scheduler as vsched
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.types.validation import verify_commits_light_batched
+
+    chain = make_light_chain(3, n_vals=8)
+    items = [(lb.commit.block_id, lb.height, lb.commit) for lb in chain]
+    vals = chain[0].validators
+    sched = vsched.VerificationScheduler(backend="cpu", cache_size=4096)
+    vsched.set_scheduler(sched)
+    try:
+        hits = m.counter("crypto_sched_cache_hits_total")
+        before = hits.value(source="commit")
+        n1 = verify_commits_light_batched(CHAIN, vals, items,
+                                          backend="cpu", use_cache=True)
+        assert n1 > 0 and len(sched.cache) >= n1
+        assert hits.value(source="commit") == before   # cold: no hits
+        n2 = verify_commits_light_batched(CHAIN, vals, items,
+                                          backend="cpu", use_cache=True)
+        assert n2 == n1                   # proven count, hit or dispatched
+        assert hits.value(source="commit") >= before + n1
+        # a tampered commit still fails WITH the cache on
+        bad = copy.deepcopy(chain[1].commit)
+        sig = bytearray(bad.signatures[0].signature)
+        sig[0] ^= 0xFF
+        bad.signatures[0].signature = bytes(sig)
+        from cometbft_tpu.types.validation import ErrBatchItemInvalid
+
+        with pytest.raises(ErrBatchItemInvalid) as ei:
+            verify_commits_light_batched(
+                CHAIN, vals,
+                [items[0], (bad.block_id, bad.height, bad), items[2]],
+                backend="cpu", use_cache=True)
+        assert ei.value.item == 1
+    finally:
+        vsched.set_scheduler(None)
+
+
+# ------------------------------------------------- trusted-store pruning
+
+def test_trusted_store_prunes_oldest_first():
+    chain = make_light_chain(10, n_vals=4)
+    store = TrustedStore()
+    for lb in chain:
+        store.save(lb)
+    store.prune(3)
+    assert store.first().height == 8
+    assert store.latest().height == 10
+    assert store.get(7) is None and store.get(9) is not None
+    store.prune(0)
+    assert store.latest() is None and store.first() is None
+
+
+# ------------------------------------------------------- live-node pass
+
+def test_light_serve_routes_on_live_node():
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.rpc import HTTPClient
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.header import tx_hash
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    async def main():
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        pv = MockPV.from_secret(b"lightserve-node")
+        doc = GenesisDoc(chain_id="ls-net",
+                         validators=[GenesisValidator(pv.get_pub_key(), 10)])
+        node = await Node.create(doc, KVStoreApplication(),
+                                 priv_validator=pv, config=cfg, name="ls0")
+        await node.start()
+        try:
+            cli = HTTPClient(*node.rpc_addr)
+            res = await cli.call("broadcast_tx_commit", tx=b"lk=lv".hex())
+            h = res["height"]
+            # wait one MORE height so h's commit is canonical
+            for _ in range(600):
+                if node.block_store.height() > h:
+                    break
+                await asyncio.sleep(0.02)
+
+            # batched bootstrap
+            out = await cli.call("light_blocks", heights=[1, h])
+            entries = out["light_blocks"]
+            assert all("light_block" in e for e in entries)
+
+            # anchor verification against the served commit (the exact
+            # round trip a bootstrapping fleet performs), twice: the
+            # second hit must come from the verdict memo
+            anchor = {"height": h,
+                      "commit": entries[1]["light_block"]["commit"]}
+            v1 = await cli.call("light_verify", anchors=[anchor])
+            assert v1["ok"] == 1 and v1["results"][0]["cached"] is False
+            v2 = await cli.call("light_verify", anchors=[anchor])
+            assert v2["results"][0]["cached"] is True
+
+            # batched tx proofs verified client-side against the real
+            # header's data_hash
+            blk = await cli.call("block", height=h)
+            data_hash = bytes.fromhex(blk["block"]["hdr"]["dh"]["~b"])
+            pr = await cli.call("light_proofs", height=h, kind="tx")
+            assert pr["total"] == 1
+            assert bytes.fromhex(pr["root"]) == data_hash
+            p = pr["proofs"][0]
+            proof = merkle.Proof(
+                p["total"], p["index"], bytes.fromhex(p["leaf_hash"]),
+                tuple(bytes.fromhex(a) for a in p["aunts"]))
+            assert proof.verify(data_hash, tx_hash(b"lk=lv"))
+
+            # the RPC provider consumes the serving tier in ONE round
+            # trip and falls back cleanly elsewhere
+            from cometbft_tpu.light.rpc_provider import RPCProvider
+
+            prov = RPCProvider(*node.rpc_addr)
+            lb = await prov.light_block(h)
+            assert prov._has_light_block is True
+            assert lb.height == h
+            assert lb.validators.hash() == lb.header.validators_hash
+            await prov.client.close()
+
+            # stats surfaced via /status
+            st = await cli.call("status")
+            ls = st["light_serve"]
+            assert ls["blocks_served"] >= 3
+            assert ls["proofs_served"] >= 1
+            assert ls["anchors_ok"] >= 1 and ls["verify_hits"] >= 1
+            await cli.close()
+        finally:
+            await node.stop()
+
+    run(main())
