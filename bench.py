@@ -1053,8 +1053,104 @@ def _single_verify_us(host_items) -> float:
     return best * 1e6
 
 
+def _child_scenarios(out_path: str) -> None:
+    """``--mode scenarios``: sweep the scenario lab's curated suite
+    (``cometbft_tpu.sim.scenario.curated_suite``) on the virtual clock,
+    re-running the first scenario to enforce the replay contract, and
+    write the full verdict JSON to ``out_path`` — the liveness analog
+    of the perf guards: a regression that forks a net, loses recovery,
+    or breaks replay determinism fails this run the same way a slow
+    kernel fails a perf bar.
+
+    The headline value is simulated-virtual-seconds per real second
+    (how much adversarial time one CPU buys), but the pass/fail payload
+    is the verdicts."""
+    from cometbft_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+    from cometbft_tpu.sim.scenario import (chaos_signature_of,
+                                           curated_suite, run_scenario)
+
+    def note(msg):
+        print(f"[bench:scenarios] {msg}", file=sys.stderr, flush=True)
+
+    suite = curated_suite()
+    only = os.environ.get("BENCH_SCENARIOS", "")
+    if only:
+        names = {n.strip() for n in only.split(",") if n.strip()}
+        suite = [s for s in suite if s.name in names]
+    verdicts = []
+    failures_: list[str] = []
+    total_virtual = 0.0
+    t_all = time.perf_counter()
+    replay_checked = False
+    for scn in suite:
+        note(f"running {scn.name} ({scn.n_nodes} nodes, "
+             f"target h{scn.target_height})")
+        t0 = time.perf_counter()
+        if not replay_checked:
+            v, sig1 = chaos_signature_of(scn)
+            real_s = time.perf_counter() - t0
+            # the replay double-run: its virtual seconds count toward
+            # the headline total (the work really ran) but its real
+            # time must not be billed to the scenario's own real_s
+            v2, sig2 = chaos_signature_of(scn)
+            if sig1 != sig2 or \
+                    json.dumps(v, sort_keys=True) != \
+                    json.dumps(v2, sort_keys=True):
+                failures_.append(f"{scn.name}: replay diverged")
+            total_virtual += v2["virtual_duration_s"]
+            replay_checked = True
+        else:
+            v = run_scenario(scn)
+            real_s = time.perf_counter() - t0
+        v["real_s"] = round(real_s, 1)     # informational; excluded from
+        # the replay compare above (which ran on the pristine dicts)
+        verdicts.append(v)
+        total_virtual += v["virtual_duration_s"]
+        if not v["fork_free"]:
+            failures_.append(f"{scn.name}: FORK")
+        if not v["reached_target"]:
+            failures_.append(
+                f"{scn.name}: stuck at {v['common_height']}")
+        note(f"  {scn.name}: h{v['common_height']} in "
+             f"{v['virtual_duration_s']}s virtual / {real_s:.1f}s real, "
+             f"fork_free={v['fork_free']}")
+    real_total = time.perf_counter() - t_all
+    doc = {"scenarios": verdicts, "failures": failures_,
+           "real_total_s": round(real_total, 1),
+           "virtual_total_s": round(total_virtual, 1)}
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        note(f"verdicts -> {out_path}")
+    print(json.dumps({
+        "metric": f"scenario lab: adversarial virtual-seconds simulated "
+                  f"per real second ({len(verdicts)} scenarios, "
+                  f"fork-free + replay-identical required)",
+        "value": round(total_virtual / max(real_total, 1e-9), 2),
+        "unit": "virtual-s/s",
+        "vs_baseline": 1.0 if not failures_ else 0.0,
+        "scenarios_passed": len(verdicts) - len(
+            {f.split(":")[0] for f in failures_}),
+        "scenarios_total": len(verdicts),
+        "failures": failures_,
+        "virtual_total_s": round(total_virtual, 1),
+        "real_total_s": round(real_total, 1),
+        "backend": "cpu",
+    }), flush=True)
+    if failures_:
+        raise SystemExit(1)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
+    if mode == "scenarios":
+        return _child_scenarios(
+            os.environ.get("BENCH_OUT",
+                           os.path.join(REPO, "docs", "bench",
+                                        "r15-scenarios-cpu.json")))
     if mode == "node":
         return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
                            float(os.environ.get("BENCH_DURATION", "20")),
@@ -1285,7 +1381,8 @@ def main() -> None:
     forced = os.environ.get("BENCH_BACKEND", "").strip().lower()
     platforms = os.environ.get("JAX_PLATFORMS", "")
     want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
-    if os.environ.get("BENCH_MODE") in ("node", "light-serve"):
+    if os.environ.get("BENCH_MODE") in ("node", "light-serve",
+                                        "scenarios"):
         # these children hard-force CPU (full-stack measurements whose
         # bottleneck is the node, not a device leg): skip the
         # accelerator probe and the redundant tpu-labeled attempt
@@ -1382,6 +1479,8 @@ def main() -> None:
                         "events/s"),
         "light-serve": ("light-serve proofs/s under simulated "
                         "skipping clients", "proofs/s"),
+        "scenarios": ("scenario lab: adversarial virtual-seconds "
+                      "simulated per real second", "virtual-s/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

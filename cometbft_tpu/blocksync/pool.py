@@ -15,6 +15,8 @@ reference's PeekTwoBlocks pairs: the window is what fills one device batch
 from __future__ import annotations
 
 import asyncio
+
+from ..libs import clock
 from typing import Callable
 
 REQUEST_TIMEOUT = 15.0          # pool.go requestRetrySeconds
@@ -50,15 +52,15 @@ class _Requester:
             while peer is None:
                 peer = self.pool._pick_peer(self.height)
                 if peer is None:
-                    await asyncio.sleep(0.05)
+                    await clock.sleep(0.05)
                     if self.pool._stopped:
                         return
             self.peer_id = peer.id
             peer.pending += 1
             self.pool.send_request(peer.id, self.height)
             try:
-                await asyncio.wait_for(self._wait_block_or_redo(),
-                                       REQUEST_TIMEOUT)
+                await clock.wait_for(self._wait_block_or_redo(),
+                                      REQUEST_TIMEOUT)
             except asyncio.TimeoutError:
                 # peer too slow: drop it (pool.go:153 timeout → RemovePeer)
                 self.pool.remove_peer(peer.id, reason="block request timeout",
@@ -186,7 +188,7 @@ class BlockPool:
                 if next_h not in self.requesters and next_h >= self.height:
                     self.requesters[next_h] = _Requester(self, next_h)
                     continue
-            await asyncio.sleep(0.02)
+            await clock.sleep(0.02)
 
     def add_block(self, peer_id: str, block, ext_commit=None) -> bool:
         """BlockResponse arrived (pool.go:296 AddBlock)."""

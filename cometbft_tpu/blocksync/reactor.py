@@ -27,8 +27,7 @@ from __future__ import annotations
 
 import asyncio
 
-from ..libs import aio
-import time
+from ..libs import aio, clock
 
 import msgpack
 
@@ -188,7 +187,7 @@ class BlocksyncReactor(Reactor):
 
     async def _status_routine(self) -> None:
         while True:
-            await asyncio.sleep(STATUS_UPDATE_INTERVAL)
+            await clock.sleep(STATUS_UPDATE_INTERVAL)
             if self.switch is not None:
                 self.switch.broadcast(BLOCKSYNC_CHANNEL, _pack(
                     "sres", h=self.block_store.height(),
@@ -203,7 +202,7 @@ class BlocksyncReactor(Reactor):
         — host staging overlaps device compute, so consecutive windows
         keep the mesh full during catch-up."""
         pool = self.pool
-        started = time.monotonic()
+        started = clock.monotonic()
         staged: _StagedWindow | None = None
         while True:
             if self._should_switch(started):
@@ -218,7 +217,7 @@ class BlocksyncReactor(Reactor):
                     pool.redo_request(e.height + 1)
                     continue
             if staged is None:
-                await asyncio.sleep(SWITCH_CHECK_INTERVAL)
+                await clock.sleep(SWITCH_CHECK_INTERVAL)
                 continue
             # double-buffer: stage the window BEHIND the in-flight one
             # (its packing + host->device staging run while the first
@@ -244,14 +243,14 @@ class BlocksyncReactor(Reactor):
                 continue
             staged = nxt
             if applied == 0 and staged is None:
-                await asyncio.sleep(SWITCH_CHECK_INTERVAL)
+                await clock.sleep(SWITCH_CHECK_INTERVAL)
 
     def _should_switch(self, started: float) -> bool:
         pool = self.pool
         if pool.is_caught_up():
             return True
         if not pool.peers and \
-                time.monotonic() - started > self.no_peers_grace:
+                clock.monotonic() - started > self.no_peers_grace:
             return True          # nobody to sync from: just run consensus
         return False
 

@@ -24,6 +24,7 @@ import time
 from typing import Callable
 
 from ..config import ConsensusConfig
+from ..libs import clock
 from ..libs import log as tmlog
 from ..libs import metrics
 from ..libs import tracing
@@ -54,7 +55,7 @@ class ConsensusState:
                  wal: WAL | None = None,
                  priv_validator: PrivValidator | None = None,
                  event_bus: EventBus | None = None,
-                 now_ns: Callable[[], int] = time.time_ns,
+                 now_ns: Callable[[], int] = clock.walltime_ns,
                  name: str = "cs"):
         self.cfg = cfg
         self.block_exec = block_exec
@@ -122,7 +123,7 @@ class ConsensusState:
         # and the first-part arrival time of the assembling block
         self._step_span = None
         self._step_info: tuple[str, float] | None = None
-        self._step_mono = time.monotonic()
+        self._step_mono = clock.monotonic()
         self._assembly_t0: float | None = None
 
         self._update_to_state(state)
@@ -131,7 +132,7 @@ class ConsensusState:
         """Every ``rs.step`` transition funnels through here: close the
         previous step's metric + trace span, open the next one, then run
         the reactor's ``on_round_step`` hook."""
-        now = time.monotonic()
+        now = clock.monotonic()
         rs = self.rs
         if self._replaying:
             # WAL catch-up drives hundreds of transitions in
@@ -160,7 +161,7 @@ class ConsensusState:
         """Seconds the state machine has sat in the current step (the
         enriched ``/status`` surface: a large Propose/Prevote age on a
         live node means a stalled round)."""
-        return max(0.0, time.monotonic() - self._step_mono)
+        return max(0.0, clock.monotonic() - self._step_mono)
 
     # ------------------------------------------------------------ lifecycle
 

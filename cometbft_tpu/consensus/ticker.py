@@ -1,6 +1,13 @@
 """Timeout ticker (reference: ``internal/consensus/ticker.go``): one pending
 timeout at a time; scheduling overrides the previous.  Mockable for
-deterministic tests (tests drive ``fire`` directly)."""
+deterministic tests (tests drive ``fire`` directly).
+
+Implementation note: a ``loop.call_later`` handle, not a task —
+consensus re-schedules on every step transition, and at scenario-lab
+scale (hundreds of nodes) the old task-per-schedule pattern was one of
+the two dominant allocators in the whole run (a Task + CancelledError
+per step vs a heap entry).  ``call_later`` rides ``loop.time()``, so
+the virtual clock drives it like any other timer."""
 
 from __future__ import annotations
 
@@ -21,21 +28,19 @@ class TimeoutTicker:
         """``deliver(TimeoutInfo)`` is called on the event loop when a
         timeout fires (posts into the consensus queue)."""
         self._deliver = deliver
-        self._task: asyncio.Task | None = None
+        self._handle: asyncio.TimerHandle | None = None
 
     def schedule(self, ti: TimeoutInfo) -> None:
-        if self._task is not None and not self._task.done():
-            self._task.cancel()
-        self._task = asyncio.get_running_loop().create_task(self._run(ti))
+        if self._handle is not None:
+            self._handle.cancel()
+        self._handle = asyncio.get_running_loop().call_later(
+            ti.duration_ns / 1e9, self._fire, ti)
 
-    async def _run(self, ti: TimeoutInfo) -> None:
-        try:
-            await asyncio.sleep(ti.duration_ns / 1e9)
-            self._deliver(ti)
-        except asyncio.CancelledError:
-            pass
+    def _fire(self, ti: TimeoutInfo) -> None:
+        self._handle = None
+        self._deliver(ti)
 
     def stop(self) -> None:
-        if self._task is not None and not self._task.done():
-            self._task.cancel()
-        self._task = None
+        if self._handle is not None:
+            self._handle.cancel()
+            self._handle = None

@@ -1,0 +1,114 @@
+"""The node-wide clock seam: every sleep/timeout/monotonic read in the
+clock-managed packages (consensus, p2p, node, mempool, blocksync,
+statesync) routes through this module instead of calling ``time`` /
+``asyncio`` directly.
+
+Why a seam at all: testing liveness with real wall-clock time caps nets
+at ~4 nodes per test and turns every timeout into a flake budget (PR 12
+had to widen a fuzz-liveness deadline from 90s to 150s because
+legitimate reconnect backoff sat on the limit).  With ONE injectable
+clock, the deterministic scenario lab (``cometbft_tpu.sim``) runs
+hundreds of in-process nodes on a virtual clock that advances only when
+every node is quiescent — a 100-node, multi-height adversarial run
+finishes in seconds of real time and is replayable from a seed.
+
+Discipline (same as ``libs/tracing`` / ``libs/failures``):
+
+- **Real-time path costs nothing.**  With no virtual clock installed
+  (every production node, every bench), each function is a
+  first-instruction branch on a module global followed by the exact
+  call it replaced.  The vote-gossip bench guard holds with the sim
+  package never imported.
+- **Virtual mode is loop-driven.**  ``asyncio.sleep`` / ``wait_for`` /
+  ``call_later`` already schedule against ``loop.time()``, so under the
+  sim's :class:`~cometbft_tpu.sim.vtime.VirtualTimeLoop` the async
+  functions here stay thin delegates — the loop virtualizes them.  The
+  functions that MUST branch are the direct time reads
+  (:func:`monotonic`, :func:`walltime_ns`): a ``time.monotonic()`` call
+  inside a clock-managed package reads *real* time under simulation and
+  silently breaks determinism (step ages, RTTs, score decay, ban TTLs).
+  ``scripts/lint.sh`` rejects new direct calls in managed packages; the
+  rare legitimate exception carries a ``clock-exempt`` marker comment.
+
+``install()`` is process-wide like the chaos plane: an in-proc ensemble
+shares one clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+
+_CLOCK = None      # None => real time; else an installed clock object
+
+
+class Clock:
+    """Interface an installable clock implements.  The sim package's
+    ``VirtualClock`` is the one real implementation; production code
+    never constructs a Clock (the module functions short-circuit to
+    ``time`` / ``asyncio`` when none is installed)."""
+
+    def monotonic(self) -> float:
+        raise NotImplementedError
+
+    def walltime_ns(self) -> int:
+        raise NotImplementedError
+
+
+def install(clk: Clock) -> None:
+    """Install the process-wide clock (the sim driver calls this before
+    any node is constructed, so ``__init__``-time reads land on virtual
+    time too)."""
+    global _CLOCK
+    _CLOCK = clk
+
+
+def uninstall() -> None:
+    global _CLOCK
+    _CLOCK = None
+
+
+def installed() -> Clock | None:
+    return _CLOCK
+
+
+# ------------------------------------------------------------ time reads
+
+def monotonic() -> float:
+    """``time.monotonic`` through the seam — THE call that must never be
+    made directly in a clock-managed package (it would measure real time
+    under simulation)."""
+    if _CLOCK is None:
+        return _time.monotonic()
+    return _CLOCK.monotonic()
+
+
+def walltime_ns() -> int:
+    """``time.time_ns`` through the seam.  Under the virtual clock this
+    is a fixed epoch plus virtual offset, which makes block timestamps —
+    hence block hashes — a pure function of the scenario seed."""
+    if _CLOCK is None:
+        return _time.time_ns()
+    return _CLOCK.walltime_ns()
+
+
+def walltime() -> float:
+    """``time.time`` through the seam (ban expiries, report stamps)."""
+    if _CLOCK is None:
+        return _time.time()
+    return _CLOCK.walltime_ns() / 1e9
+
+
+# ------------------------------------------------------- async scheduling
+
+async def sleep(delay: float, result=None):
+    """``asyncio.sleep`` through the seam.  Scheduling rides
+    ``loop.time()``, so the virtual loop makes this virtual without a
+    branch here — the indirection exists so the lint guard has one
+    spelling to allow and so a non-loop clock could intercept later."""
+    return await asyncio.sleep(delay, result)
+
+
+async def wait_for(awaitable, timeout: float | None):
+    """``asyncio.wait_for`` through the seam (see :func:`sleep`)."""
+    return await asyncio.wait_for(awaitable, timeout)

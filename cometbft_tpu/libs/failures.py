@@ -58,8 +58,10 @@ _PLANE: "ChaosPlane | None" = None
 _CONF_LOCK = threading.Lock()
 
 # rule keys with non-float values, everything else in a spec parses as
-# float (``prob=0.02``) with int-preservation (``at=40`` stays an int)
-_STR_KEYS = ("cut", "chan", "mode", "node", "file")
+# float (``prob=0.02``) with int-preservation (``at=40`` stays an int).
+# "peer" is the scenario lab's link-spec far end (sim/transport
+# apply_spec) — a plain param here, never a selector.
+_STR_KEYS = ("cut", "chan", "mode", "node", "file", "peer")
 # str params that act as SELECTORS when present on a rule: the site
 # only counts/fires calls whose `detail` carries the same value, so
 # "p2p.send.corrupt:node=bad0:every=3" arms ONE node's links in an
@@ -258,6 +260,20 @@ def is_enabled() -> bool:
     """Hot-path gate for call sites that would otherwise build detail
     dicts or bytearrays just to have :func:`fire` drop them."""
     return _ENABLED
+
+
+def armed_prefix(prefix: str) -> bool:
+    """True when any armed rule's site starts with ``prefix`` — the
+    gate for multi-site clusters (``p2p.send.*`` is five :func:`fire`
+    calls per packet; with nothing armed under the prefix the whole
+    cluster is one cheap scan, and skipping it is behavior-identical
+    because un-armed sites never count calls).  Scans the live rule
+    table so a mid-run :func:`arm`/:func:`disarm` takes effect on the
+    next packet."""
+    plane = _PLANE
+    if not _ENABLED or plane is None:
+        return False
+    return any(s.startswith(prefix) for s in plane.rules)
 
 
 def fire(site: str, **detail) -> "dict | None":

@@ -12,7 +12,7 @@ from __future__ import annotations
 import asyncio
 import functools
 
-from ..libs import aio
+from ..libs import aio, clock
 
 import msgpack
 
@@ -112,7 +112,7 @@ class MempoolReactor(Reactor):
                         sent.add(key)
                         progressed = True
                 if not progressed:
-                    await asyncio.sleep(self.gossip_sleep)
+                    await clock.sleep(self.gossip_sleep)
                 # bound the sent-set: drop keys no longer in the mempool
                 if len(sent) > 10000:
                     live = {TxKey(t) for t in self.mempool.contents()}

@@ -34,10 +34,9 @@ import asyncio
 import functools
 import json
 import os
-import time
 
 from ..consensus.wal import wal_segments, _iter_segment_file
-from ..libs import tracing
+from ..libs import clock, tracing
 from ..libs.service import BaseService
 
 BUNDLE_PREFIX = "incident-"
@@ -163,7 +162,7 @@ class LivenessWatchdog(BaseService):
     async def _run(self) -> None:
         try:
             while True:
-                await asyncio.sleep(self.check_interval_s)
+                await clock.sleep(self.check_interval_s)
                 try:
                     reasons = self._evaluate()
                     if reasons is not None:
@@ -197,7 +196,7 @@ class LivenessWatchdog(BaseService):
         for r in reasons:
             trips.inc(reason=r, node=self.node.name)
         if self._last_bundle_mono is not None and \
-                time.monotonic() - self._last_bundle_mono \
+                clock.monotonic() - self._last_bundle_mono \
                 < self.min_interval_s:
             suppressed.inc(node=self.node.name)
             return None
@@ -262,7 +261,7 @@ class LivenessWatchdog(BaseService):
             "version": 1,
             "node": node.name,
             "reasons": reasons,
-            "wall_time_ns": time.time_ns(),
+            "wall_time_ns": clock.walltime_ns(),
             "stall_threshold_s": self.stall_threshold_s,
             "height": (node.block_store.height()
                        if node.block_store is not None else None),
@@ -312,7 +311,7 @@ class LivenessWatchdog(BaseService):
             except OSError:
                 pass
             raise
-        self._last_bundle_mono = time.monotonic()
+        self._last_bundle_mono = clock.monotonic()
         self.bundles_written += 1
         _watchdog_metrics()[1].inc(node=self.node.name)
         self._prune()

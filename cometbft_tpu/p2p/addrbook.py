@@ -35,7 +35,8 @@ import hashlib
 import json
 import os
 import random
-import time
+
+from ..libs import clock
 
 N_NEW_BUCKETS = 256
 N_OLD_BUCKETS = 64
@@ -68,7 +69,7 @@ class _Entry:
         self.node_id = node_id
         self.addr = addr
         self.src_group = src_group
-        self.added = time.time()
+        self.added = clock.walltime()
         self.attempts = 0
         self.last_success = 0.0
 
@@ -136,7 +137,7 @@ class AddrBook:
             # current schema: {node_id: expiry}; expired entries drop,
             # and an uncoercible expiry (hand-edited file) counts as
             # expired rather than refusing to boot the node
-            now = time.time()
+            now = clock.walltime()
             self._banned = {}
             for nid, exp in banned.items():
                 try:
@@ -169,7 +170,7 @@ class AddrBook:
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            now = time.time()
+            now = clock.walltime()
             json.dump({
                 "salt": self._salt,
                 "new": [e.to_json() for b in self._new for e in b.values()],
@@ -178,7 +179,7 @@ class AddrBook:
                            if exp > now},
             }, f, indent=1)
         os.replace(tmp, self.path)
-        self._last_save = time.time()
+        self._last_save = clock.walltime()
 
     def save_debounced(self) -> None:
         """Hot-path persistence (every handshake/PEX response mutates
@@ -186,7 +187,7 @@ class AddrBook:
         loop, so writes are throttled to one per SAVE_INTERVAL_S; the
         book is a cache — losing the last few seconds on crash is fine
         (PexReactor.stop() flushes via save())."""
-        if time.time() - getattr(self, "_last_save", 0.0) >= \
+        if clock.walltime() - getattr(self, "_last_save", 0.0) >= \
                 self.SAVE_INTERVAL_S:
             self.save()
 
@@ -230,7 +231,6 @@ class AddrBook:
         moved updates cleanly."""
         if not addr or self.is_banned(node_id):
             return False
-        import time as _time
 
         cur = self._get(node_id)
         if cur is not None:
@@ -242,7 +242,7 @@ class AddrBook:
             self._drop(node_id)
         e = _Entry(node_id, addr, _group(source or addr))
         if proven:
-            e.last_success = _time.time()
+            e.last_success = clock.walltime()
             ok = self._place(e, "old") or self._place(e, "new")
         else:
             ok = self._place(e, "new")
@@ -264,7 +264,7 @@ class AddrBook:
         if e is None:
             return
         e.attempts = 0
-        e.last_success = time.time()
+        e.last_success = clock.walltime()
         if self._where[node_id][0] != "old":
             self._drop(node_id)
             if not self._place(e, "old"):
@@ -292,7 +292,7 @@ class AddrBook:
                  ttl: float = DEFAULT_BAN_TTL_S) -> None:
         """Timed ban and forget (addrbook MarkBad, but with a TTL — the
         caller escalates repeat offenders; forever-bans are gone)."""
-        self._banned[node_id] = time.time() + ttl
+        self._banned[node_id] = clock.walltime() + ttl
         self._drop(node_id)
         self.save_debounced()
 
@@ -302,14 +302,14 @@ class AddrBook:
         exp = self._banned.get(node_id)
         if exp is None:
             return False
-        if exp <= time.time():
+        if exp <= clock.walltime():
             self._banned.pop(node_id, None)
             return False
         return True
 
     def banned(self) -> dict[str, float]:
         """Active bans as {node_id: expiry-epoch-seconds}."""
-        now = time.time()
+        now = clock.walltime()
         for nid in [n for n, exp in self._banned.items() if exp <= now]:
             self._banned.pop(nid, None)
         return dict(self._banned)

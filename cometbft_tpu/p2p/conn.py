@@ -13,11 +13,10 @@ from __future__ import annotations
 
 import asyncio
 import struct
-import time
 
 import msgpack
 
-from ..libs import failures
+from ..libs import clock, failures
 from ..libs.flowrate import Monitor
 from .reactor import ChannelDescriptor
 from .secret_connection import SecretConnection
@@ -121,7 +120,7 @@ class MConnection:
         self._tasks: list[asyncio.Task] = []
         self._stopped = False
         # --- telemetry (plain attrs; see telemetry()) -------------------
-        now = time.monotonic()
+        now = clock.monotonic()
         self.created_mono = now
         self.last_recv_mono = now       # any complete packet counts
         self.last_msg_recv_mono = now   # complete channel messages only
@@ -226,7 +225,13 @@ class MConnection:
                     chunk, eof = ch.next_packet()
                     pkt = {"t": "m", "c": ch.desc.channel_id,
                            "e": eof, "d": chunk}
-                    if failures.is_enabled():
+                    if failures.armed_prefix("p2p.send.") or \
+                            self._chaos_held is not None:
+                        # the held-packet check keeps the release-after-
+                        # next-packet contract when the last p2p.send.*
+                        # rule is disarmed while a reordered packet is
+                        # parked — it must ride out with the next send,
+                        # not wait for a fully idle wire
                         await self._chaos_send_packet(ch, pkt)
                     else:
                         await self._write_packet(pkt)
@@ -245,7 +250,7 @@ class MConnection:
                         held, self._chaos_held = self._chaos_held, None
                         await self._write_packet(held)
                     try:
-                        await asyncio.wait_for(self._send_wakeup.wait(), 0.5)
+                        await clock.wait_for(self._send_wakeup.wait(), 0.5)
                     except asyncio.TimeoutError:
                         pass
         except asyncio.CancelledError:
@@ -287,7 +292,7 @@ class MConnection:
             pkt = dict(pkt, d=bytes(data))
         f = failures.fire("p2p.send.delay", chan=name, node=scope)
         if f is not None:
-            await asyncio.sleep(float(f.get("delay", 0.05)))
+            await clock.sleep(float(f.get("delay", 0.05)))
         f = failures.fire("p2p.send.reorder", chan=name, node=scope)
         if f is not None and self._chaos_held is None:
             self._chaos_held = pkt      # released after the NEXT packet
@@ -306,7 +311,7 @@ class MConnection:
         if self.send_rate:
             while self.send_monitor.limit(len(data), self.send_rate) \
                     < len(data):
-                await asyncio.sleep(0.01)
+                await clock.sleep(0.01)
         await self.conn.write(data)
         self.send_monitor.update(len(data))
 
@@ -320,10 +325,10 @@ class MConnection:
                     raise MConnectionError(f"oversized packet: {n}")
                 raw = await self.conn.read(n)
                 self.recv_monitor.update(n + 4)
-                self.last_recv_mono = time.monotonic()
+                self.last_recv_mono = clock.monotonic()
                 if self.recv_rate:
                     while self.recv_monitor.limit(1, self.recv_rate) < 1:
-                        await asyncio.sleep(0.01)
+                        await clock.sleep(0.01)
                 packet = msgpack.unpackb(raw, raw=False)
                 t = packet.get("t")
                 if t == "i":                      # ping
@@ -332,7 +337,7 @@ class MConnection:
                 elif t == "o":                    # pong
                     self._pong_due = None
                     if self._ping_sent_mono is not None:
-                        rtt = time.monotonic() - self._ping_sent_mono
+                        rtt = clock.monotonic() - self._ping_sent_mono
                         self._ping_sent_mono = None
                         self.last_rtt_s = rtt
                         if self.on_rtt is not None:
@@ -365,8 +370,8 @@ class MConnection:
             msg = bytes(ch.recv_buf)
             ch.recv_buf.clear()
             ch.recv_msgs += 1
-            self.last_msg_recv_mono = time.monotonic()
-            if failures.is_enabled():
+            self.last_msg_recv_mono = clock.monotonic()
+            if failures.armed_prefix("p2p.recv."):
                 # receive-side faults operate on COMPLETE messages (the
                 # unit the reactor sees): drop it, or flip one seeded
                 # bit so the codec/handler rejects it downstream
@@ -408,11 +413,11 @@ class MConnection:
         loop = asyncio.get_running_loop()
         try:
             while True:
-                await asyncio.sleep(self.ping_interval)
+                await clock.sleep(self.ping_interval)
                 await self._write_packet({"t": "i"})
-                self._ping_sent_mono = time.monotonic()
+                self._ping_sent_mono = clock.monotonic()
                 self._pong_due = loop.time() + self.pong_timeout
-                await asyncio.sleep(self.pong_timeout)
+                await clock.sleep(self.pong_timeout)
                 if self._pong_due is not None and \
                         loop.time() >= self._pong_due:
                     self.pong_timeouts += 1
@@ -431,7 +436,7 @@ class MConnection:
         occupancy, flowrate on both directions, ping RTT and liveness
         ages.  Read-only over plain attrs — safe to call from RPC
         handlers and the watchdog while the connection runs."""
-        now = time.monotonic()
+        now = clock.monotonic()
         channels = {}
         for ch in self.channels.values():
             channels[ch.display_name] = {

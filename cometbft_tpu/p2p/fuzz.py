@@ -21,9 +21,8 @@ from __future__ import annotations
 
 import asyncio
 import random
-import time
 
-from ..libs import failures
+from ..libs import clock, failures
 
 MODE_DROP = "drop"
 MODE_DELAY = "delay"
@@ -53,10 +52,10 @@ class _Fuzzer:
     def __init__(self, cfg: FuzzConnConfig, writer):
         self.cfg = cfg
         self.writer = writer
-        self._t0 = time.monotonic()
+        self._t0 = clock.monotonic()
 
     def _active(self) -> bool:
-        return (time.monotonic() - self._t0) >= self.cfg.start_after_s
+        return (clock.monotonic() - self._t0) >= self.cfg.start_after_s
 
     async def fuzz(self) -> bool:
         """Returns True if this IO should be swallowed (fuzz.go:110)."""
@@ -73,13 +72,13 @@ class _Fuzzer:
                 return True
             f = failures.fire("p2p.fuzz.delay")
             if f is not None:
-                await asyncio.sleep(float(f.get(
+                await clock.sleep(float(f.get(
                     "delay",
                     failures.site_rng("p2p.fuzz.delay").random()
                     * cfg.max_delay_s)))
                 return False
         if cfg.mode == MODE_DELAY:
-            await asyncio.sleep(cfg.rng.random() * cfg.max_delay_s)
+            await clock.sleep(cfg.rng.random() * cfg.max_delay_s)
             return False
         r = cfg.rng.random()
         if r <= cfg.prob_drop_rw:
@@ -88,7 +87,7 @@ class _Fuzzer:
             self.writer.close()
             return True
         if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
-            await asyncio.sleep(cfg.rng.random() * cfg.max_delay_s)
+            await clock.sleep(cfg.rng.random() * cfg.max_delay_s)
         return False
 
 
@@ -104,7 +103,7 @@ class FuzzedReader:
         # reliable stream would silently shift the frame boundary
         f = self._fuzzer
         if f._active() and f.cfg.mode == MODE_DELAY:
-            await asyncio.sleep(f.cfg.rng.random() * f.cfg.max_delay_s)
+            await clock.sleep(f.cfg.rng.random() * f.cfg.max_delay_s)
         return await self._reader.readexactly(n)
 
     def __getattr__(self, name):

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 
+from ..libs import clock
 from ..libs import aio
 import random
 
@@ -126,7 +127,7 @@ class PexReactor(Reactor):
         # connection can never evict a reconnect, and the reconnect's
         # own timer still hangs it up
         async def hangup():
-            await asyncio.sleep(CRAWL_LINGER)
+            await clock.sleep(CRAWL_LINGER)
             if self.switch is not None and \
                     getattr(self.switch, "peers", {}).get(
                         peer.id) is peer:
@@ -184,7 +185,7 @@ class PexReactor(Reactor):
         """pex_reactor.go ensurePeersRoutine: keep outbound connectivity
         up by asking for and dialing new addresses."""
         while True:
-            await asyncio.sleep(self.request_interval
+            await clock.sleep(self.request_interval
                                 * (0.75 + 0.5 * random.random()))
             try:
                 self._ensure_peers()
@@ -221,7 +222,7 @@ class PexReactor(Reactor):
                 self._crawl()
             except Exception as e:
                 self.log.warn("crawl failed", err=repr(e))
-            await asyncio.sleep(self.request_interval
+            await clock.sleep(self.request_interval
                                 * (0.75 + 0.5 * random.random()))
 
     def _crawl(self) -> None:

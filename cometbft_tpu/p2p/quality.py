@@ -32,7 +32,7 @@ here is synchronous, event-loop-thread-only state.
 
 from __future__ import annotations
 
-import time
+from ..libs import clock
 
 # Event taxonomy: every layer that detects misbehavior reports one of
 # these (severity-weighted; see docs/explanation/peer-quality.md for
@@ -131,7 +131,7 @@ class PeerScorer:
         ``"disconnect"``, or None (tolerated for now)."""
         if not self.enabled:
             return None
-        now = time.monotonic()
+        now = clock.monotonic()
         rec = self._peers.get(peer_id)
         if rec is None:
             if len(self._peers) >= self.max_tracked:
@@ -146,7 +146,7 @@ class PeerScorer:
         rec.events[event] = rec.events.get(event, 0) + 1
         rec.last_event = event
         rec.last_detail = detail[:160]
-        rec.last_wall = time.time()
+        rec.last_wall = clock.walltime()
         # relative epsilon: the score decays over the (sub-ms) gap
         # between accumulation and compare, so a sum that lands exactly
         # ON a threshold must still count as crossing it
@@ -175,12 +175,12 @@ class PeerScorer:
         rec = self._peers.get(peer_id)
         if rec is None:
             return 0.0
-        return self._decayed(rec, time.monotonic())
+        return self._decayed(rec, clock.monotonic())
 
     # --------------------------------------------------------------- bans
 
     def _ban(self, peer_id: str, ttl: float, reason: str) -> None:
-        expiry = time.time() + ttl
+        expiry = clock.walltime() + ttl
         self.bans_total += 1
         self._bans[peer_id] = {"reason": reason, "expiry": expiry,
                                "ttl_s": ttl}
@@ -196,7 +196,7 @@ class PeerScorer:
         ban = self._bans.get(peer_id)
         if ban is None:
             return False
-        if ban["expiry"] <= time.time():
+        if ban["expiry"] <= clock.walltime():
             self._bans.pop(peer_id, None)
             return False
         # the mirror only rules when there is no book (the book may have
@@ -211,7 +211,7 @@ class PeerScorer:
         if rec is None:
             return {"score": 0.0, "events_total": 0}
         return {
-            "score": round(self._decayed(rec, time.monotonic()), 3),
+            "score": round(self._decayed(rec, clock.monotonic()), 3),
             "events_total": rec.total,
             "events": dict(rec.events),
             "ban_count": rec.ban_count,
@@ -221,7 +221,7 @@ class PeerScorer:
 
     def bans_snapshot(self) -> list[dict]:
         """Active bans (expired entries are dropped as a side effect)."""
-        now = time.time()
+        now = clock.walltime()
         out = []
         for pid in list(self._bans):
             ban = self._bans[pid]
@@ -244,7 +244,7 @@ class PeerScorer:
 
     def snapshot(self) -> dict:
         """Whole-ledger view for incident bundles and debugging."""
-        now = time.monotonic()
+        now = clock.monotonic()
         return {
             "peers": {pid: {"score": round(self._decayed(r, now), 3),
                             "events": dict(r.events),

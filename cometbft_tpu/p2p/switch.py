@@ -9,10 +9,9 @@ with exponential backoff after errors (switch.go reconnectToPeer)."""
 from __future__ import annotations
 
 import asyncio
-import time
-
-from ..libs import aio
 import random
+
+from ..libs import aio, clock
 
 from ..libs import log as tmlog
 from .conn import (ConnectionLostError, MConnection, MConnectionError,
@@ -42,7 +41,9 @@ class Switch:
                  emulated_latency: float = 0.0,
                  telemetry_interval: float = TELEMETRY_FLUSH_INTERVAL,
                  scorer: PeerScorer | None = None,
-                 chaos_scope: str = ""):
+                 chaos_scope: str = "",
+                 reconnect_base_delay: float = RECONNECT_BASE_DELAY,
+                 reconnect_max_delay: float = RECONNECT_MAX_DELAY):
         self.transport = transport
         self.emulated_latency = emulated_latency
         # node-wide peer reputation: every layer's misbehavior reports
@@ -64,6 +65,11 @@ class Switch:
         self.ping_interval = ping_interval
         self.pong_timeout = pong_timeout
         self.telemetry_interval = telemetry_interval
+        # reconnect pacing: production keeps the module defaults; the
+        # scenario lab shrinks them so a healed partition re-knits in
+        # virtual seconds instead of riding a 30 s backoff ceiling
+        self.reconnect_base_delay = reconnect_base_delay
+        self.reconnect_max_delay = reconnect_max_delay
         self._running = False
         self._reconnect_tasks: dict[str, asyncio.Task] = {}
         self._telemetry_task: asyncio.Task | None = None
@@ -313,10 +319,10 @@ class Switch:
             return
 
         async def _reconnect():
-            delay = RECONNECT_BASE_DELAY
+            delay = self.reconnect_base_delay
             attempts = 0
             while True:
-                await asyncio.sleep(delay * (1 + 0.2 * random.random()))
+                await clock.sleep(delay * (1 + 0.2 * random.random()))
                 if not self._running:
                     return
                 if any(p.dial_addr == addr for p in self.peers.values()):
@@ -346,7 +352,7 @@ class Switch:
                             "persistent-peer reconnect exhausted backoff; "
                             "continuing at max delay", addr=addr,
                             attempts=attempts, err=repr(e)[:80])
-                    delay = min(delay * 2, RECONNECT_MAX_DELAY)
+                    delay = min(delay * 2, self.reconnect_max_delay)
 
         task = asyncio.create_task(_reconnect())
         task.add_done_callback(
@@ -362,7 +368,7 @@ class Switch:
         ``telemetry_interval`` — the hot path only ever touches ints."""
         try:
             while True:
-                await asyncio.sleep(self.telemetry_interval)
+                await clock.sleep(self.telemetry_interval)
                 try:
                     self.flush_peer_telemetry()
                 except Exception:
@@ -452,7 +458,7 @@ class Switch:
         (None with no peers: an isolated node is a different condition)."""
         if not self.peers:
             return None
-        now = time.monotonic()
+        now = clock.monotonic()
         return min(now - p.mconn.last_recv_mono
                    for p in self.peers.values())
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import time
 
+from ..libs import clock
 from .key import NodeKey, node_id
 from .metrics import p2p_metrics
 from .node_info import NodeInfo, NodeInfoError
@@ -96,7 +97,7 @@ class Transport:
         node = self.node_key.id[:8]
         t0 = time.perf_counter()
         try:
-            out = await asyncio.wait_for(
+            out = await clock.wait_for(
                 self._upgrade(reader, writer), self.handshake_timeout)
         except asyncio.CancelledError:
             raise                 # shutdown, not a handshake failure

@@ -1,0 +1,20 @@
+"""Deterministic scenario lab: virtual-time clock, in-memory transport,
+seeded byzantine adversaries — hundreds of in-process nodes, replayable
+from a seed (ROADMAP open item 5; ``docs/explanation/scenario-lab.md``).
+
+Never imported by production code: the real-time path pays nothing for
+the lab's existence (the ``libs/clock`` seam short-circuits to
+``time``/``asyncio`` when no virtual clock is installed)."""
+
+from .node import SimNode, SimTuning, make_genesis, make_sim_node
+from .scenario import Scenario, curated_suite, run_scenario
+from .transport import LinkPolicy, MemConn, MemNetwork, MemTransport
+from .vtime import (VirtualClock, VirtualTimeDeadlock, VirtualTimeLoop,
+                    run)
+
+__all__ = [
+    "SimNode", "SimTuning", "make_genesis", "make_sim_node",
+    "Scenario", "curated_suite", "run_scenario",
+    "LinkPolicy", "MemConn", "MemNetwork", "MemTransport",
+    "VirtualClock", "VirtualTimeDeadlock", "VirtualTimeLoop", "run",
+]

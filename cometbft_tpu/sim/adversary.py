@@ -1,0 +1,165 @@
+"""Seeded byzantine adversaries speaking the PR 9 misbehavior taxonomy.
+
+Each adversary is attached to a :class:`~cometbft_tpu.sim.node.SimNode`
+that otherwise runs the honest stack — the attack is a wrapper around
+its outbound hooks or an extra broadcast task, so everything it emits
+travels the real wire (MConnection packets, chaos sites, peer scoring)
+and everything honest nodes do about it is the production response.
+
+Kinds (``KINDS``):
+
+- ``equivocator`` — the double-signer: every non-nil vote it casts is
+  followed by a second, validly-signed vote for a fabricated block at
+  the same height/round/type.  Honest vote sets raise
+  ``ConflictingVoteError`` -> ``on_conflicting_vote`` -> evidence pool
+  -> ``DuplicateVoteEvidence`` in a committed block.  (With one
+  equivocator among 3f+1 honest validators safety holds; the run must
+  end fork-free WITH evidence committed.)
+- ``amnesiac`` — the forgetful voter: a seeded fraction of its own vote
+  broadcasts are silently withheld (it voted, gossip never hears).
+  Nothing provable ever hits the wire — pure liveness pressure, the
+  taxonomy's not-slashable quadrant.
+- ``spammer`` — invalid-part/proposal spammer: periodically broadcasts
+  block parts with garbage payloads and fake merkle proofs targeted at
+  the net's current height/round (plus the occasional non-msgpack
+  frame).  Honest handlers raise ``PartSetError`` ->
+  ``invalid_part``/``protocol_error`` scoring -> disconnect, then a
+  timed ban as it keeps coming.
+- ``flooder`` — the flood-then-ban-cycle adversary: pumps junk
+  transactions at every peer on the mempool channel.  Each one scores
+  ``invalid_tx`` (feather-weight — the ban takes sustained abuse),
+  the ban's TTL expires, it reconnects and floods again.
+
+All randomness is drawn from a per-adversary ``random.Random`` seeded
+from ``(scenario seed, node name)``, so the attack schedule replays
+bit-identically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import replace
+
+import msgpack
+
+from ..consensus.reactor import DATA_CHANNEL
+from ..crypto.merkle import Proof
+from ..libs import aio, clock
+from ..mempool.reactor import MEMPOOL_CHANNEL
+from ..types.block_id import BlockID, PartSetHeader
+from .node import SimNode
+
+KINDS = ("equivocator", "amnesiac", "spammer", "flooder")
+
+
+def attach(node: SimNode, kind: str, seed: int) -> None:
+    """Turn ``node`` byzantine.  Call after construction, before
+    ``start()``."""
+    if kind not in KINDS:
+        raise ValueError(f"unknown adversary kind {kind!r}; "
+                         f"expected one of {KINDS}")
+    node.byzantine = kind
+    rng = random.Random(f"{seed}:adversary:{node.name}")
+    if kind == "equivocator":
+        _attach_equivocator(node, rng)
+    elif kind == "amnesiac":
+        _attach_amnesiac(node, rng)
+    elif kind == "spammer":
+        node._adv_tasks.append(aio.spawn(_spam_parts(node, rng)))
+    elif kind == "flooder":
+        node._adv_tasks.append(aio.spawn(_flood_txs(node, rng)))
+
+
+# ----------------------------------------------------------- vote attacks
+
+def _attach_equivocator(node: SimNode, rng: random.Random) -> None:
+    cs = node.consensus
+    orig = cs.broadcast_vote
+    priv = node.pv.priv_key
+
+    def equivocate(vote) -> None:
+        orig(vote)
+        try:
+            if vote.block_id.is_nil() or not vote.signature:
+                return
+            alt = BlockID(rng.randbytes(32),
+                          PartSetHeader(1, rng.randbytes(32)))
+            dup = replace(vote, block_id=alt, signature=b"",
+                          extension=b"", extension_signature=b"",
+                          _sb_memo=None)
+            dup.signature = priv.sign(
+                dup.sign_bytes(cs.state.chain_id))
+            orig(dup)
+        except Exception:
+            pass                    # an attack must never crash its host
+
+    cs.broadcast_vote = equivocate
+
+
+def _attach_amnesiac(node: SimNode, rng: random.Random,
+                     forget_prob: float = 0.35) -> None:
+    cs = node.consensus
+    orig = cs.broadcast_vote
+
+    def forgetful(vote) -> None:
+        if rng.random() < forget_prob:
+            return                  # voted, told no one
+        orig(vote)
+
+    cs.broadcast_vote = forgetful
+
+
+# --------------------------------------------------------- wire spammers
+
+async def _spam_parts(node: SimNode, rng: random.Random,
+                      interval_s: float = 0.25) -> None:
+    """Invalid block parts (bad merkle proofs) aimed at the live
+    height/round, with the odd undecodable frame mixed in."""
+    cs = node.consensus
+    sw = node.switch
+    try:
+        while True:
+            await clock.sleep(interval_s)
+            if not sw.peers:
+                continue
+            if rng.random() < 0.2:
+                sw.broadcast(DATA_CHANNEL, rng.randbytes(48))
+                continue
+            proof = Proof(total=4, index=rng.randrange(4),
+                          leaf_hash=rng.randbytes(32),
+                          aunts=(rng.randbytes(32), rng.randbytes(32)))
+            part = {"i": proof.index, "b": rng.randbytes(64),
+                    "pt": proof.total, "pi": proof.index,
+                    "pl": proof.leaf_hash, "pa": list(proof.aunts)}
+            msg = msgpack.packb({"@": "part", "h": cs.rs.height,
+                                 "r": cs.rs.round, "p": part},
+                                use_bin_type=True)
+            sw.broadcast(DATA_CHANNEL, msg)
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass
+
+
+async def _flood_txs(node: SimNode, rng: random.Random,
+                     interval_s: float = 0.1, burst: int = 8) -> None:
+    """Junk-tx gossip: app-rejected txs score invalid_tx on every
+    receiving peer until the ban threshold trips; after the TTL the
+    flooder's reconnects are admitted again and the cycle repeats."""
+    sw = node.switch
+    try:
+        while True:
+            await clock.sleep(interval_s)
+            if not sw.peers:
+                continue
+            # hex payload: can never contain '=', so the kvstore app
+            # rejects every one (invalid_tx, not an accidental store)
+            txs = [b"\x00flood:" + rng.randbytes(12).hex().encode()
+                   for _ in range(burst)]
+            sw.broadcast(MEMPOOL_CHANNEL,
+                         msgpack.packb({"txs": txs}, use_bin_type=True))
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass

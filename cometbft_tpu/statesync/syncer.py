@@ -16,7 +16,7 @@ from __future__ import annotations
 import asyncio
 import functools
 
-from ..libs import aio
+from ..libs import aio, clock
 
 from ..abci import types as abci
 from ..libs import log as tmlog
@@ -306,7 +306,7 @@ class Syncer:
             self._snapshots.clear()
             if self.reactor is not None:
                 self.reactor.broadcast_snapshot_request()
-            await asyncio.sleep(discovery_time)
+            await clock.sleep(discovery_time)
             tried: set = set()
             while True:
                 best = self._best_snapshot(tried, rejected_formats)
@@ -401,14 +401,12 @@ class Syncer:
     MAX_CHUNK_RETRIES = 3
 
     async def _fetch_and_apply(self, pending) -> None:
-        import time as _time
-
         snapshot = pending.snapshot
         applied: set[int] = set()
         requested: dict[int, tuple[float, str]] = {}  # chunk -> (t, peer)
         retries: dict[int, int] = {}
         next_peer = 0
-        last_progress = _time.monotonic()
+        last_progress = clock.monotonic()
         while len(applied) < snapshot.chunks:
             # request chunks that were never requested or whose request
             # timed out — NOT everything missing on every wakeup, which
@@ -416,7 +414,7 @@ class Syncer:
             # at most MAX_INFLIGHT_PER_PEER outstanding requests, so
             # restore bandwidth scales with serving peers instead of
             # flooding one.
-            now = _time.monotonic()
+            now = clock.monotonic()
             inflight: dict[str, int] = {}
             for i, (t, peer) in requested.items():
                 # an assignment consumes its peer's budget until the
@@ -456,12 +454,12 @@ class Syncer:
             # timeout.  The timeout itself is PROGRESS-based (any chunk
             # arrival or apply resets it).
             try:
-                await asyncio.wait_for(self._chunk_event.wait(),
+                await clock.wait_for(self._chunk_event.wait(),
                                        CHUNK_TIMEOUT / 4)
                 self._chunk_event.clear()
-                last_progress = _time.monotonic()
+                last_progress = clock.monotonic()
             except asyncio.TimeoutError:
-                if _time.monotonic() - last_progress > CHUNK_TIMEOUT:
+                if clock.monotonic() - last_progress > CHUNK_TIMEOUT:
                     raise StatesyncError("timed out fetching chunks")
 
             # apply in STRICT index order (the ABCI restore contract —

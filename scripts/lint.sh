@@ -26,6 +26,25 @@ else
     python -m compileall -q "${TARGETS[@]}" || rc=1
 fi
 
+# Clock-seam guard: the clock-managed packages must route every sleep /
+# monotonic read through libs/clock (a direct call reads REAL time under
+# the scenario lab's virtual clock — a determinism bug, the exact class
+# PR 15 flushed out).  Legit exceptions carry a `clock-exempt` marker on
+# the same line.  libs/ itself (the seam + the virtual driver) and sim/
+# are out of scope.
+CLOCK_PKGS=(cometbft_tpu/consensus cometbft_tpu/p2p cometbft_tpu/node
+            cometbft_tpu/mempool cometbft_tpu/blocksync
+            cometbft_tpu/statesync)
+hits=$(grep -rnE 'asyncio\.sleep\(|time\.monotonic\(|time\.time\(|time\.time_ns\(' \
+        "${CLOCK_PKGS[@]}" \
+        --include='*.py' | grep -v 'clock-exempt' || true)
+if [ -n "$hits" ]; then
+    echo "[lint] direct real-time calls in clock-managed packages" \
+         "(route through libs/clock or mark clock-exempt):"
+    echo "$hits"
+    rc=1
+fi
+
 if [ "$rc" -ne 0 ]; then
     echo "[lint] FAILED"
 else

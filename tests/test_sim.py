@@ -1,0 +1,251 @@
+"""Scenario-lab tests: virtual clock, in-memory transport, seeded
+byzantine adversaries, and the replay contract (same seed + same
+scenario => identical verdict AND identical chaos signature)."""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from cometbft_tpu.libs import clock, failures
+from cometbft_tpu.sim import (MemNetwork, Scenario, SimTuning,
+                              VirtualTimeDeadlock, run_scenario)
+from cometbft_tpu.sim import vtime
+
+
+# -------------------------------------------------------- virtual clock
+
+def test_virtual_clock_sleep_and_timeout_cost_no_real_time():
+    """Hours of virtual sleeping and a fired wait_for timeout complete in
+    real milliseconds, and the clock seam reads virtual time."""
+
+    async def main():
+        t0 = clock.monotonic()
+        await clock.sleep(3600)
+        with pytest.raises(asyncio.TimeoutError):
+            await clock.wait_for(asyncio.Event().wait(), 1800)
+        return clock.monotonic() - t0, clock.walltime_ns()
+
+    real0 = time.monotonic()
+    virt, wall = vtime.run(main, seed=1)
+    assert time.monotonic() - real0 < 5.0      # vs 5400 s simulated
+    assert virt == pytest.approx(5400.0)
+    assert wall == vtime.VIRTUAL_EPOCH_NS + int(5400e9)
+    # seam restored: real clock again
+    assert clock.installed() is None
+    assert abs(clock.monotonic() - time.monotonic()) < 1.0
+
+
+def test_virtual_clock_timer_order_is_deterministic():
+    """Same seed, same schedule: callback order (hence the trace of a
+    run) is identical across runs."""
+
+    def make():
+        async def main():
+            out = []
+            for i, d in enumerate((0.3, 0.1, 0.2, 0.1, 0.0)):
+                async def tick(i=i, d=d):
+                    await clock.sleep(d)
+                    out.append(i)
+                asyncio.get_running_loop().create_task(tick())
+            await clock.sleep(1.0)
+            return out
+
+        return vtime.run(main, seed=5)
+
+    assert make() == make() == [4, 1, 3, 2, 0]
+
+
+def test_virtual_deadlock_detected(monkeypatch):
+    """A quiescent loop with nothing scheduled raises instead of
+    hanging CI forever."""
+    monkeypatch.setattr(vtime, "_MAX_IDLE_ROUNDS", 3)
+    monkeypatch.setattr(vtime, "_IDLE_SLICE_S", 0.01)
+
+    async def main():
+        await asyncio.Event().wait()       # can never fire
+
+    with pytest.raises(VirtualTimeDeadlock):
+        vtime.run(main, seed=0)
+
+
+# ------------------------------------------------------- mem transport
+
+def test_mem_network_policy_resolution_and_specs():
+    net = MemNetwork(default_latency_s=0.01)
+    net.apply_spec("link:node=a:peer=b:delay=0.2")
+    net.apply_spec("link:node=c:delay=0.05")           # c -> * wildcard
+    assert net.policy("a", "b").latency_s == pytest.approx(0.2)
+    assert net.policy("b", "a").latency_s == pytest.approx(0.01)
+    assert net.policy("c", "zz").latency_s == pytest.approx(0.05)
+    net.apply_spec("link:node=a:peer=b:cut=fwd")
+    assert net.policy("a", "b").cut and not net.policy("b", "a").cut
+    net.heal()
+    assert not net.policy("a", "b").cut
+    net.partition(["a"], ["b", "c"], one_way=True)
+    assert net.policy("a", "b").cut and not net.policy("b", "a").cut
+    with pytest.raises(failures.FaultSpecError):
+        net.apply_spec("notlink:delay=1")
+
+
+def test_mem_transport_full_stack_commits():
+    """Two sim nodes over MemTransport: real Switch handshake (NodeInfo
+    exchange, identity check), real MConnection packets, blocks
+    committed — the whole production p2p stack minus TCP."""
+    from cometbft_tpu.sim import make_genesis, make_sim_node
+
+    async def main():
+        failures.reset()
+        failures.configure(enabled=True, seed=3)
+        net = MemNetwork()
+        doc, pvs = make_genesis(2, chain_id="mem-pair")
+        nodes = [await make_sim_node(i, doc, pv, net)
+                 for i, pv in enumerate(pvs)]
+        for n in nodes:
+            await n.start()
+        peer = await nodes[0].dial(nodes[1], persistent=True)
+        assert peer.id == nodes[1].node_key.id
+        deadline = clock.monotonic() + 60
+        while min(n.height() for n in nodes) < 2:
+            assert clock.monotonic() < deadline, "no commits over mem wire"
+            await clock.sleep(0.1)
+        h1 = {n.block_store.load_block(1).hash() for n in nodes}
+        assert len(h1) == 1
+        for n in nodes:
+            await n.stop()
+        failures.reset()
+        return True
+
+    assert vtime.run(main, seed=3)
+
+
+# ----------------------------------------------------------- scenarios
+
+def test_partition_heal_liveness_and_recovery_metric():
+    scn = Scenario(
+        name="t-partition", seed=21, n_nodes=7, out_links=3,
+        target_height=5, max_virtual_s=900.0,
+        steps=[
+            {"at": 0.3, "op": "partition",
+             "groups": [[0, 1], [2, 3, 4, 5, 6]]},
+            {"at": 1.5, "op": "heal"},
+        ])
+    v = run_scenario(scn)
+    assert v["reached_target"] and v["fork_free"]
+    assert v["common_height"] >= 5
+    assert v["time_to_recover_s"] is not None
+    assert len(v["block_hashes"]) == v["common_height"]
+
+
+def test_scenario_json_round_trip_keeps_tuning():
+    """A Scenario saved to JSON must come back byte-identical INCLUDING
+    tuning — spam-flood-ban-25 exists to exercise ban_ttl_s=3.0, and a
+    round-trip that silently resurrects the default 10.0 changes the
+    ban/readmit cycle (hence the verdict) with no error."""
+    from cometbft_tpu.sim.scenario import curated_suite
+
+    for scn in curated_suite():
+        back = Scenario.from_dict(json.loads(json.dumps(scn.to_dict())))
+        assert back.tuning == scn.tuning, scn.name
+        assert back.to_dict() == scn.to_dict(), scn.name
+    # legacy dicts without the key still load (default tuning)
+    legacy = Scenario(name="t", seed=1).to_dict()
+    del legacy["tuning"]
+    assert Scenario.from_dict(legacy).tuning == SimTuning()
+
+
+def test_replay_identical_verdict_and_signature_with_prob_site():
+    """Satellite: same seed + same program => identical fault
+    signature() AND identical verdict JSON across two runs, including a
+    prob= site (the nondeterminism-prone trigger class)."""
+    scn = Scenario(
+        name="t-replay", seed=99, n_nodes=5, out_links=2,
+        target_height=3,
+        faults=["p2p.recv.corrupt:prob=0.05:max=8",
+                "p2p.send.delay:every=40:delay=0.05:max=10"])
+    from cometbft_tpu.sim.scenario import chaos_signature_of
+
+    v1, sig1 = chaos_signature_of(scn)
+    v2, sig2 = chaos_signature_of(scn)
+    assert sig1 == sig2 and len(sig1) > 0
+    assert json.dumps(v1, sort_keys=True) == json.dumps(v2, sort_keys=True)
+    assert v1["fork_free"]
+    # the prob site really fired (the signature carries its call indices)
+    assert any(site == "p2p.recv.corrupt" for site, _, _ in sig1)
+
+
+def test_double_sign_scenario_ends_in_committed_evidence():
+    """Satellite: the equivocator's conflicting votes must flow through
+    the evidence pool into a committed block, the byzantine validator
+    must be identified, and NO honest node may be banned for relaying
+    the (legitimate) evidence — the bad_evidence-exempt path."""
+    scn = Scenario(
+        name="t-equivocator", seed=31, n_nodes=5, out_links=2,
+        target_height=6, max_virtual_s=900.0,
+        byzantine={2: "equivocator"})
+    v = run_scenario(scn)
+    assert v["fork_free"], "one equivocator must not fork 3f+1 honest"
+    assert v["reached_target"]
+    assert v["evidence"]["committed_total"] >= 1
+    assert v["evidence"]["byzantine_punished"] == ["sim002"]
+    # honest gossip of real evidence is never scored bad_evidence, and
+    # nobody gets banned for it (EvidenceNotApplicableError drop path +
+    # committed-evidence dedup both return without punishment)
+    assert "bad_evidence" not in v["misbehavior_events"]
+    assert "bad_evidence" not in v["bans"]["by_reason"]
+    for name in v["bans"]["banned_nodes"]:
+        assert name == "sim002", f"honest node {name} banned"
+
+
+def test_flooder_is_banned_and_net_survives():
+    scn = Scenario(
+        name="t-flood", seed=41, n_nodes=5, out_links=2,
+        target_height=8, max_virtual_s=900.0,
+        byzantine={4: "flooder"},
+        tuning=SimTuning(ban_ttl_s=2.0))
+    v = run_scenario(scn)
+    assert v["reached_target"] and v["fork_free"]
+    assert v["misbehavior_events"].get("invalid_tx", 0) > 0
+    assert v["bans"]["total"] >= 1
+    assert v["bans"]["banned_nodes"] == ["sim004"]
+
+
+def test_crash_restore_rejoins():
+    scn = Scenario(
+        name="t-crash", seed=51, n_nodes=5, out_links=2,
+        target_height=5, max_virtual_s=900.0,
+        steps=[
+            {"at": 0.8, "op": "crash", "node": 1},
+            {"at": 2.0, "op": "restore", "node": 1},
+        ])
+    v = run_scenario(scn)
+    assert v["reached_target"] and v["fork_free"]
+    # the restored node is back in the honest floor: common_height
+    # includes it, so reaching target proves the rejoin worked
+    assert v["common_height"] >= 5
+
+
+# ----------------------------------------------- clock seam (real mode)
+
+def test_clock_seam_real_mode_matches_time_module():
+    assert clock.installed() is None
+    assert abs(clock.monotonic() - time.monotonic()) < 0.5
+    assert abs(clock.walltime_ns() - time.time_ns()) < int(5e8)
+    assert abs(clock.walltime() - time.time()) < 0.5
+
+
+def test_scorer_ban_ttl_runs_on_virtual_clock():
+    """quality.py decay/TTL rides the seam: a ban expires after virtual
+    seconds, not real ones."""
+    from cometbft_tpu.p2p.quality import PeerScorer
+
+    async def main():
+        sc = PeerScorer(ban_ttl_s=5.0)
+        for _ in range(3):
+            sc.report("peerX", "bad_block")
+        assert sc.is_banned("peerX")
+        await clock.sleep(6.0)          # virtual — instant in real time
+        return sc.is_banned("peerX")
+
+    assert vtime.run(main, seed=0) is False
