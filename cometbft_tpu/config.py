@@ -57,10 +57,33 @@ def test_consensus_config() -> ConsensusConfig:
 class MempoolConfig:
     size: int = 5000
     max_tx_bytes: int = 1024 * 1024
+    # byte-capacity bound across the whole pool (reference
+    # mempool.max_txs_bytes, default 1 GiB): capacity checks are no
+    # longer tx-count-only
+    max_txs_bytes: int = 1 << 30
     cache_size: int = 10000
     keep_invalid_txs_in_cache: bool = False
     broadcast: bool = True
     recheck: bool = True
+    # admission shards (by tx-hash prefix): each gets its own tx map,
+    # byte accounting, admission gate, and CheckTx coalescer, so
+    # concurrent admissions and the post-block recheck parallelize
+    shards: int = 4
+    # CheckTx coalescer: how long the FIRST queued admission may wait
+    # for batchmates (0 disables coalescing), and the size-flush cap
+    # (snapped DOWN to a crypto/batch compile bucket)
+    coalesce_ms: float = 1.0
+    coalesce_max: int = 64
+    # tx gossip dialect: "announce" = content-addressed (announce tx
+    # hashes, fetch bodies on miss; falls back to full bodies per peer
+    # for old-protocol peers), "full" = always send full bodies
+    gossip_mode: str = "announce"
+    # announce/fetch: how long one body fetch may be outstanding before
+    # it is re-requested from another announcer
+    fetch_timeout_s: float = 2.0
+    # byte budget per full-body / fetch-response gossip frame (many txs
+    # are packed per frame up to this)
+    gossip_batch_bytes: int = 64 * 1024
 
 
 @dataclass
@@ -445,6 +468,23 @@ class Config:
                 raise ConfigError(f"consensus.{name} must be positive")
         if self.mempool.size <= 0:
             raise ConfigError("mempool.size must be positive")
+        if self.mempool.max_txs_bytes <= 0:
+            raise ConfigError("mempool.max_txs_bytes must be positive")
+        if not 1 <= self.mempool.shards <= 256:
+            raise ConfigError("mempool.shards must be in [1, 256]")
+        if self.mempool.coalesce_ms < 0:
+            raise ConfigError("mempool.coalesce_ms must be >= 0")
+        if self.mempool.coalesce_max < 1:
+            raise ConfigError("mempool.coalesce_max must be >= 1")
+        if self.mempool.gossip_mode not in ("announce", "full"):
+            raise ConfigError(
+                f"bad mempool.gossip_mode {self.mempool.gossip_mode!r} "
+                "(expected 'announce' or 'full')")
+        if self.mempool.fetch_timeout_s <= 0:
+            raise ConfigError("mempool.fetch_timeout_s must be positive")
+        if self.mempool.gossip_batch_bytes < 1024:
+            raise ConfigError(
+                "mempool.gossip_batch_bytes must be >= 1024")
         if self.base.vote_sched_max_wait_ms < 0:
             raise ConfigError("base.vote_sched_max_wait_ms must be >= 0")
         if self.base.vote_sched_max_lanes < 1:

@@ -24,7 +24,18 @@ class LRUTxCache:
         self._map.pop(key, None)
 
     def has(self, key: bytes) -> bool:
-        return key in self._map
+        """Membership probe, refreshing recency: the announce-dedup path
+        consults this for every announced hash, and a tx that keeps
+        being announced (recently committed, still flooding the net)
+        should stay cached — evicting it would buy the next announce a
+        pointless fetch round trip."""
+        if key in self._map:
+            self._map.move_to_end(key)
+            return True
+        return False
+
+    def __len__(self) -> int:
+        return len(self._map)
 
     def reset(self) -> None:
         self._map.clear()

@@ -36,6 +36,19 @@ class Mempool(ABC):
     @abstractmethod
     async def flush(self) -> None: ...
 
+    def size_bytes(self) -> int:
+        """Total bytes of pooled txs (0 when unsupported)."""
+        return 0
+
+    def is_full(self, incoming_bytes: int = 0) -> bool:
+        """Capacity probe across every bound the pool enforces."""
+        return False
+
+    def get_tx(self, key: bytes):
+        """Body lookup by tx key — the content-addressed gossip reactor
+        serves fetch requests from here.  None when absent/unsupported."""
+        return None
+
     def txs_available(self):
         """Async event set when txs become available (may be unsupported)."""
         return None

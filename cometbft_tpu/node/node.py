@@ -221,8 +221,13 @@ class Node:
         self.mempool = CListMempool(
             self.app_conns.mempool, max_txs=cfg.mempool.size,
             max_tx_bytes=cfg.mempool.max_tx_bytes,
+            max_txs_bytes=cfg.mempool.max_txs_bytes,
             cache_size=cfg.mempool.cache_size,
             keep_invalid_txs_in_cache=cfg.mempool.keep_invalid_txs_in_cache,
+            shards=cfg.mempool.shards,
+            coalesce_ms=cfg.mempool.coalesce_ms,
+            coalesce_max=cfg.mempool.coalesce_max,
+            recheck=cfg.mempool.recheck,
             metrics_node=name)
         ev_db = make_db("evidence.db")
         self.evidence_pool = EvidencePool(
@@ -260,7 +265,10 @@ class Node:
         self.consensus_reactor = ConsensusReactor(
             self.consensus, gossip_sleep=gossip_sleep)
         self.mempool_reactor = MempoolReactor(
-            self.mempool, gossip_sleep=gossip_sleep)
+            self.mempool, gossip_sleep=gossip_sleep,
+            gossip_mode=cfg.mempool.gossip_mode,
+            fetch_timeout_s=cfg.mempool.fetch_timeout_s,
+            batch_bytes=cfg.mempool.gossip_batch_bytes)
 
         self.blocksync_reactor = BlocksyncReactor(
             self.block_exec, self.block_store, state,

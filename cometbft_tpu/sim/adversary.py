@@ -26,9 +26,12 @@ Kinds (``KINDS``):
   ``invalid_part``/``protocol_error`` scoring -> disconnect, then a
   timed ban as it keeps coming.
 - ``flooder`` — the flood-then-ban-cycle adversary: pumps junk
-  transactions at every peer on the mempool channel.  Each one scores
-  ``invalid_tx`` (feather-weight — the ban takes sustained abuse),
-  the ban's TTL expires, it reconnects and floods again.
+  transactions at every peer on the mempool channel, alternating both
+  gossip dialects — full-body pushes (old protocol) AND
+  content-addressed announce storms, where it announces junk tx hashes
+  and serves the junk bodies when honest peers fetch them.  Each junk
+  tx scores ``invalid_tx`` (feather-weight — the ban takes sustained
+  abuse), the ban's TTL expires, it reconnects and floods again.
 
 All randomness is drawn from a per-adversary ``random.Random`` seeded
 from ``(scenario seed, node name)``, so the attack schedule replays
@@ -143,22 +146,69 @@ async def _spam_parts(node: SimNode, rng: random.Random,
 
 
 async def _flood_txs(node: SimNode, rng: random.Random,
-                     interval_s: float = 0.1, burst: int = 8) -> None:
-    """Junk-tx gossip: app-rejected txs score invalid_tx on every
-    receiving peer until the ban threshold trips; after the TTL the
-    flooder's reconnects are admitted again and the cycle repeats."""
+                     interval_s: float = 0.1, burst: int = 12,
+                     stash_bound: int = 4096) -> None:
+    """Junk-tx gossip over BOTH dialects: app-rejected txs score
+    invalid_tx on every receiving peer until the ban threshold trips;
+    after the TTL the flooder's reconnects are admitted again and the
+    cycle repeats.
+
+    Half the bursts are full-body pushes (the old protocol); the other
+    half are content-addressed announce storms — junk hashes announced
+    with a ``hi`` capability greeting, the junk bodies stashed and
+    served when an honest peer fetches them, so the victim pays the
+    announce+fetch round trip AND the CheckTx rejection.  The stash is
+    the flooder's only state; honest scoring is identical either way."""
+    from ..mempool.mempool import TxKey
+
     sw = node.switch
+    stash: dict[bytes, bytes] = {}
+    reactor = node.mempool_reactor or sw.reactors.get("mempool")
+    if reactor is not None:
+        orig_receive = reactor.receive
+
+        def serve_junk(channel_id, peer, msg):
+            """Answer fetch requests from the junk stash (a real node
+            serves from its pool — the junk never got in), then let the
+            honest reactor see the frame too."""
+            try:
+                d = msgpack.unpackb(msg, raw=False)
+                req = d.get("req") if isinstance(d, dict) else None
+                if req:
+                    bodies = [stash[h] for h in req if h in stash]
+                    if bodies:
+                        peer.send(MEMPOOL_CHANNEL, msgpack.packb(
+                            {"txs": bodies}, use_bin_type=True))
+            except Exception:
+                pass
+            return orig_receive(channel_id, peer, msg)
+
+        reactor.receive = serve_junk
     try:
         while True:
             await clock.sleep(interval_s)
             if not sw.peers:
                 continue
-            # hex payload: can never contain '=', so the kvstore app
-            # rejects every one (invalid_tx, not an accidental store)
-            txs = [b"\x00flood:" + rng.randbytes(12).hex().encode()
+            # mostly hex payloads: no '=', so the kvstore app rejects
+            # them (invalid_tx scoring).  A seeded minority carry '='
+            # and ARE valid — classic volumetric spam that fills small
+            # pools, so honest nodes exercise the full-pool shed path
+            # (drop pre-CheckTx) on the rest of the storm.
+            txs = [(b"fl" + rng.randbytes(8).hex().encode() + b"=1")
+                   if rng.random() < 0.3 else
+                   (b"\x00flood:" + rng.randbytes(12).hex().encode())
                    for _ in range(burst)]
-            sw.broadcast(MEMPOOL_CHANNEL,
-                         msgpack.packb({"txs": txs}, use_bin_type=True))
+            if rng.random() < 0.5:
+                keys = [TxKey(t) for t in txs]
+                for k, t in zip(keys, txs):
+                    stash[k] = t
+                while len(stash) > stash_bound:
+                    del stash[next(iter(stash))]
+                sw.broadcast(MEMPOOL_CHANNEL, msgpack.packb(
+                    {"hi": 1, "ann": keys}, use_bin_type=True))
+            else:
+                sw.broadcast(MEMPOOL_CHANNEL, msgpack.packb(
+                    {"txs": txs}, use_bin_type=True))
     except asyncio.CancelledError:
         raise
     except Exception:

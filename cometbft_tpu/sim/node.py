@@ -47,6 +47,9 @@ class SimTuning:
     pong_timeout: float = 1.0
     gossip_sleep: float = 0.05       # consensus reactor idle poll
     mempool_gossip_sleep: float = 0.5
+    mempool_size: int = 5000         # small values force full-pool shed
+    mempool_mode: str = "announce"   # tx gossip dialect ("full" = old)
+    mempool_fetch_timeout_s: float = 1.0
     ban_ttl_s: float = 10.0          # short: ban cycles fit in one run
     ban_score: float = 10.0
     disconnect_score: float = 5.0
@@ -105,6 +108,7 @@ class SimNode:
     mempool: CListMempool
     evidence_pool: EvidencePool
     event_bus: EventBus
+    mempool_reactor: MempoolReactor | None = None
     byzantine: str = ""              # adversary kind, "" = honest
     _adv_tasks: list = field(default_factory=list)
 
@@ -158,7 +162,8 @@ async def make_sim_node(index: int, doc: GenesisDoc, pv: MockPV,
     bus = EventBus()
     bstore = BlockStore(MemDB())
     sstore = StateStore(MemDB())
-    mp = CListMempool(LocalClient(app), metrics_node=name)
+    mp = CListMempool(LocalClient(app), max_txs=tuning.mempool_size,
+                      metrics_node=name)
     state = State.from_genesis(doc)
     evpool = EvidencePool(state_store=sstore, block_store=bstore,
                           backend="cpu")
@@ -202,14 +207,18 @@ async def make_sim_node(index: int, doc: GenesisDoc, pv: MockPV,
                     reconnect_max_delay=tuning.reconnect_max_delay)
     cons_reactor = ConsensusReactor(cs, gossip_sleep=tuning.gossip_sleep)
     switch.add_reactor("consensus", cons_reactor)
-    switch.add_reactor("mempool", MempoolReactor(
-        mp, gossip_sleep=tuning.mempool_gossip_sleep))
+    mp_reactor = MempoolReactor(
+        mp, gossip_sleep=tuning.mempool_gossip_sleep,
+        gossip_mode=tuning.mempool_mode,
+        fetch_timeout_s=tuning.mempool_fetch_timeout_s)
+    switch.add_reactor("mempool", mp_reactor)
     switch.add_reactor("evidence", EvidenceReactor(evpool))
 
     node = SimNode(name=name, pv=pv, node_key=node_key, app=app,
                    consensus=cs, consensus_reactor=cons_reactor,
                    switch=switch, transport=transport,
                    block_store=bstore, state_store=sstore, mempool=mp,
-                   evidence_pool=evpool, event_bus=bus)
+                   evidence_pool=evpool, event_bus=bus,
+                   mempool_reactor=mp_reactor)
     node_box.append(node)
     return node

@@ -379,6 +379,15 @@ class _Run:
                 for evname, cnt in rec.events.items():
                     event_totals[evname] = \
                         event_totals.get(evname, 0) + cnt
+        mp_tally = {"full_skips": 0, "announced": 0, "ann_dedup": 0,
+                    "fetch_requests": 0, "fetch_fulfilled": 0,
+                    "fetch_timeouts": 0}
+        for node in honest:
+            r = node.mempool_reactor
+            if r is None:
+                continue
+            for k in mp_tally:
+                mp_tally[k] += r.tallies.get(k, 0)
         ttr = None
         if self.last_disruption_at is not None and \
                 self.recovered_at is not None:
@@ -415,6 +424,7 @@ class _Run:
                      "by_reason": dict(sorted(ban_reasons.items())),
                      "banned_nodes": sorted(banned_ids)},
             "misbehavior_events": dict(sorted(event_totals.items())),
+            "mempool": mp_tally,
             "chaos": {"signature_len": len(failures.signature()),
                       "sites": {s: v["fired"] for s, v in sorted(
                           failures.stats().get("sites", {}).items())}},
@@ -491,6 +501,16 @@ def curated_suite() -> list[Scenario]:
             max_virtual_s=900.0,
             byzantine={4: "spammer", 17: "flooder"},
             tuning=SimTuning(ban_ttl_s=3.0)),
+        Scenario(
+            name="txflood-shed-25",
+            seed=1107, n_nodes=25, out_links=3, target_height=8,
+            max_virtual_s=900.0,
+            byzantine={9: "flooder"},
+            # a TINY pool: the flood fills it, so honest nodes must
+            # SHED (full-pool skips, no CheckTx round trip) while the
+            # announce/fetch path and the invalid_tx->ban cycle run
+            tuning=SimTuning(ban_ttl_s=3.0, mempool_size=24,
+                             mempool_gossip_sleep=0.1)),
         Scenario(
             name="crash-restore-16",
             seed=1105, n_nodes=16, out_links=3, target_height=6,

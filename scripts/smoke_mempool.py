@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""CI mempool smoke: a 2-node net (one validator, one observer) driven
+through the r16 admission + gossip path end to end:
+
+- a burst of sig-less kvstore txs enters through the RPC broadcast
+  routes (sharded admission, coalesced CheckTx),
+- the observer learns them over CONTENT-ADDRESSED gossip — its
+  fetch-on-miss counters must show announce -> request -> body round
+  trips, not full-body re-flooding,
+- every tx commits, and block inclusion across heights preserves the
+  RPC submission order exactly (merged-shard reap FIFO),
+- the validator's RPC admission gate sheds part of a concurrent
+  broadcast burst with 503 + Retry-After while /status stays answerable
+  (the overload story stays true with the new mempool underneath).
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow beside the other smokes; runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_mempool.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_TXS = 40
+DEADLINE_S = 25
+
+
+async def http_get(host, port, path):
+    r, w = await asyncio.open_connection(host, port)
+    w.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+            "Connection: close\r\n\r\n".encode())
+    await w.drain()
+    raw = await r.read()
+    w.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.decode().split("\r\n")[0].split(" ")[1])
+    headers = {}
+    for ln in head.decode().split("\r\n")[1:]:
+        k, _, v = ln.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return status, headers, body
+
+
+async def scenario() -> None:
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.rpc.core import Environment, broadcast_tx_sync
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    pv = MockPV.from_secret(b"mp-smoke-val")
+    doc = GenesisDoc(chain_id="mempool-smoke",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+
+    async def mk(name, pv_, rpc=False):
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0" if rpc else ""
+        cfg.base.signature_backend = "cpu"
+        cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+        cfg.mempool.gossip_mode = "announce"
+        cfg.mempool.fetch_timeout_s = 0.5
+        if rpc:
+            # a 1-slot, 0-queue gate so the 503 shed probe is
+            # deterministic: any overlap in the burst must shed
+            cfg.rpc.max_concurrent_requests = 1
+            cfg.rpc.max_queued_requests = 0
+            cfg.rpc.shed_retry_after_s = 2.0
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv_, config=cfg,
+            node_key=NodeKey.from_secret(name.encode()), name=name)
+        await node.start()
+        return node
+
+    val = await mk("mp-val", pv, rpc=True)
+    obs = await mk("mp-obs", None)
+    try:
+        await obs.dial_peer(val.listen_addr, persistent=True)
+        deadline = time.monotonic() + 15
+        while val.node_key.id not in obs.switch.peers:
+            if time.monotonic() > deadline:
+                raise RuntimeError("observer never connected")
+            await asyncio.sleep(0.05)
+
+        # ---- burst through RPC: sharded admission, FIFO contract ----
+        env = Environment(val)
+        txs = [b"smoke%03d=v%03d" % (i, i) for i in range(N_TXS)]
+        for tx in txs:
+            res = await broadcast_tx_sync(env, tx=tx.hex())
+            if res["code"] != 0:
+                raise RuntimeError(f"tx rejected at admission: {res}")
+
+        # ---- 503 shed probe: concurrent burst vs the 1-slot gate ----
+        host, port = val.rpc_addr
+        burst = await asyncio.gather(*(
+            http_get(host, port,
+                     f"/broadcast_tx_sync?tx=%22{(b'b%d=v' % i).hex()}%22")
+            for i in range(8)))
+        statuses = [st for st, _, _ in burst]
+        if 503 not in statuses:
+            raise RuntimeError(
+                f"1-slot gate never shed 503 under an 8-wide concurrent "
+                f"burst: {statuses}")
+        if 200 not in statuses:
+            raise RuntimeError(f"gate shed EVERYTHING: {statuses}")
+        shed_hdr = next(h for st, h, _ in burst if st == 503)
+        if shed_hdr.get("retry-after") != "2":
+            raise RuntimeError(f"503 missing Retry-After: {shed_hdr}")
+        # status stays answerable through the shed (diagnostics exempt)
+        st, _, _ = await http_get(host, port, "/status")
+        if st != 200:
+            raise RuntimeError(f"/status gated: {st}")
+
+        # ---- all txs commit; inclusion order == submission order ----
+        want = set(txs)
+        deadline = time.monotonic() + DEADLINE_S
+        while True:
+            committed = []
+            h = val.block_store.height()
+            for height in range(1, h + 1):
+                blk = val.block_store.load_block(height)
+                if blk is not None:
+                    committed.extend(
+                        t for t in blk.data.txs if t in want)
+            if want <= set(committed):
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"only {len(set(committed) & want)}/{N_TXS} txs "
+                    f"committed by h{h}")
+            await asyncio.sleep(0.1)
+        if committed[:N_TXS] != txs:
+            raise RuntimeError(
+                "FIFO violated: block inclusion order != submission "
+                f"order (first divergence at "
+                f"{next(i for i, (a, b) in enumerate(zip(committed, txs)) if a != b)})")
+
+        # ---- observer fetched bodies on miss (content-addressed) ----
+        tallies = obs.mempool_reactor.tallies
+        if tallies["fetch_requests"] < 1 or tallies["fetch_fulfilled"] < 1:
+            raise RuntimeError(f"observer never fetched-on-miss: {tallies}")
+        # the observer caught up fork-free
+        deadline = time.monotonic() + 10
+        common = 0
+        while time.monotonic() < deadline:
+            common = min(val.height(), obs.height())
+            if common >= 2:
+                break
+            await asyncio.sleep(0.1)
+        for h in range(1, common + 1):
+            ha = val.block_store.load_block(h)
+            hb = obs.block_store.load_block(h)
+            if ha is None or hb is None or ha.hash() != hb.hash():
+                raise RuntimeError(f"fork/missing block at h{h}")
+        print(f"mempool smoke ok: {N_TXS} txs FIFO across "
+              f"{val.block_store.height()} heights, observer fetched "
+              f"{tallies['fetch_fulfilled']} bodies on miss "
+              f"({tallies['ann_dedup']} dedup), gate shed "
+              f"{statuses.count(503)}/8 with Retry-After")
+    finally:
+        for n in (val, obs):
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+def main() -> int:
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    except RuntimeError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

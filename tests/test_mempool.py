@@ -1,0 +1,476 @@
+"""Mempool internals (r16): shard routing + merged-reap FIFO, the
+CheckTx coalescer's per-item demux, batched recheck drop semantics,
+gossip bookkeeping pruning, byte-cap admission, and the
+content-addressed announce/fetch protocol (round trip, timeout
+re-request, old-protocol interop)."""
+
+import asyncio
+import time
+
+import msgpack
+import pytest
+
+from cometbft_tpu.abci.types import CheckTxResponse
+from cometbft_tpu.mempool.clist_mempool import (CListMempool,
+                                                MempoolFullError,
+                                                TxRejectedError)
+from cometbft_tpu.mempool.mempool import TxKey
+from cometbft_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+
+pytestmark = pytest.mark.timeout(120)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class ScriptedApp:
+    """CheckTx verdicts by tx prefix: b"bad..." rejects, b"drop..." is
+    accepted on admission but rejected on RECHECK (post-block state
+    change), everything else accepted.  Records call concurrency."""
+
+    def __init__(self):
+        self.calls = 0
+        self.recheck_calls = 0
+        self.inflight = 0
+        self.max_inflight = 0
+
+    async def check_tx(self, tx: bytes, recheck: bool = False):
+        self.calls += 1
+        self.inflight += 1
+        self.max_inflight = max(self.max_inflight, self.inflight)
+        await asyncio.sleep(0)
+        self.inflight -= 1
+        if recheck:
+            self.recheck_calls += 1
+            if tx.startswith(b"drop"):
+                return CheckTxResponse(code=1, log="stale")
+        if tx.startswith(b"bad"):
+            return CheckTxResponse(code=7, log="scripted reject")
+        return CheckTxResponse(code=0, gas_wanted=1)
+
+
+# ------------------------------------------------------------- sharding
+
+
+def test_shard_routing_spreads_and_accounts():
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=4, coalesce_ms=0)
+        txs = [b"tx-%d" % i for i in range(64)]
+        await asyncio.gather(*(mp.check_tx(t) for t in txs))
+        occupied = [n for n in mp.stats()["shards"] if n]
+        assert len(occupied) > 1, "64 txs all landed in one shard"
+        assert sum(mp.stats()["shards"]) == 64 == mp.size()
+        # shard routing is by tx-hash prefix, consistent with get_tx
+        for t in txs:
+            assert mp.get_tx(TxKey(t)) == t
+        return True
+
+    assert run(main())
+
+
+def test_merged_reap_preserves_arrival_fifo_across_shards():
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=8, coalesce_ms=0)
+        txs = [b"fifo-%03d" % i for i in range(100)]
+        for t in txs:                       # sequential: strict arrival
+            await mp.check_tx(t)
+        assert mp.reap_max_txs(1000) == txs
+        assert mp.contents() == txs
+        assert mp.reap_max_bytes_max_gas(-1, -1) == txs
+        assert [k for k, _ in mp.items()] == [TxKey(t) for t in txs]
+        return True
+
+    assert run(main())
+
+
+def test_merged_reap_fifo_under_concurrent_admission():
+    """Concurrent admissions across shards still reap in arrival-seq
+    order (seq is assigned before the app round trip)."""
+
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=4, coalesce_ms=0.5,
+                          coalesce_max=16)
+        txs = [b"conc-%03d" % i for i in range(60)]
+        await asyncio.gather(*(mp.check_tx(t) for t in txs))
+        assert mp.reap_max_txs(1000) == txs
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------------ coalescer
+
+
+def test_coalesced_checktx_demuxes_mixed_verdicts():
+    """One coalesced burst carries accepts AND rejects; every caller
+    gets ITS verdict (per-item demux, no batch poisoning)."""
+
+    async def main():
+        app = ScriptedApp()
+        mp = CListMempool(app, shards=1, coalesce_ms=5.0,
+                          coalesce_max=64)
+        txs = [b"ok-%d" % i for i in range(6)] + \
+              [b"bad-%d" % i for i in range(6)]
+        results = await asyncio.gather(
+            *(mp.check_tx(t) for t in txs), return_exceptions=True)
+        oks = [r for r in results if r is None]
+        rejects = [r for r in results if isinstance(r, TxRejectedError)]
+        assert len(oks) == 6 and len(rejects) == 6
+        assert all(r.code == 7 for r in rejects)
+        assert mp.size() == 6
+        assert app.max_inflight >= 12, \
+            "burst did not pipeline concurrently"
+        return True
+
+    assert run(main())
+
+
+def test_coalescer_size_flush_snaps_to_lane_bucket():
+    from cometbft_tpu.crypto.plan import snap_lane_cap
+
+    mp = CListMempool(ScriptedApp(), shards=1, coalesce_max=100)
+    assert mp._shards[0].checker.max_lanes == snap_lane_cap(100)
+
+
+# ------------------------------------------------------ batched recheck
+
+
+def test_batched_recheck_drops_stale_survivors():
+    async def main():
+        app = ScriptedApp()
+        mp = CListMempool(app, shards=4, coalesce_ms=0)
+        keep = [b"keep-%d" % i for i in range(10)]
+        drop = [b"drop-%d" % i for i in range(10)]
+        committed = [b"block-tx"]
+        for t in keep + drop + committed:
+            await mp.check_tx(t)
+        assert mp.size() == 21
+        removed_seen = []
+        mp.on_txs_removed = removed_seen.extend
+        async with mp.lock():
+            await mp.update(2, committed, [])
+        assert mp.size() == 10
+        assert sorted(mp.contents()) == sorted(keep)
+        # committed + recheck-dropped keys all reported for pruning
+        assert sorted(removed_seen) == sorted(
+            TxKey(t) for t in committed + drop)
+        # bytes accounting survived the drops
+        assert mp.size_bytes() == sum(len(t) for t in keep)
+        assert mp.height == 2
+        return True
+
+    assert run(main())
+
+
+def test_recheck_disabled_keeps_survivors():
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=2, coalesce_ms=0,
+                          recheck=False)
+        for t in (b"drop-a", b"drop-b"):
+            await mp.check_tx(t)
+        async with mp.lock():
+            await mp.update(2, [], [])
+        assert mp.size() == 2      # recheck off: nothing re-evaluated
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------------- capacity
+
+
+def test_byte_cap_admission():
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=2, coalesce_ms=0,
+                          max_txs=1000, max_txs_bytes=100)
+        await mp.check_tx(b"x" * 60)
+        assert mp.size_bytes() == 60
+        with pytest.raises(MempoolFullError):
+            await mp.check_tx(b"y" * 60)      # 120 > 100: byte-capped
+        await mp.check_tx(b"z" * 30)          # 90 <= 100: fits
+        assert mp.size() == 2 and mp.size_bytes() == 90
+        # removal releases byte budget
+        async with mp.lock():
+            await mp.update(2, [b"x" * 60], [])
+        assert mp.size_bytes() == 30
+        await mp.check_tx(b"w" * 60)
+        assert mp.size_bytes() == 90
+        return True
+
+    assert run(main())
+
+
+def test_size_bytes_is_running_total():
+    async def main():
+        mp = CListMempool(ScriptedApp(), shards=4, coalesce_ms=0)
+        total = 0
+        for i in range(20):
+            tx = b"b" * (i + 1)
+            await mp.check_tx(tx)
+            total += len(tx)
+        assert mp.size_bytes() == total
+        await mp.flush()
+        assert mp.size_bytes() == 0 == mp.size()
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------ reactor helpers
+
+
+class FakePeer:
+    def __init__(self, pid="peer-a", accept=True):
+        self.id = pid
+        self.accept = accept
+        self.frames: list[dict] = []
+
+    def send(self, channel_id, msg):
+        if not self.accept:
+            return False
+        self.frames.append(msgpack.unpackb(msg, raw=False))
+        return True
+
+    def sent_kinds(self):
+        return [next(iter(set(f) & {"ann", "req", "txs", "hi"}))
+                for f in self.frames]
+
+
+def mk_pool_reactor(app=None, mode="announce", **kw):
+    mp = CListMempool(app or ScriptedApp(), coalesce_ms=0, **kw)
+    return mp, MempoolReactor(mp, gossip_sleep=0.01, gossip_mode=mode,
+                              fetch_timeout_s=0.2)
+
+
+# ------------------------------------------------------ senders pruning
+
+
+def test_senders_pruned_on_update_and_bounded():
+    async def main():
+        mp, reactor = mk_pool_reactor()
+        peer = FakePeer("p1")
+        tx = b"gossiped-tx"
+        reactor.receive(MEMPOOL_CHANNEL, peer,
+                        msgpack.packb({"txs": [tx]}, use_bin_type=True))
+        await asyncio.sleep(0.05)
+        key = TxKey(tx)
+        assert peer.id in reactor._senders.get(key, ())
+        async with mp.lock():
+            await mp.update(2, [tx], [])   # committed: leaves the pool
+        assert key not in reactor._senders, \
+            "_senders entry leaked past removal"
+        # bound: the map can never exceed its cap even for never-admitted
+        # junk (rejected txs used to pin a set forever)
+        reactor._map_bound = 64
+        for i in range(200):
+            reactor._bounded_add(reactor._senders, b"h%03d" % i, "px")
+        assert len(reactor._senders) <= 64
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------- full-pool shedding counter
+
+
+def test_full_pool_announce_skips_fetch():
+    async def main():
+        mp, reactor = mk_pool_reactor(max_txs=1)
+        await mp.check_tx(b"occupies-the-pool")
+        peer = FakePeer("flood")
+        before = reactor.tallies["full_skips"]
+        reactor.receive(MEMPOOL_CHANNEL, peer, msgpack.packb(
+            {"hi": 1, "ann": [b"\x01" * 32, b"\x02" * 32]},
+            use_bin_type=True))
+        assert reactor.tallies["full_skips"] == before + 2
+        assert reactor.tallies["fetch_requests"] == 0
+        assert not any("req" in f for f in peer.frames), \
+            "full pool bought the flood a fetch round trip"
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------------- announce/fetch
+
+
+def test_announce_fetch_round_trip_between_reactors():
+    """Two real reactors linked by hand-delivered frames: A announces,
+    B requests, A serves the body, B admits it via CheckTx."""
+
+    async def main():
+        mp_a, ra = mk_pool_reactor()
+        mp_b, rb = mk_pool_reactor()
+        tx = b"round-trip-tx"
+        await mp_a.check_tx(tx)
+
+        a_view_of_b = FakePeer("node-b")    # what A sends toward B
+        b_view_of_a = FakePeer("node-a")    # what B sends toward A
+        # capability exchange (add_peer hello)
+        ra.receive(MEMPOOL_CHANNEL, a_view_of_b,
+                   msgpack.packb({"hi": 1}, use_bin_type=True))
+        rb.receive(MEMPOOL_CHANNEL, b_view_of_a,
+                   msgpack.packb({"hi": 1}, use_bin_type=True))
+        assert "node-b" in ra._capable and "node-a" in rb._capable
+
+        # A's broadcast routine would announce; hand-build the frame
+        keys = [k for k, _ in mp_a.items()]
+        rb.receive(MEMPOOL_CHANNEL, b_view_of_a,
+                   msgpack.packb({"ann": keys}, use_bin_type=True))
+        # B requested the missing body from A
+        req_frames = [f for f in b_view_of_a.frames if "req" in f]
+        assert req_frames and req_frames[0]["req"] == [TxKey(tx)]
+        assert rb.tallies["fetch_requests"] == 1
+        # serve the request through A's reactor
+        ra.receive(MEMPOOL_CHANNEL, a_view_of_b,
+                   msgpack.packb(req_frames[0], use_bin_type=True))
+        body_frames = [f for f in a_view_of_b.frames if "txs" in f]
+        assert body_frames and body_frames[0]["txs"] == [tx]
+        # deliver the body to B: fulfills the fetch, admits the tx
+        rb.receive(MEMPOOL_CHANNEL, b_view_of_a,
+                   msgpack.packb(body_frames[0], use_bin_type=True))
+        await asyncio.sleep(0.05)
+        assert rb.tallies["fetch_fulfilled"] == 1
+        assert mp_b.get_tx(TxKey(tx)) == tx
+        # duplicate announce is pure dedup now
+        rb.receive(MEMPOOL_CHANNEL, b_view_of_a,
+                   msgpack.packb({"ann": keys}, use_bin_type=True))
+        assert rb.tallies["ann_dedup"] >= 1
+        return True
+
+    assert run(main())
+
+
+def test_fetch_timeout_rerequests_from_another_announcer():
+    async def main():
+        mp, reactor = mk_pool_reactor()
+        dead = FakePeer("announcer-dead")
+        alive = FakePeer("announcer-alive")
+
+        class SwitchStub:
+            peers = {"announcer-alive": alive, "announcer-dead": dead}
+
+        reactor.set_switch(SwitchStub())
+        # both peers "connected" as far as the reactor knows
+        reactor._peer_tasks["announcer-dead"] = None
+        reactor._peer_tasks["announcer-alive"] = None
+        reactor._sweep_task = asyncio.ensure_future(
+            reactor._sweep_requests())
+        h = TxKey(b"never-served-tx")
+        # dead announces first -> initial request goes to dead
+        reactor.receive(MEMPOOL_CHANNEL, dead, msgpack.packb(
+            {"ann": [h]}, use_bin_type=True))
+        reactor.receive(MEMPOOL_CHANNEL, alive, msgpack.packb(
+            {"ann": [h]}, use_bin_type=True))
+        assert any("req" in f for f in dead.frames)
+        assert not any("req" in f for f in alive.frames)
+        # dead never serves: the sweeper re-requests from alive
+        deadline = time.monotonic() + 5
+        while not any("req" in f for f in alive.frames):
+            assert time.monotonic() < deadline, \
+                "timeout never re-requested from the other announcer"
+            await asyncio.sleep(0.02)
+        assert reactor.tallies["fetch_timeouts"] >= 1
+        assert reactor.tallies["fetch_requests"] >= 2
+        reactor._sweep_task.cancel()
+        return True
+
+    assert run(main())
+
+
+def test_old_protocol_interop_gets_full_bodies():
+    """A peer that never says hi (pre-r16 reactor) is gossiped full tx
+    bodies, many per frame; an announce-capable peer gets hashes."""
+
+    async def main():
+        mp, reactor = mk_pool_reactor()
+        for i in range(5):
+            await mp.check_tx(b"interop-%d" % i)
+        old_peer = FakePeer("old-proto")
+        new_peer = FakePeer("new-proto")
+        reactor.receive(MEMPOOL_CHANNEL, new_peer,
+                        msgpack.packb({"hi": 1}, use_bin_type=True))
+        reactor.add_peer(old_peer)
+        reactor.add_peer(new_peer)
+        try:
+            deadline = time.monotonic() + 5
+            while not (any("txs" in f for f in old_peer.frames)
+                       and any("ann" in f for f in new_peer.frames)):
+                assert time.monotonic() < deadline, (
+                    old_peer.frames, new_peer.frames)
+                await asyncio.sleep(0.02)
+            # old peer: one frame carries ALL pending bodies (batched)
+            body_frame = next(f for f in old_peer.frames if "txs" in f)
+            assert len(body_frame["txs"]) == 5
+            # old peer never receives announces
+            assert not any("ann" in f for f in old_peer.frames)
+            # new peer: hashes only, no unsolicited bodies
+            ann_frame = next(f for f in new_peer.frames if "ann" in f)
+            assert sorted(ann_frame["ann"]) == sorted(
+                k for k, _ in mp.items())
+            assert not any("txs" in f for f in new_peer.frames)
+        finally:
+            await reactor.stop()
+        return True
+
+    assert run(main())
+
+
+def test_gossip_mode_full_never_announces():
+    async def main():
+        mp, reactor = mk_pool_reactor(mode="full")
+        await mp.check_tx(b"full-mode-tx")
+        peer = FakePeer("p-full")
+        # even a capable peer gets bodies when WE are in full mode
+        reactor.receive(MEMPOOL_CHANNEL, peer,
+                        msgpack.packb({"hi": 1}, use_bin_type=True))
+        reactor.add_peer(peer)
+        try:
+            deadline = time.monotonic() + 5
+            while not any("txs" in f for f in peer.frames):
+                assert time.monotonic() < deadline
+                await asyncio.sleep(0.02)
+            assert not any("hi" in f for f in peer.frames)
+            assert not any("ann" in f for f in peer.frames)
+        finally:
+            await reactor.stop()
+        return True
+
+    assert run(main())
+
+
+# ------------------------------------------------- scenario-lab flood
+
+
+def test_txflood_scenario_sheds_and_bans_replay_identical():
+    """The tx-flood adversary through the scenario lab: the flooder is
+    scored and banned, victims shed (full-pool skips) instead of
+    collapsing, the net stays fork-free, and the whole verdict replays
+    bit-identically across two seeded runs."""
+    import json
+
+    from cometbft_tpu.sim.node import SimTuning
+    from cometbft_tpu.sim.scenario import Scenario, run_scenario
+
+    scn = Scenario(
+        name="t-txflood-shed", seed=61, n_nodes=5, out_links=2,
+        target_height=8, max_virtual_s=900.0,
+        byzantine={4: "flooder"},
+        tuning=SimTuning(ban_ttl_s=2.0, mempool_size=8,
+                         mempool_gossip_sleep=0.1))
+    v1 = run_scenario(scn)
+    v2 = run_scenario(scn)
+    assert json.dumps(v1, sort_keys=True) == \
+        json.dumps(v2, sort_keys=True), "verdict not replay-identical"
+    assert v1["reached_target"] and v1["fork_free"]
+    assert v1["misbehavior_events"].get("invalid_tx", 0) > 0
+    assert v1["bans"]["banned_nodes"] == ["sim004"]
+    mp = v1["mempool"]
+    assert mp["full_skips"] > 0, "tiny pool never shed the flood"
+    assert mp["fetch_requests"] > 0 and mp["fetch_fulfilled"] > 0, \
+        "announce/fetch path never exercised"
