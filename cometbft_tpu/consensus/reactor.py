@@ -121,7 +121,14 @@ def votes_from_commit(commit: Commit) -> list[Vote]:
 class PeerState:
     """What we know about one peer's consensus view (reactor.go:1079)."""
 
-    def __init__(self):
+    def __init__(self, rng: random.Random | None = None):
+        # per-peer seeded RNG for gossip picks/jitter (DET001): drawing
+        # from the GLOBAL rng makes the sequence a function of coroutine
+        # interleaving across every peer and node in the process, which
+        # breaks the scenario lab's replay-identity contract.  Keyed per
+        # (node, peer) the sequence is a pure function of identity —
+        # decorrelated between peers, byte-stable across replays.
+        self.rng = rng if rng is not None else random.Random()
         self.height = 0
         self.round = -1
         self.step = 0
@@ -235,7 +242,8 @@ class ConsensusReactor(Reactor):
     # ------------------------------------------------------ peer lifecycle
 
     def add_peer(self, peer) -> None:
-        peer.set("cons_peer_state", PeerState())
+        peer.set("cons_peer_state", PeerState(
+            rng=random.Random(f"gossip:{self.cs.name}:{peer.id}")))
         # gossip-efficiency children, pre-bound per peer (the label is
         # the same 12-char prefix the p2p telemetry uses)
         from ..p2p.metrics import peer_label
@@ -497,7 +505,7 @@ class ConsensusReactor(Reactor):
                 parts.header() != ps.proposal_block_parts_header:
             return False
         want = parts.bit_array().sub(ps.proposal_block_parts)
-        idx, ok = want.pick_random()
+        idx, ok = want.pick_random(ps.rng)
         if not ok:
             return False
         part = parts.get_part(idx)
@@ -537,7 +545,7 @@ class ConsensusReactor(Reactor):
                 rs.proposal_block_parts.header():
             want = rs.proposal_block_parts.bit_array().sub(
                 ps.proposal_block_parts)
-            idx, ok = want.pick_random()
+            idx, ok = want.pick_random(ps.rng)
             if ok:
                 part = rs.proposal_block_parts.get_part(idx)
                 ps.proposal_block_parts.set_index(idx, True)
@@ -615,7 +623,7 @@ class ConsensusReactor(Reactor):
                               vote_set.type, ours.size)
         if theirs is None:
             return False
-        idx, ok = ours.sub(theirs).pick_random()
+        idx, ok = ours.sub(theirs).pick_random(ps.rng)
         if not ok:
             return False
         vote = vote_set.get_by_index(idx)
@@ -635,7 +643,7 @@ class ConsensusReactor(Reactor):
             # peer's round state may not cover this commit round: track ad hoc
             ps.last_commit_round = commit.round
             ps.last_commit = theirs = BitArray(len(commit.signatures))
-        idx, ok = present.sub(theirs).pick_random()
+        idx, ok = present.sub(theirs).pick_random(ps.rng)
         if not ok:
             return False
         vote = next(v for v in votes if v.validator_index == idx)
@@ -649,7 +657,7 @@ class ConsensusReactor(Reactor):
         try:
             while True:
                 await clock.sleep(QUERY_MAJ23_SLEEP
-                                    * (0.8 + 0.4 * random.random()))
+                                    * (0.8 + 0.4 * ps.rng.random()))
                 rs = self.cs.rs
                 if rs.votes is None or ps.height != rs.height:
                     continue

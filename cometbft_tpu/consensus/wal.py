@@ -63,7 +63,7 @@ def wal_segments(path: str) -> list[str]:
     found = []          # (index, path); the bare path is index 0
     try:
         names = os.listdir(d)
-    except OSError:
+    except OSError:  # bftlint: disable=EXC001 -- read-only discovery scan; an unreadable dir reads as no segments and the boot doctor cross-checks WAL lineage
         names = []
     for name in names:
         if name == base:
@@ -81,7 +81,7 @@ def _iter_segment_file(path: str):
     try:
         with open(path, "rb") as f:
             raw = f.read()
-    except OSError:
+    except OSError:  # bftlint: disable=EXC001 -- the False sentinel IS the routing: callers treat an unreadable segment exactly like a corrupt one
         yield False
         return
     off = 0
@@ -303,7 +303,7 @@ class WAL:
         self.write_sync({"#": "endheight", "h": height})
         try:
             self.prune_completed_segments()
-        except OSError:
+        except OSError:  # bftlint: disable=EXC001 -- prune is best-effort cleanup AFTER the fsync'd sentinel; failure leaves extra segments, never loses records
             pass
         self._prev_sentinel_seg = sentinel_seg
 

@@ -27,8 +27,15 @@ Discipline (same as ``libs/tracing`` / ``libs/failures``):
   (:func:`monotonic`, :func:`walltime_ns`): a ``time.monotonic()`` call
   inside a clock-managed package reads *real* time under simulation and
   silently breaks determinism (step ages, RTTs, score decay, ban TTLs).
-  ``scripts/lint.sh`` rejects new direct calls in managed packages; the
-  rare legitimate exception carries a ``clock-exempt`` marker comment.
+  bftlint's CLK001 (``scripts/analysis``, run by ``scripts/lint.sh``)
+  rejects new direct calls in managed packages — including aliased
+  imports and ``loop.time()``, which the old regex guard missed; the
+  rare legitimate exception carries a
+  ``# bftlint: disable=CLK001 -- reason`` marker (the successor of the
+  retired ``clock-exempt`` grep marker).  ``time.perf_counter`` is NOT
+  banned: it is the duration-METRICS clock (histograms measure real CPU
+  cost even under the virtual clock), while monotonic/time/sleep order
+  events and must virtualize.
 
 ``install()`` is process-wide like the chaos plane: an in-proc ensemble
 shares one clock.

@@ -410,16 +410,15 @@ class MConnection:
     # ----------------------------------------------------------------- ping
 
     async def _ping_routine(self) -> None:
-        loop = asyncio.get_running_loop()
         try:
             while True:
                 await clock.sleep(self.ping_interval)
                 await self._write_packet({"t": "i"})
                 self._ping_sent_mono = clock.monotonic()
-                self._pong_due = loop.time() + self.pong_timeout
+                self._pong_due = clock.monotonic() + self.pong_timeout
                 await clock.sleep(self.pong_timeout)
                 if self._pong_due is not None and \
-                        loop.time() >= self._pong_due:
+                        clock.monotonic() >= self._pong_due:
                     self.pong_timeouts += 1
                     raise PongTimeoutError("pong timeout")
         except asyncio.CancelledError:

@@ -269,7 +269,7 @@ def _extract_ts_candidates(sign_bytes: bytes):
 
     try:
         yield canonical.decode_timestamp_from_vote(sign_bytes)
-    except Exception:
+    except Exception:  # bftlint: disable=EXC001 -- best-effort parse of already-persisted bytes; no candidates just means no ts-equivocation match
         return
 
 

@@ -115,7 +115,7 @@ class SignerServer:
                 return {"@": "signed_proposal_res",
                         "proposal": codec.to_dict(prop)}
             return {"@": "err", "msg": f"unknown request {tag!r}"}
-        except Exception as e:           # double-sign refusals ride back
+        except Exception as e:  # bftlint: disable=EXC001 -- double-sign refusals and sign errors ride back over the wire as err frames; the client re-raises
             return {"@": "err", "msg": f"{type(e).__name__}: {e}"}
 
 
@@ -260,7 +260,7 @@ class SignerListener(PrivValidator):
                                               timeout_s=self._timeout_s),
                     min(5.0, max(0.1, remaining)))
                 return self._client
-            except Exception:
+            except Exception:  # bftlint: disable=EXC001 -- a failed handshake closes the conn and loops to re-accept under the caller's deadline
                 writer.close()
 
     async def _reconnect(self) -> SignerClient:
@@ -281,7 +281,7 @@ class SignerListener(PrivValidator):
             try:
                 return await op(self._client)
             except (asyncio.IncompleteReadError, ConnectionError,
-                    SignerTimeoutError, OSError):
+                    SignerTimeoutError, OSError):  # bftlint: disable=EXC001 -- dropped-link/wedged-signer discipline (PR 10): close, re-accept the redial, retry once; the retry re-raises
                 await self._reconnect()
                 return await op(self._client)
 
@@ -341,6 +341,6 @@ async def serve_dialer(pv: PrivValidator, host: str, port: int,
         attempts = 0
         try:
             await server._serve(reader, writer)
-        except Exception:        # malformed frame must not kill the daemon
+        except Exception:  # bftlint: disable=EXC001 -- a malformed frame must not kill the signer daemon; it closes and redials
             writer.close()
         await asyncio.sleep(retry_interval)

@@ -155,11 +155,19 @@ _GENESIS_CHUNK_SIZE = 16 * 1024 * 1024   # rpc/core/env.go:32
 async def genesis(env: Environment) -> dict:
     import json as _json
 
-    raw = env.node.genesis.to_json()
-    if len(raw.encode()) > _GENESIS_CHUNK_SIZE:
+    def _build():
+        # serialize + size-check + decode all off the event loop: at
+        # the 16MB ceiling even the to_json dump is a visible stall
+        raw = env.node.genesis.to_json()
+        if len(raw.encode()) > _GENESIS_CHUNK_SIZE:
+            return None
+        return _json.loads(raw)
+
+    doc = await asyncio.to_thread(_build)
+    if doc is None:
         raise RPCError(-32603, "genesis response is large, please use the "
                        "genesis_chunked API instead")
-    return {"genesis": _json.loads(raw)}
+    return {"genesis": doc}
 
 
 async def genesis_chunked(env: Environment, chunk=0) -> dict:

@@ -53,7 +53,13 @@ _GATE_PROBE_MAX_BODY = 4096
 # validators on the provider's preferred single-round-trip path)
 _THREAD_ENCODE_METHODS = frozenset(
     {"dump_incidents", "dump_trace",
-     "light_block", "light_blocks", "light_proofs", "light_verify"})
+     "light_block", "light_blocks", "light_proofs", "light_verify",
+     # block-/valset-scaled payloads (a 10k-validator commit alone is
+     # ~MB of JSON): encoding them inline froze every other connection
+     # — the thread-encode gap class the BLK001 sweep closed
+     "block", "block_by_hash", "block_results", "blockchain", "commit",
+     "validators", "genesis", "genesis_chunked", "tx_search",
+     "block_search", "unconfirmed_txs", "dump_consensus_state"})
 
 
 @functools.cache
@@ -411,6 +417,7 @@ class RPCServer:
                     # routes are fixed after __init__ so the serialized
                     # document is computed once
                     if self._openapi_raw is None:
+                        # bftlint: disable=BLK001 -- one-time encode of the static route table (KBs), cached for the server's lifetime
                         self._openapi_raw = json.dumps(
                             self.openapi_spec()).encode()
                     text = self._openapi_raw
@@ -458,7 +465,10 @@ class RPCServer:
                 try:
                     if method == "POST":
                         if not parsed:
-                            req, parse_err = self._parse_jsonrpc(body)
+                            # only >probe-size bodies reach here
+                            # unparsed — decode those off the loop
+                            req, parse_err = await asyncio.to_thread(
+                                self._parse_jsonrpc, body)
                             if isinstance(req, dict):
                                 rpc_method = req.get("method")
                         resp = parse_err if parse_err is not None else \
@@ -471,14 +481,18 @@ class RPCServer:
                 finally:
                     if gated:
                         self._gate_done()
-                if rpc_method in _THREAD_ENCODE_METHODS:
+                if rpc_method in _THREAD_ENCODE_METHODS or \
+                        isinstance(req, list):
                     # multi-MB diagnostic payloads (incident bundles,
                     # trace dumps) serialize off the event loop — these
                     # routes bypass the gate, so their encode especially
-                    # must not stall pings/consensus timers
+                    # must not stall pings/consensus timers.  JSON-RPC
+                    # BATCHES have no single method and can stack heavy
+                    # calls, so they always thread-encode
                     raw = await asyncio.to_thread(json.dumps, resp)
                     raw = raw.encode()
                 else:
+                    # bftlint: disable=BLK001 -- small-payload path: block-/valset-/pool-scaled routes are in _THREAD_ENCODE_METHODS, batches thread-encode above
                     raw = json.dumps(resp).encode()
                 writer.write(
                     b"HTTP/1.1 200 OK\r\n"
@@ -599,10 +613,24 @@ class _WsSession:
                 if op not in (1, 2):
                     continue
                 try:
-                    req = json.loads(payload)
+                    if len(payload) > _GATE_PROBE_MAX_BODY:
+                        # fat frames (tx broadcasts can ride ws) parse
+                        # off the loop, like >4KB HTTP bodies
+                        req = await asyncio.to_thread(json.loads, payload)
+                    else:
+                        # bftlint: disable=BLK001 -- <=4KB frame, same inline-parse bound as the HTTP gate probe
+                        req = json.loads(payload)
                 except json.JSONDecodeError:
                     await self._send_json(_rpc_error(None, -32700,
                                                      "parse error"))
+                    continue
+                if not isinstance(req, dict):
+                    # subscribe/unsubscribe semantics don't compose with
+                    # JSON-RPC batches; the HTTP path serves those
+                    await self._send_json(_rpc_error(
+                        None, -32600,
+                        "websocket frames must carry a single "
+                        "JSON-RPC object (use HTTP POST for batches)"))
                     continue
                 await self._handle(req)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -646,7 +674,13 @@ class _WsSession:
                 resp = await self.server._dispatch(rid, method, params)
             finally:
                 self.server._gate_done()
-            await self._send_json(resp)
+            if method in _THREAD_ENCODE_METHODS:
+                # block-/valset-scaled payloads encode off the loop on
+                # the gated ws path too
+                raw = await asyncio.to_thread(json.dumps, resp)
+                await self._send_frame(1, raw.encode())
+            else:
+                await self._send_json(resp)
 
     async def _subscribe(self, rid, query: str) -> None:
         try:
@@ -674,16 +708,25 @@ class _WsSession:
         self.server.env.node.event_bus.unsubscribe(f"{self.sid}:{query}")
 
     async def _pump(self, query: str, sub) -> None:
-        """Push matching events as JSON-RPC notifications."""
+        """Push matching events as JSON-RPC notifications.  Event
+        payloads carry whole blocks (NewBlock at 10k validators is MBs
+        of JSON), so notifications thread-encode — the acks-only
+        _send_json path stays inline."""
         try:
             while True:
                 msg = await sub.queue.get()
-                await self._send_json({
-                    "jsonrpc": "2.0", "id": None,
-                    "result": {"query": query,
-                               "data": {"type": msg.event_type,
-                                        "value": _event_value(msg)},
-                               "events": msg.attrs}})
+
+                def _encode(m=msg, q=query):
+                    # the jsonable projection of a whole block costs as
+                    # much as the dumps — both belong off the loop
+                    return json.dumps({
+                        "jsonrpc": "2.0", "id": None,
+                        "result": {"query": q,
+                                   "data": {"type": m.event_type,
+                                            "value": _event_value(m)},
+                                   "events": m.attrs}})
+                raw = await asyncio.to_thread(_encode)
+                await self._send_frame(1, raw.encode())
         except (asyncio.CancelledError, ConnectionError):
             pass
 
@@ -703,10 +746,15 @@ class _WsSession:
         if ln > MAX_BODY:
             raise ConnectionError(f"oversized ws frame ({ln} bytes)")
         mask = await self.reader.readexactly(4) if masked else b"\x00" * 4
-        data = bytearray(await self.reader.readexactly(ln))
-        if masked:
-            for i in range(len(data)):
-                data[i] ^= mask[i % 4]
+        data = await self.reader.readexactly(ln)
+        if masked and ln:
+            # bulk XOR via big-int: the per-byte Python loop burned ~1s
+            # of event-loop time on a 10 MiB frame — C-speed keeps even
+            # MAX_BODY frames in the low ms
+            pad = mask * ((ln + 3) // 4)
+            data = (int.from_bytes(data, "little") ^
+                    int.from_bytes(pad[:ln], "little")
+                    ).to_bytes(ln, "little")
         return op, bytes(data)
 
     async def _send_frame(self, op: int, payload: bytes) -> None:
@@ -721,6 +769,7 @@ class _WsSession:
         await self.writer.drain()
 
     async def _send_json(self, obj: dict) -> None:
+        # bftlint: disable=BLK001 -- acks/errors only (bounded small); event payloads thread-encode in _pump, diagnostics in _handle
         await self._send_frame(1, json.dumps(obj).encode())
 
 

@@ -315,7 +315,7 @@ class LogDB(KVStore):
         try:
             with open(self._dirty_path()) as f:
                 return json.load(f)
-        except (OSError, ValueError):
+        except (OSError, ValueError):  # bftlint: disable=EXC001 -- read-only marker probe; the dirty GATE keys off exists(), this only loses detail
             return None
 
     @staticmethod

@@ -1,0 +1,16 @@
+"""bftlint — project-native AST static analysis for cometbft_tpu.
+
+The repo's hard-won concurrency/determinism invariants (clock seam,
+lock discipline, task retention, thread-encode, fatal-IO routing,
+replay identity) encoded as enforced rules.  Run from ``scripts/``:
+
+    python -m analysis                 # whole tree, exit 1 on NEW findings
+    python -m analysis --rules CLK001  # one rule (lint.sh clock gate)
+    python -m analysis --json report.json
+
+Stdlib-``ast`` only; no third-party dependencies.
+"""
+
+from .engine import main, run_paths, load_baseline  # noqa: F401
+
+__version__ = "1.0"

@@ -1,0 +1,502 @@
+"""bftlint engine: file walking, suppressions, baseline, reporting.
+
+Design (mirrors how libs/failures and libs/tracing stay dependency-free):
+
+* one ``ast.parse`` per file, one shared :class:`FileContext` handed to
+  every in-scope rule — rules walk the same tree, never re-read disk;
+* inline suppressions ``# bftlint: disable=RULE[,RULE2] -- reason`` with
+  the reason MANDATORY (a disable without one is itself a finding that
+  cannot be suppressed or baselined);
+* a triaged ``baseline.json`` so pre-existing, justified findings don't
+  block while NEW findings exit non-zero — every entry carries a reason;
+* fingerprints hash (rule, path, enclosing scope, normalized source
+  line), NOT the line number, so unrelated edits above a finding don't
+  invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import hashlib
+import json
+import re
+import sys
+from pathlib import Path
+
+# scripts/analysis/engine.py -> parents[2] == repo root
+REPO_ROOT = Path(__file__).resolve().parents[2]
+DEFAULT_TARGETS = ("cometbft_tpu",)
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+# same-line suppression: "# bftlint: disable=RULE[,RULE] -- reason"
+_SUPPRESS_RE = re.compile(
+    r"#\s*bftlint:\s*disable=([A-Za-z0-9_,\s]+?)\s*(?:--\s*(\S.*))?$")
+
+# engine-level pseudo-rules (never suppressible, never baselined)
+BAD_SUPPRESSION = "BFT000"     # disable comment without a reason
+PARSE_ERROR = "BFT001"         # file does not parse
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    severity: str              # "high" | "medium"
+    path: str                  # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+    scope: str = ""            # enclosing Class.func qualname, "" = module
+    fingerprint: str = ""
+    baselined: bool = False
+    baseline_reason: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if not self.baselined:
+            d.pop("baseline_reason")
+        return d
+
+
+class FileContext:
+    """Everything a rule needs about one file, computed once."""
+
+    def __init__(self, rel: str, source: str, tree: ast.AST):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = _import_map(tree)
+        # parent links (ast nodes are single-parent in a parse tree)
+        self._parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parent[child] = node
+
+    # ------------------------------------------------------------ tree nav
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parent.get(node)
+
+    def ancestors(self, node: ast.AST):
+        cur = self._parent.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parent.get(cur)
+
+    def enclosing_stmt(self, node: ast.AST) -> ast.stmt | None:
+        """The statement a (possibly nested) expression belongs to."""
+        cur: ast.AST | None = node
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = self._parent.get(cur)
+        return cur
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing def/async-def/lambda (a scope boundary)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return anc
+        return None
+
+    def in_async_def(self, node: ast.AST) -> bool:
+        """True when the nearest function scope is ``async def`` —
+        nested sync defs and lambdas (thread/executor targets) are
+        sync contexts even inside a coroutine."""
+        return isinstance(self.enclosing_function(node),
+                          ast.AsyncFunctionDef)
+
+    def scope_qualname(self, node: ast.AST) -> str:
+        parts = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+# --------------------------------------------------------------- resolution
+
+def _import_map(tree: ast.AST) -> dict[str, str]:
+    """local name -> dotted origin ("t" -> "time", "mono" ->
+    "time.monotonic").  Relative imports keep their leading dots so
+    in-package modules never collide with stdlib names."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            mod = "." * node.level + (node.module or "")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{mod}.{a.name}" if mod \
+                    else a.name
+    return out
+
+
+def attr_chain(node: ast.expr) -> str | None:
+    """Textual dotted chain for Name/Attribute trees ("self._lock.acquire");
+    None when the root isn't a plain name (e.g. a call result)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(func: ast.expr, imports: dict[str, str]) -> str | None:
+    """Dotted origin of a call target through the file's import aliases:
+    ``m()`` after ``from time import monotonic as m`` -> "time.monotonic";
+    ``t.time()`` after ``import time as t`` -> "time.time".  None when
+    the root is a local object (``self.x.acquire``)."""
+    chain = attr_chain(func)
+    if chain is None:
+        return None
+    root, _, rest = chain.partition(".")
+    origin = imports.get(root)
+    if origin is None:
+        # builtins referenced bare (open, ...) resolve to themselves
+        return chain if not rest and root in {"open"} else None
+    return f"{origin}.{rest}" if rest else origin
+
+
+# ------------------------------------------------------------- suppressions
+
+class Suppressions:
+    """Per-file map of line -> (rules, reason) from bftlint comments.
+
+    Two placements: trailing on the offending line, or a comment-only
+    line directly ABOVE it (the comment then covers the next code
+    line — long reasons don't fit in 79 columns)."""
+
+    def __init__(self, lines: list[str], rel: str):
+        self.by_line: dict[int, set[str]] = {}
+        self.bad: list[Finding] = []
+        for i, text in enumerate(lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group(1).split(",")
+                     if r.strip()}
+            reason = (m.group(2) or "").strip()
+            if not reason:
+                self.bad.append(Finding(
+                    rule=BAD_SUPPRESSION, severity="high", path=rel,
+                    line=i, col=0, snippet=text.strip()[:160],
+                    message="bftlint disable without a reason — write "
+                            "'# bftlint: disable=RULE -- why'"))
+                continue
+            self.by_line.setdefault(i, set()).update(rules)
+            if text.lstrip().startswith("#"):
+                # comment-only line: cover the next code line
+                j = i + 1
+                while j <= len(lines) and \
+                        (not lines[j - 1].strip() or
+                         lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                if j <= len(lines):
+                    self.by_line.setdefault(j, set()).update(rules)
+
+    def covers(self, rule: str, *linenos: int) -> bool:
+        return any(rule in self.by_line.get(ln, ())
+                   for ln in linenos if ln)
+
+
+# ----------------------------------------------------------------- baseline
+
+def load_baseline(path: Path) -> dict[str, dict]:
+    """fingerprint -> entry.  Raises SystemExit(2) on malformed files or
+    entries missing a triage reason (the acceptance bar: every baselined
+    finding is a decision somebody wrote down)."""
+    if not path.exists():
+        return {}
+    try:
+        doc = json.loads(path.read_text())
+        entries = doc["entries"]
+    except (ValueError, KeyError, TypeError) as e:
+        raise SystemExit(f"bftlint: malformed baseline {path}: {e!r}")
+    out: dict[str, dict] = {}
+    for ent in entries:
+        fp = ent.get("fingerprint")
+        reason = (ent.get("reason") or "").strip()
+        if not fp or not reason:
+            raise SystemExit(
+                f"bftlint: baseline entry missing fingerprint/reason: "
+                f"{json.dumps(ent)[:200]}")
+        out[fp] = ent
+    return out
+
+
+def _fingerprint(rule: str, rel: str, scope: str, line_text: str,
+                 seen: dict[str, int]) -> str:
+    """Stable across line drift: hash of rule|path|scope|normalized
+    source line, with an occurrence counter for identical lines in the
+    same scope."""
+    norm = " ".join(line_text.split())
+    base = f"{rule}|{rel}|{scope}|{norm}"
+    n = seen.get(base, 0)
+    seen[base] = n + 1
+    if n:
+        base += f"|#{n}"
+    return hashlib.sha1(base.encode()).hexdigest()[:16]
+
+
+# ------------------------------------------------------------------- runner
+
+def iter_py_files(targets: list[Path]):
+    for t in targets:
+        if t.is_file() and t.suffix == ".py":
+            yield t
+        elif t.is_dir():
+            for p in sorted(t.rglob("*.py")):
+                if "__pycache__" not in p.parts:
+                    yield p
+
+
+def run_paths(targets: list[Path], root: Path,
+              rule_ids: set[str] | None = None) -> list[Finding]:
+    """All findings (suppressed ones dropped, baseline NOT applied)."""
+    from . import rules as rules_mod
+    active = [r for r in rules_mod.ALL_RULES
+              if rule_ids is None or r.id in rule_ids]
+    findings: list[Finding] = []
+    for path in iter_py_files(targets):
+        try:
+            rel = path.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = path.name
+        in_scope = [r for r in active if r.applies(rel)]
+        try:
+            source = path.read_text(encoding="utf-8")
+        except OSError as e:
+            findings.append(Finding(
+                rule=PARSE_ERROR, severity="high", path=rel, line=0,
+                col=0, message=f"unreadable: {e!r}"))
+            continue
+        sup = Suppressions(source.splitlines(), rel)
+        findings.extend(sup.bad)
+        if not in_scope:
+            continue
+        try:
+            tree = ast.parse(source)
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule=PARSE_ERROR, severity="high", path=rel,
+                line=e.lineno or 0, col=e.offset or 0,
+                message=f"syntax error: {e.msg}"))
+            continue
+        ctx = FileContext(rel, source, tree)
+        seen: dict[str, int] = {}
+        file_findings: list[Finding] = []
+        for rule in in_scope:
+            for f in rule.check(ctx):
+                # suppression honored anywhere across the flagged
+                # node's (expression-sized) line span
+                node_end = max(f.line, getattr(f, "_end_line", f.line))
+                if sup.covers(f.rule, *range(f.line, node_end + 1)):
+                    continue
+                f.snippet = f.snippet or ctx.line_text(f.line).strip()[:160]
+                file_findings.append(f)
+        # deterministic order, then fingerprint with occurrence counters
+        file_findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        for f in file_findings:
+            f.fingerprint = _fingerprint(
+                f.rule, f.path, f.scope, ctx.line_text(f.line), seen)
+        findings.extend(file_findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, dict]) -> list[str]:
+    """Mark baselined findings in place; return stale fingerprints
+    (baseline entries whose finding no longer exists — candidates for
+    pruning, reported but never fatal)."""
+    live = set()
+    for f in findings:
+        ent = baseline.get(f.fingerprint)
+        # engine pseudo-rules can never be baselined away
+        if ent is not None and f.rule not in (BAD_SUPPRESSION, PARSE_ERROR):
+            f.baselined = True
+            f.baseline_reason = ent.get("reason", "")
+            live.add(f.fingerprint)
+    return sorted(set(baseline) - live)
+
+
+# ---------------------------------------------------------------------- CLI
+
+def _write_json(path: str, findings: list[Finding], stale: list[str],
+                rule_ids: list[str]) -> None:
+    doc = {
+        "tool": "bftlint",
+        "version": 1,
+        "rules": rule_ids,
+        "summary": {
+            "total": len(findings),
+            "new": sum(1 for f in findings if not f.baselined),
+            "baselined": sum(1 for f in findings if f.baselined),
+            "stale_baseline_entries": len(stale),
+        },
+        "findings": [f.to_dict() for f in findings],
+        "stale_baseline_fingerprints": stale,
+    }
+    raw = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+    if path == "-":
+        sys.stdout.write(raw)
+    else:
+        Path(path).write_text(raw)
+
+
+def _merge_baseline(path: Path, findings: list[Finding], reason: str,
+                    prune_stale: bool) -> int:
+    baseline = load_baseline(path)
+    if prune_stale:
+        live = {f.fingerprint for f in findings}
+        baseline = {fp: e for fp, e in baseline.items() if fp in live}
+    added = 0
+    for f in findings:
+        if f.baselined or f.rule in (BAD_SUPPRESSION, PARSE_ERROR):
+            continue
+        baseline[f.fingerprint] = {
+            "fingerprint": f.fingerprint, "rule": f.rule, "path": f.path,
+            "line": f.line, "scope": f.scope, "snippet": f.snippet,
+            "reason": reason,
+        }
+        added += 1
+    doc = {"version": 1,
+           "entries": sorted(baseline.values(),
+                             key=lambda e: (e.get("path", ""),
+                                            e.get("rule", ""),
+                                            e.get("line", 0)))}
+    path.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    return added
+
+
+def main(argv: list[str] | None = None) -> int:
+    from . import rules as rules_mod
+    ap = argparse.ArgumentParser(
+        prog="python -m analysis",
+        description="bftlint: project-native AST rules for cometbft_tpu")
+    ap.add_argument("paths", nargs="*",
+                    help=f"files/dirs to scan (default: {DEFAULT_TARGETS}"
+                         " under the repo root)")
+    ap.add_argument("--rules", help="comma-separated rule ids to run")
+    ap.add_argument("--root", type=Path, default=REPO_ROOT,
+                    help="tree root rule scopes are resolved against")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding as new")
+    ap.add_argument("--json", dest="json_out", metavar="PATH",
+                    help="write the machine-readable report ('-' = stdout)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="merge current NEW findings into the baseline "
+                         "(requires --reason)")
+    ap.add_argument("--prune-stale", action="store_true",
+                    help="with --write-baseline: drop entries whose "
+                         "finding no longer exists")
+    ap.add_argument("--reason", default="",
+                    help="triage reason stored with --write-baseline")
+    ap.add_argument("--list-rules", action="store_true")
+    ns = ap.parse_args(argv)
+
+    known = {r.id: r for r in rules_mod.ALL_RULES}
+    if ns.list_rules:
+        for r in rules_mod.ALL_RULES:
+            print(f"{r.id}  [{r.severity:6s}]  {r.title}")
+            print(f"        scope: {', '.join(r.scopes)}")
+        return 0
+
+    rule_ids: set[str] | None = None
+    if ns.rules:
+        rule_ids = {r.strip().upper() for r in ns.rules.split(",")
+                    if r.strip()}
+        unknown = rule_ids - set(known)
+        if unknown:
+            print(f"bftlint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    root = ns.root.resolve()
+    targets = [Path(p) for p in ns.paths] if ns.paths else \
+        [root / t for t in DEFAULT_TARGETS]
+    missing = [t for t in targets if not t.exists()]
+    if missing:
+        print(f"bftlint: no such path: {missing[0]}", file=sys.stderr)
+        return 2
+
+    findings = run_paths(targets, root, rule_ids)
+
+    if ns.write_baseline:
+        if not ns.reason.strip():
+            print("bftlint: --write-baseline requires --reason",
+                  file=sys.stderr)
+            return 2
+        if ns.prune_stale and (rule_ids is not None or ns.paths):
+            print("bftlint: --prune-stale needs a full default run "
+                  "(--rules/path filters would prune live entries the "
+                  "filtered scan can't see)", file=sys.stderr)
+            return 2
+        apply_baseline(findings, load_baseline(ns.baseline))
+        n = _merge_baseline(ns.baseline, findings, ns.reason.strip(),
+                            ns.prune_stale)
+        print(f"bftlint: baselined {n} finding(s) -> {ns.baseline}")
+        return 0
+
+    baseline = {} if ns.no_baseline else load_baseline(ns.baseline)
+    if rule_ids is not None:
+        # a filtered run can only observe its own rules' findings —
+        # other rules' entries are out of scope, not stale
+        baseline = {fp: e for fp, e in baseline.items()
+                    if e.get("rule") in rule_ids}
+    if ns.paths:
+        # same for a partial-tree scan: entries outside the scanned
+        # paths are invisible here, not stale
+        scanned = []
+        for t in targets:
+            try:
+                scanned.append(t.resolve().relative_to(root).as_posix())
+            except ValueError:
+                pass
+        baseline = {fp: e for fp, e in baseline.items()
+                    if any(e.get("path", "") == s or
+                           e.get("path", "").startswith(s.rstrip("/") + "/")
+                           for s in scanned)}
+    stale = apply_baseline(findings, baseline)
+
+    ran = sorted(rule_ids) if rule_ids else [r.id for r in
+                                            rules_mod.ALL_RULES]
+    if ns.json_out:
+        _write_json(ns.json_out, findings, stale, ran)
+
+    new = [f for f in findings if not f.baselined]
+    if ns.json_out != "-":              # '-' means the report IS stdout
+        for f in new:
+            print(f"{f.location()}: {f.rule} [{f.severity}] {f.message}")
+            if f.snippet:
+                print(f"    {f.snippet}")
+        n_base = len(findings) - len(new)
+        tail = f"{len(new)} new finding(s), {n_base} baselined"
+        if stale:
+            tail += (f", {len(stale)} stale baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} (run "
+                     "--write-baseline --prune-stale --reason '...' to "
+                     "drop)")
+        print(f"bftlint: {tail}")
+    return 1 if new else 0

@@ -1,0 +1,492 @@
+"""The six bftlint rules — each encodes an invariant this repo already
+paid for in review cycles (the war stories live in
+docs/explanation/static-analysis.md).
+
+A rule is scope + a ``check(ctx) -> Iterator[Finding]`` over one
+:class:`~analysis.engine.FileContext`.  Scopes are repo-relative posix
+prefixes so the rules bind to the packages whose discipline they
+encode, not to the whole world.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .engine import Finding, FileContext, attr_chain, resolve_call
+
+
+def _mk(rule: "Rule", ctx: FileContext, node: ast.AST,
+        message: str) -> Finding:
+    f = Finding(rule=rule.id, severity=rule.severity, path=ctx.rel,
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                message=message, scope=ctx.scope_qualname(node))
+    end = getattr(node, "end_lineno", None)
+    if end:
+        f._end_line = end          # suppression honored on the last line
+    return f
+
+
+class Rule:
+    id = "RULE"
+    severity = "high"
+    title = ""
+    scopes: tuple[str, ...] = ()
+
+    def applies(self, rel: str) -> bool:
+        return any(rel == s or rel.startswith(s) for s in self.scopes)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------- CLK001
+
+class ClockSeam(Rule):
+    """Real-time reads/sleeps bypassing libs/clock in the clock-managed
+    packages.  Scope-aware replacement for the lint.sh grep: resolves
+    aliased imports (``from time import monotonic as m``) and catches
+    ``loop.time()`` — both invisible to the regex."""
+
+    id = "CLK001"
+    severity = "high"
+    title = "real-time call bypassing the libs/clock seam"
+    scopes = tuple(f"cometbft_tpu/{p}/" for p in (
+        "consensus", "p2p", "node", "mempool", "blocksync", "statesync"))
+
+    # COORDINATION clocks only.  time.perf_counter is deliberately NOT
+    # banned: it is the repo's duration-METRICS clock (histograms measure
+    # real CPU cost even under the virtual clock — the PR 5 flight-
+    # recorder discipline), while monotonic/time/sleep order events and
+    # so must virtualize.
+    BANNED = {
+        "time.monotonic", "time.monotonic_ns", "time.time", "time.time_ns",
+        "asyncio.sleep",
+    }
+    SEAM = {"monotonic": "clock.monotonic()", "monotonic_ns":
+            "clock.monotonic()", "time": "clock.walltime()", "time_ns":
+            "clock.walltime_ns()", "sleep": "clock.sleep()"}
+
+    def _seam_for(self, dotted: str) -> str:
+        return self.SEAM.get(dotted.rsplit(".", 1)[-1], "libs/clock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        # the import form itself: catches the function being passed
+        # around as a value, which call-site resolution can't see
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0 and \
+                    node.module in ("time", "asyncio"):
+                for a in node.names:
+                    dotted = f"{node.module}.{a.name}"
+                    if dotted in self.BANNED:
+                        yield _mk(self, ctx, node,
+                                  f"imports {dotted} directly — route "
+                                  f"through {self._seam_for(dotted)}")
+            elif isinstance(node, ast.Call):
+                dotted = resolve_call(node.func, ctx.imports)
+                if dotted in self.BANNED:
+                    yield _mk(self, ctx, node,
+                              f"{dotted}() bypasses the clock seam — use "
+                              f"{self._seam_for(dotted)}")
+                    continue
+                # loop.time(): an event-loop clock read is a real-time
+                # read unless the loop IS the virtual driver
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "time" and dotted is None:
+                    chain = attr_chain(node.func.value)
+                    is_loop_call = (
+                        isinstance(node.func.value, ast.Call) and
+                        resolve_call(node.func.value.func, ctx.imports)
+                        in ("asyncio.get_event_loop",
+                            "asyncio.get_running_loop"))
+                    if is_loop_call or (chain is not None and
+                                        chain.split(".")[-1].lower()
+                                        .endswith("loop")):
+                        yield _mk(self, ctx, node,
+                                  "loop.time() reads the event-loop "
+                                  "clock directly — use clock.monotonic()")
+
+
+# --------------------------------------------------------------------- LCK001
+
+class LockDiscipline(Rule):
+    """The PR 14 cancellation-wedge class: a manual ``.acquire()`` whose
+    release is not structurally guaranteed (try/finally or the
+    with-statement), and ``await`` while holding a SYNCHRONOUS lock
+    (blocks the event loop until the awaited thing completes — a
+    single-threaded deadlock waiting to happen)."""
+
+    id = "LCK001"
+    severity = "high"
+    title = "lock acquire without guaranteed release / await under sync lock"
+    scopes = ("cometbft_tpu/mempool/", "cometbft_tpu/p2p/",
+              "cometbft_tpu/crypto/")
+
+    # context-manager/lock-wrapper implementations acquire here and
+    # release in their paired exit — the pattern the rule steers TO
+    _CM_FUNCS = {"__aenter__", "__enter__", "__aexit__", "__exit__",
+                 "acquire", "_acquire", "release", "_release", "lock",
+                 "unlock"}
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                yield from self._check_acquire(ctx, node)
+            elif isinstance(node, ast.With):
+                yield from self._check_sync_with(ctx, node)
+
+    # ------------------------------------------------- acquire/finally
+
+    def _check_acquire(self, ctx: FileContext,
+                       call: ast.Call) -> Iterator[Finding]:
+        fn = ctx.enclosing_function(call)
+        if fn is not None and getattr(fn, "name", "") in self._CM_FUNCS:
+            return
+        owner = attr_chain(call.func.value)
+        stmt = ctx.enclosing_stmt(call)
+        if stmt is None or owner is None:
+            return
+        # non-blocking probe (acquire(blocking=False)) manages failure
+        # inline; the wedge class is the blocking form
+        for kw in call.keywords:
+            if kw.arg == "blocking" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is False:
+                return
+        if self._released_in_finally(ctx, stmt, owner):
+            return
+        yield _mk(self, ctx, call,
+                  f"{owner}.acquire() without a try/finally release — "
+                  "cancellation between acquire and release wedges every "
+                  "later waiter (use 'async with' or release in finally)")
+
+    def _released_in_finally(self, ctx: FileContext, stmt: ast.stmt,
+                             owner: str) -> bool:
+        # (a) acquire inside a try whose finally releases the same owner
+        for anc in ctx.ancestors(stmt):
+            if isinstance(anc, ast.Try) and \
+                    self._finally_releases(anc, owner):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+        # (b) the canonical form: acquire, then IMMEDIATELY a
+        # try/finally releasing it
+        parent = ctx.parent(stmt)
+        for field in ("body", "orelse", "finalbody", "handlers"):
+            block = getattr(parent, field, None)
+            if isinstance(block, list) and stmt in block:
+                i = block.index(stmt)
+                if i + 1 < len(block) and \
+                        isinstance(block[i + 1], ast.Try) and \
+                        self._finally_releases(block[i + 1], owner):
+                    return True
+        return False
+
+    @staticmethod
+    def _finally_releases(try_node: ast.Try, owner: str) -> bool:
+        for node in ast.walk(ast.Module(body=try_node.finalbody,
+                                        type_ignores=[])):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "release" and \
+                    attr_chain(node.func.value) == owner:
+                return True
+        return False
+
+    # ---------------------------------------------- await under sync with
+
+    def _check_sync_with(self, ctx: FileContext,
+                         node: ast.With) -> Iterator[Finding]:
+        if not any(self._lockish(item.context_expr)
+                   for item in node.items):
+            return
+        holder_fn = ctx.enclosing_function(node)
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Await) and \
+                    ctx.enclosing_function(inner) is holder_fn:
+                yield _mk(self, ctx, inner,
+                          "await while holding a synchronous lock — the "
+                          "held lock blocks every thread (and this "
+                          "coroutine's loop) until the await completes")
+                return  # one finding per with-block is enough signal
+
+    # word-ish boundaries: a bare substring test would match 'block',
+    # which in this codebase names half the world
+    _LOCK_NAME = re.compile(r"(^|_)(r|w)?(lock|mutex|mu)(_|$)")
+
+    @classmethod
+    def _lockish(cls, expr: ast.expr) -> bool:
+        chain = attr_chain(expr.func if isinstance(expr, ast.Call)
+                           else expr)
+        if chain is None:
+            return False
+        leaf = chain.split(".")[-1].lower().strip("_")
+        return cls._LOCK_NAME.search(leaf) is not None
+
+
+# --------------------------------------------------------------------- TSK001
+
+class TaskRetention(Rule):
+    """The PR 7 'Task was destroyed but it is pending' class: the event
+    loop holds only weak refs to tasks, so a spawn whose result is
+    dropped can be garbage-collected mid-flight and its exception is
+    never retrieved.  libs/aio.spawn is the blessed fire-and-forget."""
+
+    id = "TSK001"
+    severity = "high"
+    title = "asyncio task spawned without retention"
+    scopes = ("cometbft_tpu/",)
+
+    CREATORS = {"asyncio.create_task", "asyncio.ensure_future"}
+    _CREATE_ATTRS = {"create_task", "ensure_future"}
+
+    def _is_creator(self, node: ast.Call, ctx: FileContext) -> bool:
+        dotted = resolve_call(node.func, ctx.imports)
+        if dotted in self.CREATORS:
+            return True
+        # loop.create_task(...) / self._loop.create_task(...)
+        return (dotted is None and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr in self._CREATE_ATTRS)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    self._is_creator(node, ctx)):
+                continue
+            stmt = ctx.enclosing_stmt(node)
+            if isinstance(stmt, ast.Expr) and stmt.value is node:
+                yield _mk(self, ctx, node,
+                          "task result discarded — the loop keeps only a "
+                          "weak ref; use libs/aio.spawn (or retain + "
+                          "add_done_callback)")
+            elif isinstance(stmt, ast.Assign) and stmt.value is node and \
+                    len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name):
+                name = stmt.targets[0].id
+                if name == "_" or not self._used_later(ctx, stmt, name):
+                    yield _mk(self, ctx, node,
+                              f"task bound to '{name}' but never used — "
+                              "the reference dies with the scope; use "
+                              "libs/aio.spawn or retain it")
+
+    @staticmethod
+    def _used_later(ctx: FileContext, assign: ast.stmt,
+                    name: str) -> bool:
+        scope = ctx.enclosing_function(assign) or ctx.tree
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Name) and node.id == name and \
+                    isinstance(node.ctx, ast.Load):
+                return True
+        return False
+
+
+# --------------------------------------------------------------------- BLK001
+
+class BlockingInAsync(Rule):
+    """Event-loop stalls in the serving paths: the thread-encode
+    discipline PRs 9/12 kept re-fixing (multi-MB json.dumps freezes
+    /status for every client), plus sleeps, sync file IO, and hashing
+    loops inside ``async def``."""
+
+    id = "BLK001"
+    severity = "medium"
+    title = "blocking call on the event loop"
+    scopes = ("cometbft_tpu/rpc/", "cometbft_tpu/p2p/",
+              "cometbft_tpu/consensus/")
+
+    SLEEPS = {"time.sleep"}
+    CODECS = {"json.dumps", "json.loads", "json.dump", "json.load"}
+    HASHES = ("hashlib.",)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    ctx.in_async_def(node)):
+                continue
+            dotted = resolve_call(node.func, ctx.imports)
+            if dotted is None:
+                continue
+            if dotted in self.SLEEPS:
+                yield _mk(self, ctx, node,
+                          f"{dotted}() blocks the event loop — "
+                          "clock.sleep() (or to_thread for sync work)")
+            elif dotted in self.CODECS:
+                yield _mk(self, ctx, node,
+                          f"{dotted}() on the event loop — response-sized "
+                          "payloads freeze every connection; thread-encode "
+                          "via asyncio.to_thread (suppress with the "
+                          "payload-size argument if provably tiny)")
+            elif dotted == "open":
+                yield _mk(self, ctx, node,
+                          "sync file IO inside async def — use "
+                          "asyncio.to_thread for the read/write")
+            elif dotted.startswith(self.HASHES) and \
+                    self._in_loop(ctx, node):
+                yield _mk(self, ctx, node,
+                          f"{dotted}() in a loop inside async def — "
+                          "hashing loops starve the loop; batch on a "
+                          "worker thread")
+
+    @staticmethod
+    def _in_loop(ctx: FileContext, node: ast.AST) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return False
+        return False
+
+
+# --------------------------------------------------------------------- EXC001
+
+class FatalIoSwallow(Rule):
+    """The fsyncgate discipline (PRs 8/10): in the storage-critical
+    packages a broad ``except Exception/OSError`` that neither re-raises
+    nor routes through the fatal-IO machinery can swallow EIO/ENOSPC and
+    keep consensus running on a store that silently stopped persisting."""
+
+    id = "EXC001"
+    severity = "high"
+    title = "broad except swallows fatal IO errors"
+    scopes = ("cometbft_tpu/storage/", "cometbft_tpu/privval/",
+              "cometbft_tpu/consensus/wal.py")
+
+    BROAD = {"Exception", "BaseException", "OSError", "IOError"}
+    # the blessed escape hatches — routing or classifying the failure
+    ROUTERS = {"_io_failed", "_is_fatal_io_error"}
+
+    def _broad_names(self, type_node: ast.expr | None,
+                     imports: dict[str, str]) -> list[str]:
+        if type_node is None:
+            return ["bare except"]
+        exprs = type_node.elts if isinstance(type_node, ast.Tuple) \
+            else [type_node]
+        out = []
+        for e in exprs:
+            chain = attr_chain(e)
+            if chain is not None and chain.split(".")[-1] in self.BROAD:
+                out.append(chain)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = self._broad_names(node.type, ctx.imports)
+            if not broad:
+                continue
+            if self._body_routes(node):
+                continue
+            f = _mk(self, ctx, node,
+                    f"except {', '.join(broad)} neither re-raises nor "
+                    "routes through the fatal-IO classifier "
+                    "(_io_failed/_is_fatal_io_error) — an EIO here is "
+                    "silently swallowed")
+            # suppression is honored anywhere on the (possibly
+            # multi-line) except CLAUSE, not deep in the handler body
+            if node.type is not None and node.type.end_lineno:
+                f._end_line = node.type.end_lineno
+            else:
+                f._end_line = node.lineno
+            yield f
+
+    def _body_routes(self, handler: ast.ExceptHandler) -> bool:
+        return any(self._routes(n) for n in handler.body)
+
+    def _routes(self, node: ast.AST) -> bool:
+        # recursion that actually PRUNES nested function scopes —
+        # ast.walk can't: a `raise` inside a callback defined in the
+        # handler body runs later (if ever), it does not route THIS
+        # exception
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return False
+        if isinstance(node, ast.Raise):
+            return True
+        chain = None
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+        elif isinstance(node, ast.Name):
+            chain = node.id
+        if chain is not None and chain.split(".")[-1] in self.ROUTERS:
+            return True
+        return any(self._routes(c) for c in ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------------------- DET001
+
+class ReplayDeterminism(Rule):
+    """The PR 13 replay-identity discipline: the scenario lab promises
+    ``run_scenario(s) == run_scenario(s)`` byte-for-byte, so sim/ and
+    the consensus gossip/vote paths must draw randomness only from
+    seeded ``random.Random`` instances (keyed like libs/failures) and
+    time only from the clock seam — a global-RNG draw's sequence is a
+    function of coroutine interleaving, not of the seed."""
+
+    id = "DET001"
+    severity = "medium"
+    title = "unseeded randomness / real-time value on a replay path"
+    scopes = ("cometbft_tpu/sim/", "cometbft_tpu/consensus/")
+
+    GLOBAL_DRAWS = {
+        "random.random", "random.randint", "random.randrange",
+        "random.choice", "random.choices", "random.shuffle",
+        "random.sample", "random.uniform", "random.gauss",
+        "random.getrandbits", "random.triangular", "random.expovariate",
+        "random.normalvariate", "random.betavariate", "random.vonmisesvariate",
+    }
+    ENTROPY = {"os.urandom", "uuid.uuid4", "secrets.token_bytes",
+               "secrets.token_hex", "secrets.token_urlsafe",
+               "secrets.randbits", "secrets.choice", "secrets.randbelow"}
+    # real-time reads in sim/ (consensus/ is already CLK001 territory)
+    TIME = {"time.time", "time.time_ns", "time.monotonic",
+            "time.monotonic_ns", "time.perf_counter"}
+    # the virtual driver itself must touch the real loop/clock
+    _EXEMPT_FILES = ("cometbft_tpu/sim/vtime.py",)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        in_sim = ctx.rel.startswith("cometbft_tpu/sim/")
+        exempt_time = ctx.rel in self._EXEMPT_FILES
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = resolve_call(node.func, ctx.imports)
+            if dotted is None:
+                continue
+            if dotted in self.GLOBAL_DRAWS:
+                yield _mk(self, ctx, node,
+                          f"{dotted}() draws from the GLOBAL RNG — the "
+                          "sequence depends on scheduling interleaving, "
+                          "breaking replay identity; draw from a seeded "
+                          "random.Random keyed by (seed, site)")
+            elif dotted in self.ENTROPY:
+                yield _mk(self, ctx, node,
+                          f"{dotted}() is OS entropy — unreplayable; "
+                          "derive from the scenario seed")
+            elif in_sim and not exempt_time and dotted in self.TIME:
+                yield _mk(self, ctx, node,
+                          f"{dotted}() reads real time on a replay path "
+                          "— route through libs/clock")
+        # BitArray.pick_random() with no rng falls back to the module
+        # RNG — same class, hidden one call away (libs/bits.py)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "pick_random" and \
+                    not node.args and not node.keywords:
+                yield _mk(self, ctx, node,
+                          "pick_random() without an rng draws from the "
+                          "GLOBAL RNG — pass a seeded random.Random")
+
+
+ALL_RULES: tuple[Rule, ...] = (
+    ClockSeam(), LockDiscipline(), TaskRetention(),
+    BlockingInAsync(), FatalIoSwallow(), ReplayDeterminism(),
+)

@@ -29,20 +29,37 @@ fi
 # Clock-seam guard: the clock-managed packages must route every sleep /
 # monotonic read through libs/clock (a direct call reads REAL time under
 # the scenario lab's virtual clock — a determinism bug, the exact class
-# PR 15 flushed out).  Legit exceptions carry a `clock-exempt` marker on
-# the same line.  libs/ itself (the seam + the virtual driver) and sim/
-# are out of scope.
-CLOCK_PKGS=(cometbft_tpu/consensus cometbft_tpu/p2p cometbft_tpu/node
-            cometbft_tpu/mempool cometbft_tpu/blocksync
-            cometbft_tpu/statesync)
-hits=$(grep -rnE 'asyncio\.sleep\(|time\.monotonic\(|time\.time\(|time\.time_ns\(' \
-        "${CLOCK_PKGS[@]}" \
-        --include='*.py' | grep -v 'clock-exempt' || true)
-if [ -n "$hits" ]; then
-    echo "[lint] direct real-time calls in clock-managed packages" \
-         "(route through libs/clock or mark clock-exempt):"
-    echo "$hits"
-    rc=1
+# PR 15 flushed out).  Enforced by bftlint's CLK001 (scripts/analysis):
+# scope-aware, resolves aliased imports (`from time import monotonic as
+# m`) and flags `loop.time()` — both invisible to the old regex.  Legit
+# exceptions carry `# bftlint: disable=CLK001 -- reason` on (or directly
+# above) the line.  The grep remains ONLY as a degraded fallback for
+# environments whose python can't run the engine.
+if python -c 'import analysis' >/dev/null 2>&1 || \
+        (cd scripts && python -c 'import analysis' >/dev/null 2>&1); then
+    echo "[lint] bftlint CLK001 (clock-seam, AST)"
+    (cd scripts && python -m analysis --rules CLK001) || rc=1
+else
+    echo "[lint] bftlint unavailable; regex clock-seam fallback"
+    CLOCK_PKGS=(cometbft_tpu/consensus cometbft_tpu/p2p cometbft_tpu/node
+                cometbft_tpu/mempool cometbft_tpu/blocksync
+                cometbft_tpu/statesync)
+    # awk instead of grep -v: the suppression grammar also allows the
+    # marker on a comment-only line directly ABOVE the call
+    hits=$(find "${CLOCK_PKGS[@]}" -name '*.py' -exec awk '
+        FNR == 1 { prev = "" }
+        /asyncio\.sleep\(|time\.monotonic\(|time\.time\(|time\.time_ns\(/ {
+            if (index($0, "bftlint: disable=CLK001") == 0 &&
+                index(prev, "bftlint: disable=CLK001") == 0)
+                print FILENAME ":" FNR ":" $0
+        }
+        { prev = $0 }' {} + 2>/dev/null || true)
+    if [ -n "$hits" ]; then
+        echo "[lint] direct real-time calls in clock-managed packages" \
+             "(route through libs/clock or bftlint: disable=CLK001):"
+        echo "$hits"
+        rc=1
+    fi
 fi
 
 if [ "$rc" -ne 0 ]; then

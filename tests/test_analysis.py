@@ -1,0 +1,536 @@
+"""bftlint (scripts/analysis) — the rule engine that machine-checks the
+repo's concurrency/determinism invariants.
+
+Fixture snippets per rule: a positive hit, a suppressed hit, a
+baseline'd hit, and the CLK001 aliased-import case the retired lint.sh
+regex provably missed.  Each rule's positive fixture doubles as the
+"fails if the rule is deleted" guard from the acceptance criteria."""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO / "scripts"))
+
+from analysis import engine  # noqa: E402
+from analysis import rules as rules_mod  # noqa: E402
+from analysis.engine import main as cli_main  # noqa: E402
+
+
+def _scan(tree: dict[str, str], root: Path,
+          rule_ids: set[str] | None = None):
+    """Write a fixture tree under ``root`` and run the engine on it."""
+    for rel, src in tree.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return engine.run_paths([root], root, rule_ids)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --------------------------------------------------------------- rule: CLK001
+
+def test_clk001_positive_direct_call(tmp_path):
+    fs = _scan({"cometbft_tpu/consensus/fx.py": """
+        import time
+
+        def age():
+            return time.monotonic()
+    """}, tmp_path)
+    assert _rules_of(fs) == ["CLK001"]
+
+
+def test_clk001_aliased_import_the_grep_missed(tmp_path):
+    """``from time import monotonic as mono`` + ``mono()``: the retired
+    lint.sh regex (kept verbatim here) finds NOTHING, the AST rule finds
+    both the import and the call."""
+    src = textwrap.dedent("""
+        from time import monotonic as mono
+
+        def age():
+            return mono()
+    """)
+    grep = re.compile(
+        r"asyncio\.sleep\(|time\.monotonic\(|time\.time\(|time\.time_ns\(")
+    assert not any(grep.search(line) for line in src.splitlines()), \
+        "fixture must be invisible to the old regex"
+    fs = _scan({"cometbft_tpu/p2p/fx.py": src}, tmp_path)
+    assert _rules_of(fs) == ["CLK001", "CLK001"]
+    assert any("imports time.monotonic" in f.message for f in fs)
+
+
+def test_clk001_loop_time_and_scope(tmp_path):
+    fs = _scan({
+        # loop.time() — also invisible to the regex
+        "cometbft_tpu/mempool/fx.py": """
+            import asyncio
+
+            async def due():
+                loop = asyncio.get_running_loop()
+                return loop.time() + 1.0
+        """,
+        # crypto/ is NOT clock-managed: same call, no finding
+        "cometbft_tpu/crypto/fx.py": """
+            import time
+
+            def bench():
+                return time.monotonic()
+        """,
+        # the metrics clock is deliberately allowed
+        "cometbft_tpu/node/fx.py": """
+            import time
+
+            def observe():
+                return time.perf_counter()
+        """}, tmp_path)
+    assert _rules_of(fs) == ["CLK001"]
+    assert fs[0].path == "cometbft_tpu/mempool/fx.py"
+    assert "loop.time()" in fs[0].message
+
+
+def test_clk001_suppressed_with_reason(tmp_path):
+    fs = _scan({"cometbft_tpu/node/fx.py": """
+        import time
+
+        def boot_stamp():
+            return time.time()  # bftlint: disable=CLK001 -- one-shot boot stamp, never compared across virtual time
+    """}, tmp_path)
+    assert fs == []
+
+
+# --------------------------------------------------------------- rule: LCK001
+
+def test_lck001_acquire_without_finally(tmp_path):
+    fs = _scan({"cometbft_tpu/mempool/fx.py": """
+        async def bad(self):
+            await self._gate.acquire()
+            self.n += 1
+            self._gate.release()
+    """}, tmp_path)
+    assert _rules_of(fs) == ["LCK001"]
+    assert "try/finally" in fs[0].message
+
+
+def test_lck001_blessed_forms_pass(tmp_path):
+    fs = _scan({"cometbft_tpu/mempool/fx.py": """
+        async def ok_with(self):
+            async with self._lock:
+                self.n += 1
+
+        async def ok_finally(self):
+            await self._gate.acquire()
+            try:
+                self.n += 1
+            finally:
+                self._gate.release()
+
+        async def ok_inside_try(self):
+            try:
+                await self._gate.acquire()
+                self.n += 1
+            finally:
+                self._gate.release()
+
+        def ok_probe(self):
+            return self._mu.acquire(blocking=False)
+
+        class Ctx:
+            async def __aenter__(self):
+                await self._lock.acquire()
+                return self
+    """}, tmp_path)
+    assert fs == []
+
+
+def test_lck001_await_under_sync_lock(tmp_path):
+    fs = _scan({"cometbft_tpu/p2p/fx.py": """
+        async def bad(self):
+            with self._lock:
+                await self.flush()
+    """}, tmp_path)
+    assert _rules_of(fs) == ["LCK001"]
+    assert "synchronous lock" in fs[0].message
+
+
+def test_lck001_lockish_needs_word_boundary(tmp_path):
+    """'block' contains 'lock': block-named context managers must not
+    read as sync locks, while lock-spelled names still do."""
+    fs = _scan({"cometbft_tpu/mempool/fx.py": """
+        async def ok(self):
+            with self.open_block():
+                await self.flush()
+
+        async def bad(self):
+            with self._wlock:
+                await self.flush()
+    """}, tmp_path)
+    assert _rules_of(fs) == ["LCK001"]
+    assert fs[0].scope == "bad"
+
+
+# --------------------------------------------------------------- rule: TSK001
+
+def test_tsk001_discarded_and_unused(tmp_path):
+    fs = _scan({"cometbft_tpu/p2p/fx.py": """
+        import asyncio
+
+        def bad_discard(self):
+            asyncio.create_task(self._run())
+
+        def bad_unused(self):
+            t = asyncio.ensure_future(self._run())
+            return None
+    """}, tmp_path)
+    assert _rules_of(fs) == ["TSK001", "TSK001"]
+
+
+def test_tsk001_retained_forms_pass(tmp_path):
+    fs = _scan({"cometbft_tpu/p2p/fx.py": """
+        import asyncio
+
+        from ..libs import aio
+
+        def ok(self):
+            self._task = asyncio.create_task(self._run())
+            self._tasks = [asyncio.create_task(self._recv())]
+            t = asyncio.create_task(self._ping())
+            t.add_done_callback(self._done)
+            aio.spawn(self._sweep())
+    """}, tmp_path)
+    assert fs == []
+
+
+# --------------------------------------------------------------- rule: BLK001
+
+def test_blk001_blocking_calls_in_async(tmp_path):
+    fs = _scan({"cometbft_tpu/rpc/fx.py": """
+        import json
+        import time
+
+        async def bad(self, resp):
+            time.sleep(0.1)
+            return json.dumps(resp)
+    """}, tmp_path)
+    assert sorted(_rules_of(fs)) == ["BLK001", "BLK001"]
+
+
+def test_blk001_sync_and_threaded_pass(tmp_path):
+    fs = _scan({"cometbft_tpu/rpc/fx.py": """
+        import asyncio
+        import json
+
+        def sync_helper(resp):          # sync def: caller's problem
+            return json.dumps(resp)
+
+        async def ok(self, resp):
+            # passing the function is not calling it
+            return await asyncio.to_thread(json.dumps, resp)
+    """}, tmp_path)
+    assert fs == []
+
+
+def test_blk001_hashlib_only_in_loops(tmp_path):
+    fs = _scan({"cometbft_tpu/p2p/fx.py": """
+        import hashlib
+
+        async def ok_single(self, b):
+            return hashlib.sha256(b).digest()
+
+        async def bad_loop(self, items):
+            return [hashlib.sha256(i).digest() for i in items][0]
+    """}, tmp_path)
+    # a comprehension is not a For statement — the rule flags explicit
+    # loop statements, where the N-times cost is structural
+    fs2 = _scan({"cometbft_tpu/p2p/fx2.py": """
+        import hashlib
+
+        async def bad_loop(self, items):
+            out = []
+            for i in items:
+                out.append(hashlib.sha256(i).digest())
+            return out
+    """}, tmp_path)
+    assert _rules_of(fs) == []
+    assert _rules_of(fs2) == ["BLK001"]
+
+
+# --------------------------------------------------------------- rule: EXC001
+
+def test_exc001_swallow_vs_routing(tmp_path):
+    fs = _scan({"cometbft_tpu/storage/fx.py": """
+        def bad(self):
+            try:
+                self._f.flush()
+            except OSError:
+                pass
+
+        def ok_reraise(self):
+            try:
+                self._f.flush()
+            except OSError:
+                self._dead = True
+                raise
+
+        def ok_routed(self, e=None):
+            try:
+                self._f.flush()
+            except Exception as e:
+                self._io_failed(e)
+    """}, tmp_path)
+    assert _rules_of(fs) == ["EXC001"]
+    assert fs[0].scope == "bad"
+
+
+def test_exc001_nested_def_raise_does_not_route(tmp_path):
+    """A raise inside a callback DEFINED in the handler body runs later
+    (if ever) — it must not count as routing this exception."""
+    fs = _scan({"cometbft_tpu/storage/fx.py": """
+        def bad(self):
+            try:
+                self._f.flush()
+            except OSError:
+                def cb():
+                    raise RuntimeError("later")
+                self._register(cb)
+    """}, tmp_path)
+    assert _rules_of(fs) == ["EXC001"]
+
+
+def test_exc001_narrow_except_passes(tmp_path):
+    fs = _scan({"cometbft_tpu/privval/fx.py": """
+        def ok(self):
+            try:
+                return self._decode()
+            except (ValueError, KeyError):
+                return None
+    """}, tmp_path)
+    assert fs == []
+
+
+def test_exc001_multiline_clause_suppression(tmp_path):
+    fs = _scan({"cometbft_tpu/privval/fx.py": """
+        def ok(self):
+            try:
+                return self._roundtrip()
+            except (ConnectionError,
+                    OSError):  # bftlint: disable=EXC001 -- retry discipline, the retry re-raises
+                return self._retry()
+    """}, tmp_path)
+    assert fs == []
+
+
+# --------------------------------------------------------------- rule: DET001
+
+def test_det001_global_rng_and_pick_random(tmp_path):
+    fs = _scan({"cometbft_tpu/consensus/fx.py": """
+        import random
+
+        def bad_jitter():
+            return 0.8 + 0.4 * random.random()
+
+        def bad_pick(want):
+            return want.pick_random()
+
+        def ok_seeded(want, rng):
+            r = random.Random("gossip:n0:peer1")
+            return want.pick_random(rng), r.random()
+    """}, tmp_path, {"DET001"})
+    assert _rules_of(fs) == ["DET001", "DET001"]
+    assert "GLOBAL RNG" in fs[0].message
+
+
+def test_det001_sim_time_and_entropy(tmp_path):
+    fs = _scan({"cometbft_tpu/sim/fx.py": """
+        import os
+        import time
+
+        def bad():
+            return os.urandom(8), time.monotonic()
+    """}, tmp_path, {"DET001"})
+    assert sorted(f.message.split("(")[0].split()[0] for f in fs) == \
+        ["os.urandom", "time.monotonic"]
+
+
+# ------------------------------------------------------- suppression grammar
+
+def test_suppression_requires_reason(tmp_path):
+    fs = _scan({"cometbft_tpu/node/fx.py": """
+        import time
+
+        def bad():
+            return time.time()  # bftlint: disable=CLK001
+    """}, tmp_path)
+    # the disable is rejected AND the finding it failed to cover stays
+    assert sorted(_rules_of(fs)) == [engine.BAD_SUPPRESSION, "CLK001"]
+
+
+def test_suppression_own_line_covers_next_code_line(tmp_path):
+    fs = _scan({"cometbft_tpu/node/fx.py": """
+        import time
+
+        def ok():
+            # bftlint: disable=CLK001 -- long reasons go on their own line
+            return time.time()
+    """}, tmp_path)
+    assert fs == []
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    fs = _scan({"cometbft_tpu/node/fx.py": """
+        import time
+
+        def still_bad():
+            return time.time()  # bftlint: disable=TSK001 -- wrong rule on purpose
+    """}, tmp_path)
+    assert _rules_of(fs) == ["CLK001"]
+
+
+# ------------------------------------------------------------------ baseline
+
+def _write_fixture(root: Path, src: str,
+                   rel="cometbft_tpu/consensus/fx.py") -> Path:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return p
+
+
+def test_baselined_hit_passes_new_finding_fails(tmp_path, capsys):
+    src = """
+        import time
+
+        def age():
+            return time.monotonic()
+    """
+    _write_fixture(tmp_path, src)
+    bl = tmp_path / "baseline.json"
+
+    # triage the pre-existing finding into the baseline
+    rc = cli_main([str(tmp_path / "cometbft_tpu"), "--root", str(tmp_path),
+                   "--baseline", str(bl), "--write-baseline",
+                   "--reason", "pre-existing; tracked in fixture triage"])
+    assert rc == 0
+    # baselined -> exit 0
+    rc = cli_main([str(tmp_path / "cometbft_tpu"), "--root", str(tmp_path),
+                   "--baseline", str(bl)])
+    assert rc == 0
+
+    # a NEW finding in the same file still fails
+    _write_fixture(tmp_path, src + """
+        def age2():
+            return time.monotonic()
+    """)
+    rc = cli_main([str(tmp_path / "cometbft_tpu"), "--root", str(tmp_path),
+                   "--baseline", str(bl)])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "age2" in out or "1 new finding" in out
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    _write_fixture(tmp_path, """
+        import time
+
+        def age():
+            return time.monotonic()
+    """)
+    bl = tmp_path / "baseline.json"
+    assert cli_main([str(tmp_path / "cometbft_tpu"), "--root",
+                     str(tmp_path), "--baseline", str(bl),
+                     "--write-baseline", "--reason", "triaged"]) == 0
+    # shift the finding 3 lines down: fingerprint (rule|path|scope|line
+    # text) is unchanged, so the entry still matches
+    _write_fixture(tmp_path, """
+        import time
+
+        # a
+        # b
+        # c
+        def age():
+            return time.monotonic()
+    """)
+    assert cli_main([str(tmp_path / "cometbft_tpu"), "--root",
+                     str(tmp_path), "--baseline", str(bl)]) == 0
+
+
+def test_baseline_entry_requires_reason(tmp_path):
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"version": 1, "entries": [{"fingerprint": "cafe", "reason": ""}]}))
+    with pytest.raises(SystemExit):
+        engine.load_baseline(bl)
+
+
+# ----------------------------------------------------------------------- CLI
+
+def test_cli_rules_filter_and_json_report(tmp_path):
+    _write_fixture(tmp_path, """
+        import time
+        import asyncio
+
+        def age():
+            return time.monotonic()
+
+        def fire(self):
+            asyncio.create_task(self._run())
+    """)
+    report = tmp_path / "report.json"
+    rc = cli_main([str(tmp_path / "cometbft_tpu"), "--root", str(tmp_path),
+                   "--no-baseline", "--rules", "TSK001",
+                   "--json", str(report)])
+    assert rc == 1
+    doc = json.loads(report.read_text())
+    assert doc["tool"] == "bftlint"
+    assert [f["rule"] for f in doc["findings"]] == ["TSK001"]
+    assert doc["summary"]["new"] == 1
+    f = doc["findings"][0]
+    assert f["fingerprint"] and f["path"].endswith("fx.py") and f["line"]
+
+
+def test_cli_unknown_rule_is_usage_error(tmp_path):
+    assert cli_main(["--rules", "NOPE42"]) == 2
+
+
+def test_cli_prune_stale_refuses_filtered_runs(tmp_path):
+    """A --rules or path-filtered scan can't see the whole tree, so
+    pruning from it would delete live out-of-scope entries."""
+    _write_fixture(tmp_path, "x = 1\n")
+    args = ["--baseline", str(tmp_path / "b.json"), "--write-baseline",
+            "--prune-stale", "--reason", "x"]
+    assert cli_main(["--rules", "CLK001"] + args) == 2
+    assert cli_main([str(tmp_path / "cometbft_tpu"), "--root",
+                     str(tmp_path)] + args) == 2
+
+
+def test_every_shipped_rule_exists_and_has_scope():
+    ids = {r.id for r in rules_mod.ALL_RULES}
+    # deleting any of the six invariants from the engine fails here
+    assert {"CLK001", "LCK001", "TSK001",
+            "BLK001", "EXC001", "DET001"} <= ids
+    for r in rules_mod.ALL_RULES:
+        assert r.scopes and r.severity in ("high", "medium") and r.title
+
+
+# ----------------------------------------------------------- the real tree
+
+def test_repo_tree_is_clean_under_the_shipped_baseline():
+    """The acceptance bar: ``python -m analysis`` exits 0 on the full
+    tree — every finding either fixed, suppressed-with-reason, or
+    triaged into baseline.json."""
+    assert cli_main([]) == 0
+
+
+def test_shipped_baseline_entries_all_carry_reasons():
+    bl = engine.load_baseline(engine.DEFAULT_BASELINE)
+    for ent in bl.values():
+        assert ent["reason"].strip()
