@@ -1354,6 +1354,223 @@ def _child_mempool(out_path: str) -> None:
     }), flush=True)
 
 
+def _child_statesync(out_path: str) -> None:
+    """``--mode statesync``: the r18 snapshot fabric — three
+    measurements, one JSON:
+
+    - **serving**: chunks/s served through the reactor's byte-budgeted
+      LRU + admission gate (cold pass loads from the app, warm passes
+      hit RAM) and the warm cache hit ratio.
+    - **bootstrap**: restore wall-clock over per-peer-bandwidth-limited
+      serving peers, 1 peer vs 4 peers — multi-peer round-robin fetch
+      must turn peer count into bandwidth (the ±-free speedup is the
+      acceptance bar).
+    - **fleet**: the 50-node scenario-lab program (40 concurrent
+      bootstrappers, 4 seeds, gray failures + a byzantine seed serving
+      corrupt chunks) run TWICE: verdicts must be byte-identical
+      (replay contract), every bootstrapper must complete, the byzantine
+      seed must be banned by all, and restore resets must be zero.
+    """
+    from cometbft_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+    import asyncio
+    from types import SimpleNamespace
+
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.sim.statesync_lab import (curated_statesync_scenario,
+                                                run_statesync_scenario)
+    from cometbft_tpu.statesync.reactor import StatesyncReactor
+    from cometbft_tpu.statesync.syncer import Syncer
+
+    def note(msg):
+        print(f"[bench:statesync] {msg}", file=sys.stderr, flush=True)
+
+    n_serves = int(os.environ.get("BENCH_SS_SERVES", "3000"))
+    n_chunks = int(os.environ.get("BENCH_SS_CHUNKS", "64"))
+    serve_delay = 0.005        # per-chunk service time per peer
+
+    async def serving_leg() -> dict:
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        # ~1.5 MB of state -> ~24 chunks of 64 KiB
+        await client.finalize_block(abci_t.FinalizeBlockRequest(
+            txs=[b"bk%02d=" % i + b"v" * 32768 for i in range(48)],
+            height=1, time_ns=0))
+        await client.commit()
+        snaps = await client.list_snapshots()
+        snap = snaps[-1]
+        reactor = StatesyncReactor(SimpleNamespace(snapshot=client),
+                                   name="bench.ss")
+        sink = SimpleNamespace(id="bench-peer",
+                               send=lambda chan, msg: True)
+        # cold pass (loads + fills the LRU), then the timed warm passes
+        for i in range(snap.chunks):
+            await reactor._serve_chunk(sink, {"h": snap.height,
+                                              "f": snap.format, "i": i})
+        t0 = time.perf_counter()
+        for k in range(n_serves):
+            i = k % snap.chunks
+            await reactor._serve_chunk(sink, {"h": snap.height,
+                                              "f": snap.format, "i": i})
+        dt = time.perf_counter() - t0
+        served = n_serves
+        return {
+            "snapshot_chunks": snap.chunks,
+            "serves": served,
+            "chunks_per_s": round(served / dt, 1),
+            "warm_hit_ratio": round(served / (served + snap.chunks), 4),
+            "cache_bytes": reactor._cache.bytes,
+        }
+
+    class _SerialPeerReactor:
+        """Each peer is a serial worker: one chunk every serve_delay —
+        aggregate throughput is proportional to peer count only if the
+        fetcher spreads requests (same harness shape as
+        tests/test_statesync.py)."""
+
+        def __init__(self, box):
+            self.box = box
+            self.queues: dict[str, asyncio.Queue] = {}
+            self.workers: list = []
+
+        def request_chunk(self, peer, height, format_, index, h):
+            if peer not in self.queues:
+                self.queues[peer] = asyncio.Queue()
+                self.workers.append(asyncio.get_event_loop().create_task(
+                    self._serve(peer)))
+            self.queues[peer].put_nowait((height, format_, index, h))
+
+        async def _serve(self, peer):
+            while True:
+                height, format_, index, h = await self.queues[peer].get()
+                await asyncio.sleep(serve_delay)
+                self.box[0].add_chunk(peer, height, format_, index,
+                                      b"DATA-%d" % index, h)
+
+    async def bootstrap_leg(n_peers: int) -> float:
+        class SnapConn:
+            async def offer_snapshot(self, snapshot, app_hash):
+                return abci_t.OFFER_SNAPSHOT_ACCEPT
+
+            async def apply_snapshot_chunk(self, index, chunk, sender):
+                return abci_t.APPLY_CHUNK_ACCEPT
+
+        class QueryConn:
+            async def info(self):
+                return abci_t.InfoResponse(last_block_height=7,
+                                           last_block_app_hash=b"\xab" *
+                                           32)
+
+        class Provider:
+            async def app_hash(self, h):
+                return b"\xab" * 32
+
+            async def state(self, h):
+                return "S"
+
+            async def commit(self, h):
+                return "C"
+
+        conns = SimpleNamespace(snapshot=SnapConn(), query=QueryConn())
+        box = [None]
+        reactor = _SerialPeerReactor(box)
+        syncer = Syncer(conns, Provider(), reactor=reactor,
+                        in_memory_spool=True)
+        box[0] = syncer
+        snapshot = abci_t.Snapshot(height=7, format=1, chunks=n_chunks,
+                                   hash=b"\xcd" * 32, metadata=b"")
+        for k in range(n_peers):
+            syncer.add_snapshot(f"peer{k}", snapshot)
+        t0 = time.perf_counter()
+        await syncer._restore(syncer._snapshots[(7, 1, b"\xcd" * 32)])
+        dt = time.perf_counter() - t0
+        for w in reactor.workers:
+            w.cancel()
+        syncer._pool.close()
+        return dt
+
+    async def drive() -> dict:
+        serving = await serving_leg()
+        note(f"serving: {serving['chunks_per_s']} chunks/s warm "
+             f"({serving['snapshot_chunks']}-chunk snapshot)")
+        t1 = await bootstrap_leg(1)
+        t4 = await bootstrap_leg(4)
+        note(f"bootstrap {n_chunks} chunks: 1 peer {t1:.2f}s, "
+             f"4 peers {t4:.2f}s ({t1 / t4:.2f}x)")
+        return {"serving": serving,
+                "bootstrap": {
+                    "n_chunks": n_chunks,
+                    "serve_delay_s": serve_delay,
+                    "single_peer_s": round(t1, 3),
+                    "multi_peer_s": round(t4, 3),
+                    "multi_peer_speedup": round(t1 / t4, 2)}}
+
+    loop = asyncio.new_event_loop()
+    try:
+        doc = loop.run_until_complete(drive())
+    finally:
+        loop.close()
+
+    failures_: list[str] = []
+    scn = curated_statesync_scenario()
+    note(f"fleet: {scn.n_bootstrappers} bootstrappers / "
+         f"{scn.n_seeds} seeds / byzantine {scn.byzantine_seeds}")
+    t0 = time.perf_counter()
+    v1 = run_statesync_scenario(scn)
+    fleet_real = time.perf_counter() - t0
+    v2 = run_statesync_scenario(scn)
+    if json.dumps(v1, sort_keys=True) != json.dumps(v2, sort_keys=True):
+        failures_.append("fleet scenario: replay diverged")
+    if v1["completed"] != scn.n_bootstrappers:
+        failures_.append(f"fleet scenario: only {v1['completed']} of "
+                         f"{scn.n_bootstrappers} completed")
+    if v1["syncer_tallies"].get("restore_resets", 0) != 0:
+        failures_.append("fleet scenario: corrupt chunk caused a "
+                         "restore reset")
+    if len(v1["byzantine_banned_by"]) < scn.n_bootstrappers:
+        failures_.append("fleet scenario: byzantine seed not banned "
+                         "by the whole fleet")
+    v1["real_s"] = round(fleet_real, 1)
+    doc["fleet"] = v1
+    doc["failures"] = failures_
+    dist = {k: x for k, x in v1["time_to_serving_height_s"].items()
+            if k != "all"}
+    replay_ok = "fleet scenario: replay diverged" not in failures_
+    note(f"fleet: completed={v1['completed']} dist={dist} "
+         f"replay_ok={replay_ok}")
+
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        note(f"results -> {out_path}")
+    print(json.dumps({
+        "metric": "statesync fabric: chunks/s served warm through the "
+                  "serving LRU (vs_baseline = 4-peer bootstrap speedup "
+                  "over 1 peer; fleet scenario replay-identical, "
+                  "reset-free, byzantine seed banned)",
+        "value": doc["serving"]["chunks_per_s"],
+        "unit": "chunks/s",
+        "vs_baseline": 0.0 if failures_ else
+        doc["bootstrap"]["multi_peer_speedup"],
+        "multi_peer_speedup": doc["bootstrap"]["multi_peer_speedup"],
+        "warm_hit_ratio": doc["serving"]["warm_hit_ratio"],
+        "fleet_completed": v1["completed"],
+        "fleet_time_to_serving_p50_s":
+        v1["time_to_serving_height_s"]["p50"],
+        "fleet_time_to_serving_max_s":
+        v1["time_to_serving_height_s"]["max"],
+        "failures": failures_,
+        "backend": "cpu",
+    }), flush=True)
+    if failures_:
+        raise SystemExit(1)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
     if mode == "mempool":
@@ -1366,6 +1583,11 @@ def _child_main(backend: str, nsig: int) -> None:
             os.environ.get("BENCH_OUT",
                            os.path.join(REPO, "docs", "bench",
                                         "r16-scenarios-cpu.json")))
+    if mode == "statesync":
+        return _child_statesync(
+            os.environ.get("BENCH_OUT",
+                           os.path.join(REPO, "docs", "bench",
+                                        "r18-statesync-cpu.json")))
     if mode == "node":
         return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
                            float(os.environ.get("BENCH_DURATION", "20")),
@@ -1597,7 +1819,8 @@ def main() -> None:
     platforms = os.environ.get("JAX_PLATFORMS", "")
     want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
     if os.environ.get("BENCH_MODE") in ("node", "light-serve",
-                                        "scenarios", "mempool"):
+                                        "scenarios", "mempool",
+                                        "statesync"):
         # these children hard-force CPU (full-stack measurements whose
         # bottleneck is the node, not a device leg): skip the
         # accelerator probe and the redundant tpu-labeled attempt
@@ -1697,6 +1920,8 @@ def main() -> None:
         "scenarios": ("scenario lab: adversarial virtual-seconds "
                       "simulated per real second", "virtual-s/s"),
         "mempool": ("mempool admission+recheck throughput", "tx/s"),
+        "statesync": ("statesync fabric: warm chunks/s served",
+                      "chunks/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

@@ -226,6 +226,34 @@ class StateSyncConfig:
     trust_hash: str = ""
     trust_period: int = 168 * 3600 * NS
     rpc_servers: list[str] = field(default_factory=list)
+    # --- snapshot fabric: fetch discipline (statesync/syncer.py) ------
+    # a chunk request with no progress for this long fails the restore
+    # attempt; individual requests are re-issued to another peer after
+    # half of it
+    chunk_timeout_s: float = 10.0
+    # outstanding chunk requests per serving peer — restore bandwidth
+    # scales with peer count while no single peer is ever flooded
+    max_inflight_per_peer: int = 4
+    # how long one discovery broadcast collects snapshot offers, and how
+    # many discover-pick-restore rounds run before the sync gives up
+    discovery_time_s: float = 0.5
+    discovery_rounds: int = 5
+    # per-chunk refetch budget before the snapshot attempt is abandoned
+    chunk_retries: int = 3
+    # byte budget for retained spool blobs: the window over which a
+    # failed/retried restore resumes instead of re-fetching (chunks are
+    # content-addressed, so identical chunks across heights/formats/
+    # attempts never transfer twice)
+    spool_retain_bytes: int = 64 * 1024 * 1024
+    # --- snapshot fabric: serving side (statesync/reactor.py) ---------
+    # byte budget of the served-chunk LRU — concurrent bootstrappers
+    # hit RAM instead of costing an ABCI load each
+    chunk_cache_bytes: int = 64 * 1024 * 1024
+    # admission gate: concurrent serving loads / queued requests beyond
+    # that; past both budgets requests are shed (fetchers re-request
+    # from another peer) instead of stalling the event loop
+    serve_concurrency: int = 8
+    serve_queue: int = 64
 
 
 @dataclass
@@ -569,6 +597,29 @@ class Config:
             raise ConfigError("lightserve.max_batch must be >= 1")
         if ls.max_proofs < 1:
             raise ConfigError("lightserve.max_proofs must be >= 1")
+        ss = self.statesync
+        if ss.chunk_timeout_s <= 0:
+            raise ConfigError("statesync.chunk_timeout_s must be positive")
+        if not 1 <= ss.max_inflight_per_peer <= 64:
+            raise ConfigError(
+                "statesync.max_inflight_per_peer must be in [1, 64]")
+        if ss.discovery_time_s <= 0:
+            raise ConfigError(
+                "statesync.discovery_time_s must be positive")
+        if not 1 <= ss.discovery_rounds <= 100:
+            raise ConfigError(
+                "statesync.discovery_rounds must be in [1, 100]")
+        if not 0 <= ss.chunk_retries <= 100:
+            raise ConfigError(
+                "statesync.chunk_retries must be in [0, 100]")
+        if ss.spool_retain_bytes < 0 or ss.chunk_cache_bytes < 0:
+            raise ConfigError(
+                "statesync byte budgets must be >= 0")
+        if ss.serve_concurrency < 1:
+            raise ConfigError(
+                "statesync.serve_concurrency must be >= 1")
+        if ss.serve_queue < 0:
+            raise ConfigError("statesync.serve_queue must be >= 0")
         if not 2 <= self.blocksync.verify_window <= 4096:
             # floor 2: the accumulator needs a vouching tail block;
             # cap 4096: one window's commits already fill the largest

@@ -282,11 +282,21 @@ class Node:
 
         from ..statesync import StatesyncReactor, Syncer
 
-        self.statesync_reactor = StatesyncReactor(self.app_conns,
-                                                  name=f"{name}.ss")
+        self.statesync_reactor = StatesyncReactor(
+            self.app_conns, name=f"{name}.ss",
+            chunk_cache_bytes=cfg.statesync.chunk_cache_bytes,
+            serve_concurrency=cfg.statesync.serve_concurrency,
+            serve_queue=cfg.statesync.serve_queue)
         if self._state_syncing:
-            self.syncer = Syncer(self.app_conns, state_sync_provider,
-                                 reactor=self.statesync_reactor, name=name)
+            self.syncer = Syncer(
+                self.app_conns, state_sync_provider,
+                reactor=self.statesync_reactor, name=name,
+                chunk_timeout=cfg.statesync.chunk_timeout_s,
+                max_inflight_per_peer=cfg.statesync.max_inflight_per_peer,
+                discovery_time=cfg.statesync.discovery_time_s,
+                discovery_rounds=cfg.statesync.discovery_rounds,
+                chunk_retries=cfg.statesync.chunk_retries,
+                spool_retain_bytes=cfg.statesync.spool_retain_bytes)
             self.statesync_reactor.syncer = self.syncer
             self.blocksync_reactor.hold = True
 

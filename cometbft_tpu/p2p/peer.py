@@ -59,11 +59,24 @@ class Peer:
     def id(self) -> str:
         return self.node_info.node_id
 
+    def has_channel(self, channel_id: int) -> bool:
+        """Whether the REMOTE advertised this channel in its handshake
+        NodeInfo (reference peer.go hasChannel).  An empty advertisement
+        means a pre-channels peer: allow, for wire compat."""
+        chans = self.node_info.channels
+        return not chans or channel_id in chans
+
     def send(self, channel_id: int, msg: bytes) -> bool:
+        # sending on a channel the remote lacks would be a protocol
+        # error THERE (unknown-channel frame kills the connection):
+        # heterogeneous peers — e.g. statesync-only bootstrappers —
+        # simply don't receive gossip they can't parse
+        if not self.has_channel(channel_id):
+            return False
         return self.mconn.send(channel_id, msg)
 
     def try_send(self, channel_id: int, msg: bytes) -> bool:
-        return self.mconn.send(channel_id, msg)
+        return self.send(channel_id, msg)
 
     def get(self, key: str):
         return self._data.get(key)

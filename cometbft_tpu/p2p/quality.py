@@ -55,7 +55,10 @@ EVENT_WEIGHTS: dict[str, float] = {
     # evidence gossip
     "bad_evidence": 5.0,       # unverifiable gossiped evidence
     # statesync
-    "bad_snapshot_chunk": 5.0,  # app rejected this sender's chunks
+    "bad_snapshot_chunk": 5.0,  # manifest/app rejected this sender's
+    #   chunks: provably bad bytes, two strikes is a ban
+    "snapshot_timeout": 0.5,    # chunk request aged out: slow, not
+    #   (provably) malicious — persistent molasses still adds up
 }
 DEFAULT_WEIGHT = 1.0
 

@@ -56,6 +56,12 @@ class SimTuning:
     handshake_timeout: float = 4.0
     reconnect_base_delay: float = 0.25
     reconnect_max_delay: float = 2.0
+    # statesync fabric (virtual seconds): tight timeouts keep byzantine-
+    # seed detours cheap, and the re-request machinery is what's under test
+    statesync_chunk_timeout: float = 3.0
+    statesync_inflight: int = 4
+    statesync_discovery: float = 0.5
+    statesync_rounds: int = 5
     consensus: ConsensusConfig | None = None
 
     def to_dict(self) -> dict:

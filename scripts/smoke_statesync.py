@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""CI statesync-fabric smoke: a seeded 2-validator TCP net plus one
+fresh bootstrapper, where ONE seed's statesync serving path is armed
+with ``statesync.serve.corrupt`` (every served chunk gets a flipped
+bit).  Asserts the snapshot fabric's corrupt-chunk discipline end to
+end over real sockets:
+
+- the bootstrapper verifies every chunk against the content-addressed
+  manifest BEFORE spooling, so the corrupt seed is caught at the first
+  bad chunk (``chunk_hash_mismatches`` tally),
+- the corrupt seed is banned as a snapshot sender and the poisoned
+  chunk is re-requested from the honest seed — the restore NEVER
+  resets (``restore_resets == 0``; pre-manifest code paid a full
+  whole-restore retry here),
+- the sync completes off the honest seed, the restored app state
+  answers queries, and the bootstrapper follows the chain fork-free.
+
+Exit 0 on success, 1 with a reason on any failure.  Used by the lint
+workflow next to ``scripts/smoke_chaos.py``; runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_statesync.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 20260806
+# the BAD seed's serving reactor (node name + ".ss") corrupts every
+# chunk it serves; snapshot offers and manifests stay honest, so the
+# fetcher trusts its advertised root and catches the bytes
+SPEC = "statesync.serve.corrupt:node=ssmk-bad.ss:every=1"
+PERIOD = 3600 * 1_000_000_000
+
+
+async def scenario() -> None:
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import Config, test_consensus_config
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.light import Client, LocalNodeProvider, TrustOptions
+    from cometbft_tpu.node import Node
+    from cometbft_tpu.p2p import NodeKey
+    from cometbft_tpu.statesync import StateProvider
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    F.reset()
+    F.configure(enabled=True, seed=SEED, faults=[SPEC])
+    pvs = [MockPV.from_secret(b"ssmk%d" % i) for i in range(2)]
+    doc = GenesisDoc(chain_id="ssmk-net",
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+
+    def _config() -> Config:
+        cfg = Config(consensus=test_consensus_config())
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.rpc.laddr = ""
+        cfg.base.signature_backend = "cpu"
+        cfg.instrumentation.watchdog_stall_threshold_s = 0.0
+        cfg.statesync.discovery_time_s = 0.3
+        cfg.statesync.chunk_timeout_s = 3.0
+        return cfg
+
+    async def mk(name, pv, provider=None):
+        node = await Node.create(
+            doc, KVStoreApplication(), priv_validator=pv,
+            config=_config(), state_sync_provider=provider,
+            node_key=NodeKey.from_secret(name.encode()), name=name)
+        await node.start()
+        return node
+
+    good = await mk("ssmk-good", pvs[0])
+    bad = await mk("ssmk-bad", pvs[1])
+    nodes = [good, bad]
+    try:
+        await good.dial_peer(bad.listen_addr, persistent=True)
+
+        # app-state ballast: enough bytes that the snapshot spans
+        # several chunks, so round-robin hands the corrupt seed at
+        # least one of them
+        for i in range(8):
+            await good.mempool.check_tx(
+                b"ssmk%d=" % i + b"v" * 16384)
+
+        deadline = time.monotonic() + 40
+        while not all(n.height() >= 6 for n in nodes):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"seed chain stalled: {[n.height() for n in nodes]}")
+            await asyncio.sleep(0.1)
+
+        # the joining node trusts a recent header out of band
+        trust_h = 2
+        trust_hash = good.block_store.load_block(trust_h).hash()
+        light = Client("ssmk-net",
+                       TrustOptions(PERIOD, trust_h, trust_hash),
+                       LocalNodeProvider(good.block_store,
+                                         good.state_store),
+                       backend="cpu")
+        fresh = await mk("ssmk-fresh", None,
+                         provider=StateProvider(light, doc))
+        nodes.append(fresh)
+        for seed in (bad, good):     # bad seed first in the rotation
+            await fresh.dial_peer(seed.listen_addr, persistent=True)
+
+        # must state-sync (no history below the snapshot), then follow
+        target = max(n.height() for n in nodes[:2]) + 2
+        deadline = time.monotonic() + 60
+        while fresh.height() < target:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"bootstrapper stalled at {fresh.height()} "
+                    f"(statesync_error={fresh.statesync_error}, "
+                    f"tallies={fresh.syncer.tallies}, "
+                    f"chaos={F.stats()['sites']})")
+            await asyncio.sleep(0.1)
+        if fresh.block_store.base() <= 1:
+            raise RuntimeError(
+                "node replayed from genesis instead of state syncing")
+
+        # the corrupt seed was caught on the bytes, banned, and routed
+        # around — WITHOUT a whole-restore reset
+        t = fresh.syncer.tallies
+        fired = F.stats()["sites"].get(
+            "statesync.serve.corrupt", {}).get("fired", 0)
+        if fired < 1:
+            raise RuntimeError("the corrupt seed never served a chunk "
+                               "(ballast too small for the rotation?)")
+        if t["chunk_hash_mismatches"] < 1:
+            raise RuntimeError(
+                f"corrupt chunks served ({fired} fired) but never "
+                f"caught: {t}")
+        if t["restore_resets"] != 0:
+            raise RuntimeError(
+                f"corrupt chunk caused a whole-restore reset: {t}")
+        if bad.node_key.id not in fresh.syncer._banned:
+            raise RuntimeError(
+                f"corrupt seed not banned: {fresh.syncer._banned}")
+        if t["chunks_verified"] < 2:
+            raise RuntimeError(f"manifest verification inactive: {t}")
+
+        # restored app state contains pre-snapshot keys
+        q = await fresh.app_conns.query.query("/key", b"ssmk0", 0, False)
+        if not (q.value or b"").startswith(b"v"):
+            raise RuntimeError(f"restored state missing key: {q.value!r}")
+
+        # fork-free at every height all three share
+        common = min(n.height() for n in nodes)
+        for h in range(trust_h, common + 1):
+            hs = {n.block_store.load_block(h).hash() for n in nodes
+                  if n.block_store.load_block(h) is not None}
+            if len(hs) != 1:
+                raise RuntimeError(f"fork at height {h}: {hs}")
+
+        print(f"statesync smoke ok: restored at base "
+              f"{fresh.block_store.base()}, {t['chunk_hash_mismatches']} "
+              f"corrupt chunks caught pre-spool ({fired} served), "
+              f"0 restore resets, corrupt seed banned, "
+              f"{common} heights fork-free")
+    finally:
+        for n in nodes:
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        F.reset()
+
+
+def main() -> int:
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(scenario())
+    except RuntimeError as e:
+        print(f"FAIL: {e}", file=sys.stderr)
+        return 1
+    finally:
+        loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

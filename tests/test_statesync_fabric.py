@@ -1,0 +1,711 @@
+"""Snapshot-fabric tests: content-addressed manifests, the blob-pool
+spool (dedup / retention / adopt-resume), corrupt-chunk recovery
+without restore resets, serving-side LRU + admission gate, the
+fatal-IO spool discipline, provider retry, the ``[statesync]`` config
+knobs, and the deterministic fleet scenario lab."""
+
+import asyncio
+import errno
+import hashlib
+from types import SimpleNamespace
+
+import pytest
+
+from cometbft_tpu.statesync.manifest import (ChunkManifest, hash_chunk,
+                                             manifest_root,
+                                             valid_hash_list)
+from cometbft_tpu.statesync.syncer import (StatesyncError,
+                                           StatesyncFatalError, Syncer,
+                                           _BlobPool, _ChunkStore,
+                                           _is_fatal_io_error,
+                                           _PendingSnapshot)
+
+pytestmark = pytest.mark.timeout(150)
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ----------------------------------------------------------- manifest
+
+
+def test_manifest_root_binds_snapshot_and_order():
+    hs = [hash_chunk(b"c%d" % i) for i in range(4)]
+    root = manifest_root(b"\xcd" * 32, hs)
+    # bound to the snapshot hash: no cross-snapshot replay
+    assert manifest_root(b"\xce" * 32, hs) != root
+    # bound to chunk ORDER, not just the set
+    assert manifest_root(b"\xcd" * 32, list(reversed(hs))) != root
+
+    assert valid_hash_list(b"\xcd" * 32, hs, 4, root)
+    assert not valid_hash_list(b"\xcd" * 32, hs, 5, root)      # count
+    assert not valid_hash_list(b"\xcd" * 32, hs[:3], 4, root)  # short
+    assert not valid_hash_list(b"\xce" * 32, hs, 4, root)      # binding
+    assert not valid_hash_list(b"\xcd" * 32, hs, 4, b"\x00" * 32)
+    # shape: every entry must be a 32-byte digest
+    assert not valid_hash_list(b"\xcd" * 32, hs[:3] + [b"short"], 4, root)
+    assert not valid_hash_list(b"\xcd" * 32, hs[:3] + ["str"], 4, root)
+
+
+def test_chunk_manifest_verifies_chunks():
+    chunks = [b"alpha", b"beta", b"gamma"]
+    mf = ChunkManifest.from_chunks(b"\xcd" * 32, chunks)
+    assert len(mf) == 3
+    assert mf.root == manifest_root(b"\xcd" * 32,
+                                    [hash_chunk(c) for c in chunks])
+    for i, c in enumerate(chunks):
+        assert mf.verify_chunk(i, c)
+        assert not mf.verify_chunk(i, c + b"!")
+    assert not mf.verify_chunk(-1, b"alpha")
+    assert not mf.verify_chunk(3, b"alpha")
+
+
+# -------------------------------------------------- blob pool / spool
+
+
+def test_blob_pool_dedups_identical_content():
+    pool = _BlobPool(in_memory=True, retain_bytes=1 << 20)
+    h = hashlib.sha256(b"DATA").digest()
+    assert pool.put(h, b"DATA")
+    assert pool.put(h, b"DATA")          # second put: ref++, no copy
+    assert pool.dedup_hits == 1
+    assert pool.get(h) == b"DATA"
+    pool.release(h)
+    assert pool.get(h) == b"DATA"        # still referenced
+    pool.release(h)                      # last ref -> retained tier
+    assert pool.acquire(h)               # adopt path revives it
+    assert pool.dedup_hits == 1          # acquire is not a dedup
+    pool.close()
+
+
+def test_blob_pool_retention_budget_evicts_oldest():
+    pool = _BlobPool(in_memory=True, retain_bytes=250)
+    hs = []
+    for i in range(4):
+        data = bytes([i]) * 100
+        h = hashlib.sha256(data).digest()
+        pool.put(h, data)
+        hs.append(h)
+    for h in hs:
+        pool.release(h)          # all retire into the retained tier
+    # 400 B over a 250 B budget: the two oldest blobs are gone
+    assert not pool.acquire(hs[0])
+    assert not pool.acquire(hs[1])
+    assert pool.acquire(hs[2])
+    assert pool.acquire(hs[3])
+    pool.close()
+
+
+def test_blob_pool_zero_budget_deletes_on_release():
+    pool = _BlobPool(in_memory=True, retain_bytes=0)
+    h = hashlib.sha256(b"X").digest()
+    pool.put(h, b"X")
+    pool.release(h)
+    assert not pool.acquire(h)
+    pool.close()
+
+
+def test_chunk_store_adopts_retained_blobs_across_attempts():
+    """The resume path: a failed attempt's chunks survive in the shared
+    pool's retained tier and the NEXT attempt adopts them by manifest
+    hash instead of re-fetching."""
+    pool = _BlobPool(in_memory=True, retain_bytes=1 << 20)
+    h = hashlib.sha256(b"CHUNK-0").digest()
+
+    first = _ChunkStore(pool=pool)
+    first[0] = (b"CHUNK-0", "peerA")
+    first.close()                        # attempt failed: refs released
+
+    second = _ChunkStore(pool=pool)
+    assert second.adopt(0, h)
+    assert second[0] == (b"CHUNK-0", "")
+    assert not second.adopt(0, h)        # already indexed
+    assert not second.adopt(1, b"\x00" * 32)   # unknown content
+    second.close()
+    pool.close()
+
+
+def test_chunk_store_pop_if_sender_race():
+    """The banned-mid-write guard: pop only when the chunk still came
+    from the banned sender — never a good peer's fresh replacement."""
+    store = _ChunkStore(in_memory=True)
+    store[0] = (b"evil-bytes", "evil")
+    assert not store.pop_if_sender(0, "good")
+    assert 0 in store
+    # good peer overwrote the slot before the late purge ran
+    store[0] = (b"good-bytes", "good")
+    assert not store.pop_if_sender(0, "evil")
+    assert store[0] == (b"good-bytes", "good")
+    assert store.pop_if_sender(0, "good")
+    assert 0 not in store
+    store.close()
+
+
+# ------------------------------------------ add_chunk + manifest gate
+
+
+def _syncer_with_manifest(chunks):
+    sy = Syncer(app_conns=None, state_provider=None,
+                in_memory_spool=True)
+    snap = SimpleNamespace(height=7, format=1, chunks=len(chunks),
+                           hash=b"\xcd" * 32)
+    sy._current = _PendingSnapshot(snap)
+    sy._manifest = [hash_chunk(c) for c in chunks]
+    return sy
+
+
+def test_add_chunk_spools_only_verified_bytes():
+    sy = _syncer_with_manifest([b"C0", b"C1"])
+    sy.add_chunk("evil", 7, 1, 0, b"CORRUPT", b"\xcd" * 32)
+    assert 0 not in sy._chunks                 # never touched the spool
+    assert "evil" in sy._banned
+    assert 0 in sy._refetch                    # re-request flagged
+    assert sy.tallies["chunk_hash_mismatches"] == 1
+    assert sy.tallies["senders_banned"] == 1
+
+    sy.add_chunk("good", 7, 1, 0, b"C0", b"\xcd" * 32)
+    assert sy._chunks[0] == (b"C0", "good")
+    assert sy.tallies["chunks_verified"] == 1
+    # a late delivery from the banned sender is dropped outright
+    sy.add_chunk("evil", 7, 1, 1, b"C1", b"\xcd" * 32)
+    assert 1 not in sy._chunks
+    sy._chunks.close()
+    sy._pool.close()
+
+
+def test_add_chunk_drops_stale_snapshot_responses():
+    sy = _syncer_with_manifest([b"C0"])
+    for h, f, sh in ((8, 1, b"\xcd" * 32),     # wrong height
+                     (7, 2, b"\xcd" * 32),     # wrong format
+                     (7, 1, b"\xee" * 32)):    # wrong snapshot hash
+        sy.add_chunk("p", h, f, 0, b"C0", sh)
+    assert 0 not in sy._chunks
+    assert sy.tallies["chunks_verified"] == 0
+    sy._chunks.close()
+    sy._pool.close()
+
+
+def test_restore_recovers_from_corrupt_chunks_without_reset():
+    """The tentpole property end to end at the syncer layer: a peer
+    serving corrupt bytes is caught against the negotiated manifest,
+    banned, and routed around — the restore completes off the honest
+    peer with ZERO whole-restore resets."""
+    from cometbft_tpu.abci import types as abci_t
+
+    chunks = [b"CHUNK-%d" % i for i in range(4)]
+    hashes = [hash_chunk(c) for c in chunks]
+    root = manifest_root(b"\xcd" * 32, hashes)
+
+    applied = {}
+
+    class SnapConn:
+        async def offer_snapshot(self, snapshot, app_hash):
+            return abci_t.OFFER_SNAPSHOT_ACCEPT
+
+        async def apply_snapshot_chunk(self, index, chunk, sender):
+            applied[index] = (chunk, sender)
+            return abci_t.APPLY_CHUNK_ACCEPT
+
+    class QueryConn:
+        async def info(self):
+            return abci_t.InfoResponse(last_block_height=5,
+                                       last_block_app_hash=b"\xab" * 32)
+
+    class Provider:
+        async def app_hash(self, h):
+            return b"\xab" * 32
+
+        async def state(self, h):
+            return "S"
+
+        async def commit(self, h):
+            return "C"
+
+    class Reactor:
+        def __init__(self, box):
+            self.box = box
+
+        def request_manifest(self, peer, height, format_, sh):
+            self.box[0].add_manifest(peer, height, format_, sh,
+                                     list(hashes))
+            return True
+
+        def request_chunk(self, peer, height, format_, index, sh):
+            data = chunks[index] if peer == "good" \
+                else chunks[index][:-1] + b"!"
+
+            async def deliver():
+                self.box[0].add_chunk(peer, height, format_, index,
+                                      data, sh)
+
+            asyncio.get_event_loop().create_task(deliver())
+            return True
+
+    async def main():
+        conns = SimpleNamespace(snapshot=SnapConn(), query=QueryConn())
+        box = [None]
+        syncer = Syncer(conns, Provider(), reactor=Reactor(box),
+                        in_memory_spool=True)
+        box[0] = syncer
+        snapshot = abci_t.Snapshot(height=5, format=1, chunks=4,
+                                   hash=b"\xcd" * 32, metadata=b"")
+        # the corrupting peer is FIRST in the rotation
+        syncer.add_snapshot("evil", snapshot, manifest_root=root)
+        syncer.add_snapshot("good", snapshot, manifest_root=root)
+        state, commit = await syncer._restore(
+            syncer._snapshots[(5, 1, b"\xcd" * 32)])
+        assert (state, commit) == ("S", "C")
+        return syncer
+
+    syncer = run(main())
+    assert set(applied) == {0, 1, 2, 3}
+    assert all(s == "good" for _, s in applied.values())
+    assert "evil" in syncer._banned
+    t = syncer.tallies
+    assert t["chunk_hash_mismatches"] >= 1
+    assert t["chunks_verified"] == 4
+    assert t["restore_resets"] == 0, \
+        "a corrupt chunk must never reset the restore"
+    syncer._pool.close()
+
+
+def test_restore_rejects_lying_manifest_server():
+    """A peer advertising the majority root but serving a DIFFERENT
+    hash list is caught by the root check inside add_manifest, banned,
+    and the next holder serves the real list."""
+    from cometbft_tpu.abci import types as abci_t
+
+    chunks = [b"A", b"B"]
+    hashes = [hash_chunk(c) for c in chunks]
+    root = manifest_root(b"\xcd" * 32, hashes)
+    lies = [hash_chunk(b"X"), hash_chunk(b"Y")]
+
+    class SnapConn:
+        async def offer_snapshot(self, snapshot, app_hash):
+            return abci_t.OFFER_SNAPSHOT_ACCEPT
+
+        async def apply_snapshot_chunk(self, index, chunk, sender):
+            return abci_t.APPLY_CHUNK_ACCEPT
+
+    class QueryConn:
+        async def info(self):
+            return abci_t.InfoResponse(last_block_height=5,
+                                       last_block_app_hash=b"\xab" * 32)
+
+    class Provider:
+        async def app_hash(self, h):
+            return b"\xab" * 32
+
+        async def state(self, h):
+            return "S"
+
+        async def commit(self, h):
+            return "C"
+
+    class Reactor:
+        def __init__(self, box):
+            self.box = box
+            self.manifest_reqs = []
+
+        def request_manifest(self, peer, height, format_, sh):
+            self.manifest_reqs.append(peer)
+            hs = lies if peer == "liar" else hashes
+            self.box[0].add_manifest(peer, height, format_, sh, list(hs))
+            return True
+
+        def request_chunk(self, peer, height, format_, index, sh):
+            async def deliver():
+                self.box[0].add_chunk(peer, height, format_, index,
+                                      chunks[index], sh)
+
+            asyncio.get_event_loop().create_task(deliver())
+            return True
+
+    async def main():
+        conns = SimpleNamespace(snapshot=SnapConn(), query=QueryConn())
+        box = [None]
+        reactor = Reactor(box)
+        syncer = Syncer(conns, Provider(), reactor=reactor,
+                        in_memory_spool=True)
+        box[0] = syncer
+        snapshot = abci_t.Snapshot(height=5, format=1, chunks=2,
+                                   hash=b"\xcd" * 32, metadata=b"")
+        syncer.add_snapshot("liar", snapshot, manifest_root=root)
+        syncer.add_snapshot("hon1", snapshot, manifest_root=root)
+        syncer.add_snapshot("hon2", snapshot, manifest_root=root)
+        await syncer._restore(syncer._snapshots[(5, 1, b"\xcd" * 32)])
+        return syncer, reactor
+
+    syncer, reactor = run(main())
+    assert "liar" in syncer._banned
+    assert len(reactor.manifest_reqs) >= 2     # fell through to honest
+    syncer._pool.close()
+
+
+# ---------------------------------------------- fatal-IO spool (sat 1)
+
+
+def test_is_fatal_io_error_classification():
+    for e in (errno.EIO, errno.ENOSPC, errno.EROFS, errno.EDQUOT,
+              errno.ENXIO):
+        assert _is_fatal_io_error(OSError(e, "dead"))
+    for e in (errno.ENOENT, errno.EAGAIN, errno.EINTR):
+        assert not _is_fatal_io_error(OSError(e, "transient"))
+    assert not _is_fatal_io_error(OSError("no errno"))
+
+
+def test_spool_enospc_fails_sync_with_fatal_error():
+    from cometbft_tpu.libs import failures as F
+
+    F.reset()
+    F.configure(enabled=True, seed=7,
+                faults=["statesync.spool.enospc:every=1"])
+    try:
+        sy = Syncer(app_conns=None, state_provider=None,
+                    in_memory_spool=True)
+        snap = SimpleNamespace(height=7, format=1, chunks=2,
+                               hash=b"\xcd" * 32)
+        pending = _PendingSnapshot(snap)
+        pending.peers.append("p")
+        sy._current = pending
+        sy.add_chunk("p", 7, 1, 0, b"data", b"\xcd" * 32)
+        assert isinstance(sy._fatal, StatesyncFatalError)
+        assert "ENOSPC" in str(sy._fatal)
+        assert 0 not in sy._chunks
+
+        async def main():
+            with pytest.raises(StatesyncFatalError):
+                await sy._fetch_and_apply(pending)
+
+        run(main())
+        sy._chunks.close()
+        sy._pool.close()
+    finally:
+        F.reset()
+
+
+def test_spool_nonfatal_oserror_does_not_kill_sync():
+    sy = Syncer(app_conns=None, state_provider=None,
+                in_memory_spool=True)
+    sy._spool_failed(0, OSError(errno.ENOENT, "transient"))
+    assert sy._fatal is None
+    sy._chunks.close()
+    sy._pool.close()
+
+
+# ------------------------------------------- provider retries (sat 2)
+
+
+def test_stateprovider_retries_transient_failures():
+    from cometbft_tpu.statesync.stateprovider import StateProvider
+
+    class FlakyLight:
+        def __init__(self, fail, exc):
+            self.calls = 0
+            self.fail = fail
+            self.exc = exc
+
+        async def verify_light_block_at_height(self, height):
+            self.calls += 1
+            if self.calls <= self.fail:
+                raise self.exc
+            return SimpleNamespace(
+                header=SimpleNamespace(app_hash=b"\xab" * 32),
+                commit="COMMIT")
+
+    async def main():
+        # two transient failures, then success
+        light = FlakyLight(2, TimeoutError("slow"))
+        sp = StateProvider(light, None, retries=2, backoff_s=0.0)
+        assert await sp.app_hash(4) == b"\xab" * 32
+        assert light.calls == 3
+
+        # retries exhausted: the transient error surfaces
+        light = FlakyLight(99, ConnectionError("refused"))
+        sp = StateProvider(light, None, retries=1, backoff_s=0.0)
+        with pytest.raises(ConnectionError):
+            await sp.commit(4)
+        assert light.calls == 2
+
+        # verification failures are NOT transient: no retry
+        light = FlakyLight(99, ValueError("bad header"))
+        sp = StateProvider(light, None, retries=3, backoff_s=0.0)
+        with pytest.raises(ValueError):
+            await sp.commit(4)
+        assert light.calls == 1
+
+    run(main())
+
+
+# ------------------------------------------------ config knobs (sat 3)
+
+
+def test_statesync_config_validation_bounds():
+    from cometbft_tpu.config import Config, ConfigError
+
+    Config().validate()
+    bad = [("chunk_timeout_s", 0.0), ("chunk_timeout_s", -1.0),
+           ("max_inflight_per_peer", 0), ("max_inflight_per_peer", 65),
+           ("discovery_time_s", 0.0), ("discovery_rounds", 0),
+           ("discovery_rounds", 101), ("chunk_retries", -1),
+           ("spool_retain_bytes", -1), ("chunk_cache_bytes", -1),
+           ("serve_concurrency", 0), ("serve_queue", -1)]
+    for field_, value in bad:
+        cfg = Config()
+        setattr(cfg.statesync, field_, value)
+        with pytest.raises(ConfigError):
+            cfg.validate()
+
+
+def test_statesync_config_toml_round_trip(tmp_path):
+    from cometbft_tpu.config import Config
+
+    cfg = Config()
+    cfg.statesync.chunk_timeout_s = 7.5
+    cfg.statesync.max_inflight_per_peer = 8
+    cfg.statesync.discovery_rounds = 9
+    cfg.statesync.chunk_retries = 5
+    cfg.statesync.spool_retain_bytes = 1 << 20
+    cfg.statesync.chunk_cache_bytes = 2 << 20
+    cfg.statesync.serve_concurrency = 3
+    cfg.statesync.serve_queue = 17
+    path = str(tmp_path / "config.toml")
+    cfg.save(path)
+    back = Config.load(path)
+    for f_ in ("chunk_timeout_s", "max_inflight_per_peer",
+               "discovery_rounds", "chunk_retries",
+               "spool_retain_bytes", "chunk_cache_bytes",
+               "serve_concurrency", "serve_queue"):
+        assert getattr(back.statesync, f_) == \
+            getattr(cfg.statesync, f_), f_
+    back.validate()
+
+
+# -------------------------------------------- serving side (LRU/gate)
+
+
+def test_chunk_lru_byte_budget():
+    from cometbft_tpu.statesync.cache import ChunkLRU
+
+    lru = ChunkLRU(max_size=10, max_bytes=250)
+    for i in range(3):
+        lru.put(("h", 1, i), bytes([i]) * 100)
+    # 300 B over 250: the oldest entry evicted
+    assert lru.get(("h", 1, 0)) is None
+    assert lru.get(("h", 1, 1)) is not None
+    assert lru.bytes == 200
+    # get() refreshes recency: key 1 survives the next eviction
+    lru.put(("h", 1, 3), b"z" * 100)
+    assert lru.get(("h", 1, 1)) is not None
+    assert lru.get(("h", 1, 2)) is None
+    # never evicts below one entry even when over budget
+    lru2 = ChunkLRU(max_size=10, max_bytes=10)
+    lru2.put("k", b"x" * 100)
+    assert len(lru2) == 1
+
+
+def test_admission_gate_sheds_over_queue_budget():
+    from cometbft_tpu.statesync.cache import AdmissionGate
+
+    async def main():
+        gate = AdmissionGate(concurrency=1, max_queued=1)
+        release = asyncio.Event()
+        entered = asyncio.Event()
+
+        async def hold():
+            async with gate:
+                entered.set()
+                await release.wait()
+
+        holder = asyncio.get_event_loop().create_task(hold())
+        await entered.wait()
+        assert gate.try_queue()          # one slot in the queue
+
+        async def wait_in_queue():
+            async with gate:
+                pass
+
+        waiter = asyncio.get_event_loop().create_task(wait_in_queue())
+        await asyncio.sleep(0)           # waiter parks (waiting == 1)
+        assert not gate.try_queue()      # queue full: shed
+        assert gate.shed == 1
+        release.set()
+        await asyncio.gather(holder, waiter)
+        assert gate.try_queue()          # drained: admitting again
+
+    run(main())
+
+
+def test_reactor_offers_root_and_serves_manifest():
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.statesync.reactor import StatesyncReactor, _pack
+
+    import msgpack
+
+    async def main():
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        await client.finalize_block(abci_t.FinalizeBlockRequest(
+            txs=[b"k%02d=" % i + b"v" * 50000 for i in range(4)],
+            height=1, time_ns=0))
+        await client.commit()
+        snaps = await client.list_snapshots()
+        snap = snaps[-1]
+        assert snap.chunks >= 2
+
+        reactor = StatesyncReactor(SimpleNamespace(snapshot=client),
+                                   name="t.ss")
+        sent = []
+        peer = SimpleNamespace(
+            id="p1", send=lambda chan, msg: sent.append(
+                (chan, msgpack.unpackb(msg, raw=False))) or True)
+
+        await reactor._serve_snapshots(peer)
+        offers = [d for _, d in sent if d["@"] == "sres"]
+        assert offers
+        offer = next(d for d in offers if d["h"] == snap.height
+                     and d["f"] == snap.format)
+        root = offer["mr"]
+
+        sent.clear()
+        await reactor._serve_manifest(
+            peer, {"h": snap.height, "f": snap.format, "sh": snap.hash})
+        mres = next(d for _, d in sent if d["@"] == "mres")
+        assert valid_hash_list(snap.hash, mres["hs"], snap.chunks, root)
+
+        # chunk serving goes through the LRU: second serve is a hit
+        sent.clear()
+        await reactor._serve_chunk(
+            peer, {"h": snap.height, "f": snap.format, "i": 0})
+        await reactor._serve_chunk(
+            peer, {"h": snap.height, "f": snap.format, "i": 0})
+        served = [d for _, d in sent if d["@"] == "cres"]
+        assert len(served) == 2
+        assert served[0]["chunk"] == served[1]["chunk"]
+        assert hash_chunk(served[0]["chunk"]) == mres["hs"][0]
+        assert len(reactor._cache) >= 1
+        _ = _pack     # imported for parity with the wire format
+
+    run(main())
+
+
+def test_serve_corrupt_chaos_site_flips_served_bytes_not_cache():
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.libs import failures as F
+    from cometbft_tpu.statesync.reactor import StatesyncReactor
+
+    import msgpack
+
+    async def main():
+        app = KVStoreApplication()
+        client = LocalClient(app)
+        await client.finalize_block(abci_t.FinalizeBlockRequest(
+            txs=[b"k=" + b"v" * 1000], height=1, time_ns=0))
+        await client.commit()
+        snap = (await client.list_snapshots())[-1]
+        honest = await client.load_snapshot_chunk(snap.height,
+                                                  snap.format, 0)
+
+        reactor = StatesyncReactor(SimpleNamespace(snapshot=client),
+                                   name="byz.ss")
+        sent = []
+        peer = SimpleNamespace(
+            id="p1", send=lambda chan, msg: sent.append(
+                msgpack.unpackb(msg, raw=False)) or True)
+        F.reset()
+        F.configure(enabled=True, seed=3, faults=[
+            "statesync.serve.corrupt:node=byz.ss:every=1"])
+        try:
+            await reactor._serve_chunk(
+                peer, {"h": snap.height, "f": snap.format, "i": 0})
+            served = sent[-1]["chunk"]
+            assert served != honest              # exactly one bit apart
+            diff = [a ^ b for a, b in zip(served, honest) if a != b]
+            assert len(diff) == 1 and bin(diff[0]).count("1") == 1
+            # the LRU kept the honest bytes (corruption is per-serve)
+            key = (snap.height, snap.format, 0)
+            assert reactor._cache.get(key) == honest
+        finally:
+            F.reset()
+
+    run(main())
+
+
+# ------------------------------------------- heterogeneous peers (p2p)
+
+
+def test_peer_send_filters_unadvertised_channels():
+    """Sender-side channel filtering (reference peer.go hasChannel): a
+    statesync-only bootstrapper must not be killed by consensus gossip
+    frames it cannot parse — the sender just skips it."""
+    from cometbft_tpu.p2p.node_info import NodeInfo
+    from cometbft_tpu.p2p.peer import Peer
+
+    sent = []
+    mconn = SimpleNamespace(send=lambda chan, msg: sent.append(chan)
+                            or True)
+    info = NodeInfo(node_id="n1", listen_addr="mem://x", network="net",
+                    channels=bytes([0x60, 0x61]), moniker="x")
+    peer = Peer(info, mconn, outbound=True)
+    assert peer.has_channel(0x60)
+    assert not peer.has_channel(0x20)
+    assert peer.send(0x60, b"m")
+    assert not peer.send(0x20, b"m")     # consensus channel: filtered
+    assert sent == [0x60]
+    # empty advertisement = pre-channels peer: allow everything
+    info2 = NodeInfo(node_id="n2", listen_addr="mem://y", network="net",
+                     channels=b"", moniker="y")
+    peer2 = Peer(info2, mconn, outbound=True)
+    assert peer2.send(0x20, b"m")
+
+
+# --------------------------------------------------- fleet scenarios
+
+
+def test_small_fleet_scenario_replay_identical():
+    from cometbft_tpu.sim.statesync_lab import (StatesyncScenario,
+                                                curated_statesync_scenario,
+                                                run_statesync_scenario)
+
+    scn = curated_statesync_scenario(small=True)
+    v1 = run_statesync_scenario(scn)
+    v2 = run_statesync_scenario(scn)
+    assert v1 == v2, "verdict must be a pure function of (scenario, seed)"
+    assert v1["completed"] == scn.n_bootstrappers, v1["failed"]
+    assert v1["restored_state_matches_chain"]
+    t = v1["syncer_tallies"]
+    assert t["chunk_hash_mismatches"] >= 1     # byzantine seed caught
+    assert t["restore_resets"] == 0            # ...without a reset
+    assert len(v1["byzantine_banned_by"]) == scn.n_bootstrappers
+    assert v1["chaos"]["sites"].get("statesync.serve.corrupt", 0) >= 1
+    # the scenario survives the JSON round trip (replay-from-file)
+    rt = StatesyncScenario.from_dict(scn.to_dict())
+    assert rt.to_dict() == scn.to_dict()
+
+
+@pytest.mark.slow
+def test_fleet_50_node_bootstrap_scenario():
+    """The flagship program: 40 bootstrappers, 4 seeds, gray failures,
+    one byzantine seed — every bootstrapper completes, the byzantine
+    seed is banned fleet-wide, zero restore resets."""
+    from cometbft_tpu.sim.statesync_lab import (curated_statesync_scenario,
+                                                run_statesync_scenario)
+
+    scn = curated_statesync_scenario()
+    v = run_statesync_scenario(scn)
+    assert v["completed"] == scn.n_bootstrappers, v["failed"]
+    assert v["restored_state_matches_chain"]
+    assert v["syncer_tallies"]["restore_resets"] == 0
+    assert len(v["byzantine_banned_by"]) == scn.n_bootstrappers
+    d = v["time_to_serving_height_s"]
+    assert d["min"] is not None and d["max"] is not None
+    assert d["min"] <= d["p50"] <= d["p90"] <= d["max"]
