@@ -35,6 +35,11 @@ so the driver always records a result.
              through the light/serve.py tier — proofs/s + request p99
              with /status probed throughout, vs the per-proof re-hash
              baseline
+- mesh:      the r19 true-SPMD path — weak-scaling over 1/2/4/8 devices
+             (ONE sharded dispatch per bucket), blocksync window
+             occupancy, a sharded-vs-single equal-work guard, the
+             10k-validator commit p50 at full mesh width, and the
+             fresh-process sharded-bundle first-dispatch gauge
 """
 
 from __future__ import annotations
@@ -529,6 +534,319 @@ def _child_p50commit(backend: str, n_vals: int) -> None:
         "n_validators": n_vals,
         "backend": backend,
     }), flush=True)
+
+
+def _child_mesh(backend: str, out_path: str) -> None:
+    """True-SPMD mesh bench (r19): every number measured from INSIDE the
+    timed pass of the production dispatch, on ONE sharded program per
+    bucket over an explicit device mesh.
+
+    Sections of the artifact:
+    - weak_scaling: the same per-device lane load (BENCH_MESH_LANES,
+      default 256) over 1/2/4/8 devices (CPU host-device emulation
+      locally, real chips when present) — per-bucket p50, occupancy,
+      sigs/s.
+    - window: the staged-window lane count the mesh-aware blocksync
+      accumulator produces (plan.window_blocks) and its full-mesh
+      occupancy (acceptance: >= 0.85).
+    - equal_work_guard: the full-mesh lane count dispatched sharded vs
+      single-device; the child EXITS NONZERO if sharded is slower than
+      BENCH_MESH_TOL x single (default 1.25 on CPU emulation, 1.0 on a
+      real accelerator).
+    - commit10k: the BASELINE headline — p50 VerifyCommit @10k
+      validators through the cached-valset route at full mesh width,
+      recorded against the <5ms / >=20x-Go-batch targets.
+    - first_dispatch: a sharded rlc bundle built here must load in a
+      FRESH process and dispatch < 1s on the PR 5
+      crypto_kernel_first_dispatch_seconds gauge.
+
+    TPU projection methodology (for the committed CPU artifact): the
+    emulated host devices SHARE the box's physical cores, so
+    per-dispatch latency cannot drop with mesh width here — on CPU the
+    weak-scaling curve validates that the sharded program adds no
+    overhead (flat-ish p50 at D x the work = near-linear weak scaling),
+    and the equal-work guard enforces the invariant that must hold on
+    any backend.  The <5ms absolute bar is a per-chip-throughput
+    number: project it from a real chip's single-device sigs/s times
+    the mesh width (lanes are independent; the RLC fold crosses
+    O(windows) points per verdict), then confirm on hardware with this
+    same mode, which runs unchanged on a TPU host.
+    """
+    counts = sorted({int(x) for x in os.environ.get(
+        "BENCH_MESH_COUNTS", "1,2,4,8").split(",") if int(x) > 0})
+    if backend == "cpu":
+        # BEFORE any jax import: the weak-scaling sweep needs emulated
+        # host devices on a CPU-only box
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count"
+                f"={max(counts)}").strip()
+    note, _ = _mode_child_setup("mesh", backend)
+
+    import dataclasses
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from cometbft_tpu.crypto import aotbundle
+    from cometbft_tpu.crypto import batch as cb
+    from cometbft_tpu.crypto import plan as deviceplan
+    from cometbft_tpu.testing import dense_signature_batch
+
+    ndev = len(jax.devices())
+    counts = [c for c in counts if c <= ndev] or [1]
+    per_dev = int(os.environ.get("BENCH_MESH_LANES", "256"))
+    reps = int(os.environ.get("BENCH_MESH_REPS", "7"))
+    max_d = max(counts)
+    max_lanes = per_dev * max_d
+    note(f"devices={ndev} counts={counts} per_device_lanes={per_dev}")
+
+    note(f"building {max_lanes}-lane all-valid batch")
+    args, items = dense_signature_batch(max_lanes, msg_len=120, seed=19,
+                                        n_keys=256)
+    pubs = np.asarray(args[0], np.uint8)
+    rs8 = np.asarray(args[1], np.uint8)
+    ss8 = np.asarray(args[2], np.uint8)
+    msgs = np.stack([np.frombuffer(m, np.uint8).copy()
+                     for _, m, _ in items])
+    lens = np.full((max_lanes,), msgs.shape[1], np.int64)
+
+    def set_mesh(d):
+        deviceplan.configure(mesh_shape=(d,) if d > 1 else ())
+
+    def run_lanes(n):
+        out = cb.device_verify_ed25519(pubs[:n], rs8[:n], ss8[:n],
+                                       msgs[:n], lens[:n])
+        assert bool(out.all()), "all-valid batch rejected"
+
+    def timed_pass(fn):
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        return (float(np.percentile(times, 50)),
+                float(np.percentile(times, 90)))
+
+    # ---- weak scaling: per-device load held constant over mesh width
+    weak = []
+    for d in counts:
+        set_mesh(d)
+        lanes = per_dev * d
+        bb = deviceplan.chunk_bucket(
+            lanes, tuple(range(d)) if d > 1 else ())
+        note(f"[weak] D={d} lanes={lanes} bucket={bb}: cold dispatch")
+        cold, _ = _timed_cold_warm(lambda: run_lanes(lanes))
+        p50, p90 = timed_pass(lambda: run_lanes(lanes))
+        weak.append({
+            "devices": d, "lanes": lanes, "bucket": bb,
+            "occupancy": round(deviceplan.mesh_occupancy(lanes, d), 4),
+            "cold_s": round(cold, 3),
+            "p50_ms": round(p50 * 1e3, 3),
+            "p90_ms": round(p90 * 1e3, 3),
+            "sigs_per_s": round(lanes / p50, 1),
+        })
+        note(f"[weak] D={d} p50={p50 * 1e3:.2f}ms "
+             f"{lanes / p50:,.0f} sigs/s")
+    for w in weak:
+        w["scaling_vs_1dev"] = round(
+            w["sigs_per_s"] / weak[0]["sigs_per_s"], 3)
+
+    # ---- the blocksync staged-window workload at full mesh width
+    set_mesh(max_d)
+    bs_vals = int(os.environ.get("BENCH_MESH_WINDOW_VALS", "100"))
+    bs_window = int(os.environ.get("BENCH_MESH_WINDOW", "32"))
+    blocks = deviceplan.window_blocks(bs_window, bs_vals)
+    win_lanes = blocks * bs_vals
+    window = {
+        "verify_window": bs_window, "n_vals": bs_vals,
+        "staged_blocks": blocks, "lanes": win_lanes,
+        "occupancy": round(
+            deviceplan.mesh_occupancy(win_lanes, max_d), 4),
+    }
+    note(f"[window] {bs_window} cfg blocks x {bs_vals} vals -> "
+         f"{blocks} staged blocks, occupancy {window['occupancy']}")
+
+    # ---- equal-work guard: full-mesh lanes, sharded vs single-device
+    tol = float(os.environ.get(
+        "BENCH_MESH_TOL", "1.25" if backend == "cpu" else "1.0"))
+    sharded_p50 = weak[-1]["p50_ms"]
+    set_mesh(1)
+    note(f"[guard] single-device equal work: {max_lanes} lanes")
+    _timed_cold_warm(lambda: run_lanes(max_lanes))
+    sp50, _ = timed_pass(lambda: run_lanes(max_lanes))
+    guard = {
+        "lanes": max_lanes,
+        "sharded_p50_ms": sharded_p50,
+        "single_p50_ms": round(sp50 * 1e3, 3),
+        "tol": tol,
+        "ratio": round(sharded_p50 / (sp50 * 1e3), 3),
+        "ok": bool(sharded_p50 <= tol * sp50 * 1e3),
+    }
+    note(f"[guard] sharded/single = {guard['ratio']} (tol {tol})")
+
+    # ---- BASELINE headline: 10k-validator commit p50, cached route
+    n_vals = int(os.environ.get("BENCH_MESH_VALS", "10000"))
+    commit = None
+    if n_vals > 0:
+        note(f"[commit] building {n_vals}-validator commit batch")
+        cargs, citems = dense_signature_batch(n_vals, msg_len=120,
+                                              seed=77, n_keys=256)
+        cp = np.asarray(cargs[0], np.uint8)
+        cr = np.asarray(cargs[1], np.uint8)
+        cs = np.asarray(cargs[2], np.uint8)
+        cm = np.stack([np.frombuffer(m, np.uint8).copy()
+                       for _, m, _ in citems])
+        cl = np.full((n_vals,), cm.shape[1], np.int64)
+        scope = np.arange(n_vals, dtype=np.int64)
+
+        def one_commit():
+            out = cb.device_verify_ed25519_cached(cp, scope, cp, cr, cs,
+                                                  cm, cl)
+            assert bool(out.all()), "commit batch rejected"
+
+        commit = {"n_validators": n_vals, "target_p50_ms": 5.0,
+                  "target_vs_go_batch": 20.0}
+        for tag, d in (("single", 1), ("sharded", max_d)):
+            set_mesh(d)
+            note(f"[commit] {tag} D={d}: cold (table + compiles)")
+            cold, _ = _timed_cold_warm(one_commit)
+            note(f"[commit] {tag} cold {cold:.1f}s; timing")
+            p50, p90 = timed_pass(one_commit)
+            commit[tag] = {
+                "devices": d,
+                "p50_ms": round(p50 * 1e3, 3),
+                "p90_ms": round(p90 * 1e3, 3),
+                "cold_s": round(cold, 3),
+            }
+            note(f"[commit] {tag} p50={p50 * 1e3:.2f}ms")
+        commit["vs_target"] = round(
+            5.0 / commit["sharded"]["p50_ms"], 4)
+        commit["sharded_vs_single"] = round(
+            commit["single"]["p50_ms"] / commit["sharded"]["p50_ms"], 3)
+
+    # ---- PR 5 gauge: sharded bundle loads warm in a FRESH process
+    first = None
+    if max_d > 1 and int(os.environ.get("BENCH_MESH_GAUGE", "1")):
+        set_mesh(max_d)
+        # TWO sharded buckets: the rlc executable is the production
+        # target but its serialized form can hit the known XLA CPU
+        # deserialize quirk ("Symbols not found") in a fresh process —
+        # in which case it reports degraded:deserialize (by design) and
+        # the merkle bucket carries the warm-load proof instead
+        gplan = dataclasses.replace(
+            deviceplan.active(), warm_kinds=("rlc",), warm_tables=(),
+            warm_merkle=(max_lanes,), warm_lanes=(max_lanes,),
+            warm_blocks=(2,))
+        with tempfile.TemporaryDirectory(prefix="bench-mesh-aot-") as td:
+            bpath = os.path.join(td, "bundle.aot")
+            t0 = time.perf_counter()
+            binfo = aotbundle.build(plan=gplan, path=bpath)
+            t_build = time.perf_counter() - t0
+            note(f"[gauge] sharded bundle build {t_build:.1f}s "
+                 f"-> {binfo['buckets']}")
+            if "warm" in binfo["buckets"].values():
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--_mesh_gauge", bpath, str(max_d), str(max_lanes)],
+                    env=dict(os.environ), timeout=300,
+                    stdout=subprocess.PIPE, stderr=sys.stderr)
+                parsed = None
+                for line in reversed(
+                        proc.stdout.decode(errors="replace").splitlines()):
+                    if line.strip().startswith("{"):
+                        parsed = json.loads(line)
+                        break
+                if parsed and parsed.get("seconds") is not None:
+                    first = {
+                        "key": parsed.get("key"),
+                        "build_s": round(t_build, 2),
+                        "fresh_process_first_dispatch_s":
+                            round(parsed["seconds"], 4),
+                        "warm": bool(parsed["seconds"] < 1.0),
+                        "bucket_statuses": parsed.get("buckets"),
+                    }
+                    note(f"[gauge] fresh-process first dispatch "
+                         f"{parsed['seconds'] * 1e3:.1f}ms via "
+                         f"{parsed.get('key')}")
+    set_mesh(1)
+
+    top = weak[-1]
+    doc = {
+        "metric": ("sharded SPMD verify: full-mesh sigs/s, ONE dispatch "
+                   f"over {max_d} devices (weak-scaling workload)"),
+        "value": top["sigs_per_s"],
+        "unit": "sigs/s",
+        # the invariant every backend must hold: sharded >= single-device
+        # throughput at equal work (>1 = sharding helps outright)
+        "vs_baseline": round(
+            guard["single_p50_ms"] / guard["sharded_p50_ms"], 3),
+        "weak_scaling": weak,
+        "window": window,
+        "equal_work_guard": guard,
+        "commit10k": commit,
+        "first_dispatch": first,
+        "devices_visible": ndev,
+        "per_device_lanes": per_dev,
+        "reps": reps,
+        "projection": (
+            "CPU host-device emulation shares the box's cores, so "
+            "per-dispatch latency cannot drop with mesh width here; "
+            "project chip throughput as single-device sigs/s x mesh "
+            "width (lanes independent, RLC fold crosses O(windows) "
+            "points), then confirm on hardware with this same mode."),
+        "backend": backend,
+    }
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc), flush=True)
+    if not guard["ok"]:
+        note("EQUAL-WORK GUARD FAILED: sharded slower than single")
+        sys.exit(3)
+
+
+def _mesh_gauge_child(path: str, nd: int, lanes: int) -> None:
+    """Fresh-process half of the mesh bench's first-dispatch proof."""
+    import dataclasses
+
+    from cometbft_tpu.jaxenv import enable_compile_cache, harden_cpu_pinned_env
+
+    harden_cpu_pinned_env()
+    enable_compile_cache()
+
+    from cometbft_tpu.crypto import aotbundle
+    from cometbft_tpu.crypto import plan as deviceplan
+    from cometbft_tpu.libs import metrics
+
+    plan = dataclasses.replace(
+        deviceplan.DevicePlan(), warm_kinds=("rlc",), warm_tables=(),
+        warm_merkle=(lanes,), warm_lanes=(lanes,), warm_blocks=(2,),
+        mesh_shape=(nd,))
+    info = aotbundle.load(path=path, plan=plan)
+    # prefer the production rlc executable; fall back to the merkle
+    # bucket when rlc hit the fresh-process deserialize quirk (its
+    # status then reads degraded:deserialize — reported upstream)
+    candidates = (
+        (f"rlc:{lanes}x2@m{nd}", deviceplan.CompileBucket("rlc", lanes, 2)),
+        (f"merkle_level:{lanes}@m{nd}",
+         deviceplan.CompileBucket("merkle_level", lanes)),
+    )
+    hit, secs = None, None
+    if info["status"] == "loaded":
+        for key, bucket in candidates:
+            if info["buckets"].get(key) != "warm":
+                continue
+            aotbundle.timed_call(key, *aotbundle.sample_args(bucket))
+            g = metrics.gauge("crypto_kernel_first_dispatch_seconds", "")
+            hit = key
+            secs = g.value(kind=bucket.kind, lanes=str(lanes))
+            break
+    print(json.dumps({"loaded": info["status"] == "loaded", "key": hit,
+                      "seconds": secs, "buckets": info.get("buckets")}),
+          flush=True)
 
 
 def _child_node(rate: float, duration_s: float, tx_size: int) -> None:
@@ -1627,6 +1945,10 @@ def _child_main(backend: str, nsig: int) -> None:
                                  int(os.environ.get("BENCH_VALS", "256")),
                                  int(os.environ.get("BENCH_DUP_K", "3")),
                                  int(os.environ.get("BENCH_SLOTS", "4")))
+    if mode == "mesh":
+        return _child_mesh(backend, os.environ.get(
+            "BENCH_OUT", os.path.join(REPO, "docs", "bench",
+                                      f"r19-mesh-{backend}.json")))
 
     def note(msg):
         print(f"[bench:{backend}] {msg}", file=sys.stderr, flush=True)
@@ -1922,6 +2244,7 @@ def main() -> None:
         "mempool": ("mempool admission+recheck throughput", "tx/s"),
         "statesync": ("statesync fabric: warm chunks/s served",
                       "chunks/s"),
+        "mesh": ("sharded SPMD verify, full-mesh sigs/s", "sigs/s"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,
@@ -1935,6 +2258,9 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--_child":
         _child_main(sys.argv[2], int(sys.argv[3]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--_mesh_gauge":
+        # fresh-process half of `--mode mesh`'s first-dispatch proof
+        _mesh_gauge_child(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
     else:
         # `--mode X` is sugar for BENCH_MODE=X (the env var wins if both
         # are set, matching every other BENCH_* knob)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 
+from ..crypto import plan as deviceplan
 from ..libs import aio, clock
 
 import msgpack
@@ -275,11 +276,16 @@ class BlocksyncReactor(Reactor):
         apply (the header lies or the chain advanced validators); at
         skip>0 the same mismatch is just the rotation boundary the next
         loop iteration handles with fresh state."""
-        window = self.pool.peek_window(
-            skip + self.verify_window + 1)[skip:]
+        state = self.state
+        # mesh-aware window depth: with a device mesh active, one staged
+        # window should fill the WHOLE mesh in a single sharded dispatch
+        # — snap the block count up so window_lanes ~= mesh x lane_bucket
+        # (plan.window_blocks; the base verify_window stands off-mesh)
+        blocks = deviceplan.window_blocks(
+            self.verify_window, len(state.validators.validators))
+        window = self.pool.peek_window(skip + blocks + 1)[skip:]
         if len(window) < 2:
             return None
-        state = self.state
         vals_hash = state.validators.hash()
         raw = []                 # (block, vouching commit, ext)
         for i in range(len(window) - 1):

@@ -96,13 +96,17 @@ def bundle_version(plan=None) -> str:
     return hashlib.sha256(doc.encode()).hexdigest()[:16]
 
 
-def default_path(dir_: str | None = None) -> str:
-    """Bundle location: ``<dir>/bundle-<version>.aot`` (one file per
-    fingerprint, so a jax upgrade builds beside the old bundle instead
-    of clobbering it).  Default dir sits next to the persistent XLA
-    cache."""
+def default_path(dir_: str | None = None, plan=None) -> str:
+    """Bundle location: ``<dir>/bundle-<version>[-m<D>].aot`` (one file
+    per fingerprint, so a jax upgrade builds beside the old bundle
+    instead of clobbering it; a mesh tag keeps sharded bundles beside
+    the single-device one — the plan hash deliberately excludes the
+    mesh shape).  Default dir sits next to the persistent XLA cache."""
     base = dir_ or os.path.join(_REPO, ".jax_cache", "aot")
-    return os.path.join(base, f"bundle-{bundle_version()}.aot")
+    plan = plan or _plan.active()
+    nd = _plan.mesh_size(plan)
+    tag = f"-m{nd}" if nd > 1 else ""
+    return os.path.join(base, f"bundle-{bundle_version(plan)}{tag}.aot")
 
 
 # --------------------------------------------------------------- samples
@@ -199,33 +203,60 @@ def build(plan=None, kinds: tuple | None = None, path: str | None = None,
 
     plan = plan or _plan.active()
     _b._jit_env()
+    nd = _plan.mesh_size(plan)
+    mesh_devices = None
+    if nd > 1:
+        devs = jax.devices()
+        if len(devs) >= nd:
+            mesh_devices = list(devs[:nd])
+        else:
+            _log().warn("plan mesh wider than visible devices; building "
+                        "a single-device bundle", mesh=nd,
+                        devices=len(devs))
+            nd = 1
     buckets = _plan.enumerate_buckets(plan, kinds=kinds)
     entries: dict[str, dict] = {}
     statuses: dict[str, str] = {}
     for bucket in buckets:
-        fn = _kernel_fn(bucket.kind)
-        args = sample_args(bucket)
+        key = bucket.key
         t0 = time.perf_counter()
         try:
-            compiled = jax.jit(fn).lower(*args).compile()
+            if mesh_devices is not None and bucket.kind != "tables":
+                # sharded program over the plan's mesh; the @m<D> key tag
+                # and the header's mesh dims keep it off any other mesh.
+                # ("tables" builds once and replicates, so it stays a
+                # single-device program.)
+                if bucket.lanes % nd:
+                    statuses[key] = "degraded:mesh_divides"
+                    _log().warn("bucket lanes do not divide the mesh; "
+                                "not bundling", bucket=key, mesh=nd)
+                    continue
+                from ..parallel.mesh import sharded_kernel
+
+                key = f"{bucket.key}@m{nd}"
+                jfn = sharded_kernel(bucket.kind, mesh_devices)
+            else:
+                jfn = jax.jit(_kernel_fn(bucket.kind))
+            args = sample_args(bucket)
+            compiled = jfn.lower(*args).compile()
             payload, in_tree, out_tree = se.serialize(compiled)
         except Exception as e:
             _log().error("AOT build failed for bucket; skipping",
-                         bucket=bucket.key, err=repr(e))
-            statuses[bucket.key] = "failed"
+                         bucket=key, err=repr(e))
+            statuses[key] = "degraded:compile"
             continue
         secs = time.perf_counter() - t0
-        _LOADED[bucket.key] = compiled
-        entries[bucket.key] = {
+        _LOADED[key] = compiled
+        entries[key] = {
             "payload": payload,
             "trees": pickle.dumps((in_tree, out_tree)),
             "compile_s": round(secs, 3),
         }
-        statuses[bucket.key] = "warm"
-        _log().info("AOT-compiled bucket", bucket=bucket.key,
+        statuses[key] = "warm"
+        _log().info("AOT-compiled bucket", bucket=key,
                     secs=round(secs, 2))
     version = bundle_version(plan)
-    out_path = path or default_path()
+    out_path = path or default_path(plan=plan)
     if save and entries:
         _save_file(out_path, version, plan, entries)
     return _set_info({
@@ -244,6 +275,10 @@ def _save_file(path: str, version: str, plan, entries: dict) -> None:
         "magic": _MAGIC,
         "format": _FORMAT,
         "version": version,
+        # mesh dims ride OUTSIDE the version hash: a mesh mismatch is
+        # its own staleness reason (a 4-chip executable on an 8-chip
+        # mesh would be silently wrong, not just stale)
+        "mesh": [int(d) for d in plan.mesh_shape],
         "plan": _plan.describe(plan),
         "buckets": entries,
     }
@@ -272,7 +307,7 @@ def load(path: str | None = None, plan=None) -> dict:
     plan = plan or _plan.active()
     gauge, stale = _metrics()
     want = bundle_version(plan)
-    path = path or default_path()
+    path = path or default_path(plan=plan)
     if not os.path.exists(path):
         return _set_info({"status": "absent", "version": want,
                           "path": path, "plan": _plan.describe(plan),
@@ -298,14 +333,30 @@ def load(path: str | None = None, plan=None) -> dict:
         return _set_info({"status": "stale", "version": want,
                           "path": path, "plan": _plan.describe(plan),
                           "buckets": {}})
+    want_mesh = [int(d) for d in plan.mesh_shape]
+    got_mesh = [int(d) for d in (doc.get("mesh") or [])]
+    if got_mesh != want_mesh:
+        # version matches (mesh is deliberately outside the plan hash)
+        # but the executables were sharded for a different mesh: running
+        # them would be WRONG, not slow — degrade to jit compiles
+        stale.inc(reason="mesh")
+        _log().warn("compile bundle mesh mismatch; ignoring",
+                    path=path, bundle_mesh=got_mesh, want=want_mesh)
+        return _set_info({"status": "stale", "version": want,
+                          "path": path, "plan": _plan.describe(plan),
+                          "buckets": {}})
     from jax.experimental import serialize_executable as se
 
     from . import batch as _b
 
     _b._jit_env()
     statuses: dict[str, str] = {}
+    nd = _plan.mesh_size(plan)
     for bucket in _plan.enumerate_buckets(plan):
-        statuses.setdefault(bucket.key, "cold")
+        k = bucket.key
+        if nd > 1 and bucket.kind != "tables":
+            k = f"{k}@m{nd}"
+        statuses.setdefault(k, "cold")
     for key, ent in (doc.get("buckets") or {}).items():
         try:
             in_tree, out_tree = pickle.loads(ent["trees"])
@@ -313,10 +364,14 @@ def load(path: str | None = None, plan=None) -> dict:
                 ent["payload"], in_tree, out_tree)
             statuses[key] = "warm"
         except Exception as e:
+            # per-bucket degrade with a REASON in /status (the r13 CPU
+            # quirk: executables referencing runtime symbols — "Symbols
+            # not found" on the tables kernel — fail cross-process
+            # deserialization while the rest of the bundle is fine)
             stale.inc(reason="bucket")
-            _log().warn("bundle bucket failed to deserialize; skipping",
-                        bucket=key, err=repr(e))
-            statuses[key] = "failed"
+            _log().warn("bundle bucket failed to deserialize; that "
+                        "bucket degrades to jit", bucket=key, err=repr(e))
+            statuses[key] = "degraded:deserialize"
     return _set_info({
         "status": "loaded",
         "version": want,
@@ -365,8 +420,9 @@ def timed_call(key: str, *args):
     except Exception:
         pass
     dt = time.perf_counter() - t0
-    kind = key.split(":")[0]
-    lanes = int(key.split(":")[-1].split("x")[0])
+    base = key.split("@", 1)[0]          # drop any @m<D> mesh tag
+    kind = base.split(":")[0]
+    lanes = int(base.split(":")[-1].split("x")[0])
     from .batch import _note_dispatch
 
     _note_dispatch(kind, lanes, dt)

@@ -163,14 +163,15 @@ def _compiled_verify():
 
 @functools.cache
 def _compiled_verify_sharded(devices: tuple):
-    """Kernel jitted over a 1-D mesh of ``devices`` with every argument
-    sharded on the lane axis (SURVEY §2.10: verification is data-parallel
-    over lanes, so the step is collective-free and scales linearly over
-    ICI).  Cached per device tuple; jit's cache handles shapes."""
-    from ..parallel.mesh import batch_mesh, sharded_verify_fn
+    """ONE sharded program of the verify kernel over a 1-D mesh of
+    ``devices`` (SURVEY §2.10: verification is data-parallel over lanes,
+    so the step is collective-free and scales linearly over ICI).
+    Shardings + donation come from the plan's labels via the mesh
+    authority.  Cached per device tuple; jit's cache handles shapes."""
+    from ..parallel.mesh import sharded_kernel
 
     _jit_env()
-    return sharded_verify_fn(batch_mesh(list(devices)))
+    return sharded_kernel("verify", list(devices))
 
 
 def _jit_env():
@@ -225,27 +226,19 @@ def _compiled_rlc_sharded(devices: tuple):
     add_cc tree folds the D partials, one chip-replicated ladder
     finishes — O(windows) cross-chip points per verdict (the reduction
     the old single-device gate forbade)."""
-    import jax
-
-    from ..ops import rlc as _r
-    from ..parallel.mesh import batch_mesh
+    from ..parallel.mesh import sharded_kernel
 
     _jit_env()
-    return jax.jit(_r.make_verify_batch_rlc_sharded(
-        batch_mesh(list(devices))))
+    return sharded_kernel("rlc", list(devices))
 
 
 @functools.cache
 def _compiled_rlc_gather_sharded(devices: tuple):
     """Sharded RLC through a replicated cached valset table."""
-    import jax
-
-    from ..ops import rlc as _r
-    from ..parallel.mesh import batch_mesh
+    from ..parallel.mesh import sharded_kernel
 
     _jit_env()
-    return jax.jit(_r.make_verify_batch_rlc_sharded(
-        batch_mesh(list(devices)), gather=True))
+    return sharded_kernel("rlc_gather", list(devices))
 
 
 # RLC dispatch threshold: batches with at least this many ed25519 lanes
@@ -294,17 +287,9 @@ def _compiled_verify_gather(devices: tuple):
     _jit_env()
     if len(devices) <= 1:
         return jax.jit(_kernel.verify_padded_gather)
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..parallel.mesh import sharded_kernel
 
-    from ..parallel.mesh import batch_mesh
-
-    mesh = batch_mesh(list(devices))
-    lane = NamedSharding(mesh, P("batch"))
-    repl = NamedSharding(mesh, P())
-    return jax.jit(
-        _kernel.verify_padded_gather,
-        in_shardings=(repl, repl, lane, lane, lane, lane, lane),
-        out_shardings=lane)
+    return sharded_kernel("gather", list(devices))
 
 
 # Whole-validator-set device tables, keyed by the identity of the
@@ -386,7 +371,9 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
     tab, ok, n_pad = _valset_tables(valset_pubs, devices)
     place = _single_device_place(device, devices)
     results = np.zeros((b,), bool)
-    cap = _plan.active().lane_buckets[-1]
+    # a mesh multiplies the chunk cap: one sharded dispatch carries a
+    # cap-sized lane slab per device
+    cap = _plan.active().lane_buckets[-1] * max(1, len(devices))
     for start in range(0, b, cap):
         end = min(start + cap, b)
         c = end - start
@@ -398,13 +385,17 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
         idx[:c] = np.asarray(scope[sl], np.int32)
         idx[c:] = idx[0]
         nb_blocks = blocks.shape[1]
+        _note_mesh(devices, c, bb)
         if c >= _rlc_min_lanes():
             # steady-state fast path: one RLC verdict over the cached
             # tables (lane-sharded over a multi-chip mesh); a reject
             # falls through to per-lane localization
             rl_args = (idx, r32, s32, blocks, active, _rlc_args(bb, c))
             if len(devices) > 1:
-                rfn = _compiled_rlc_gather_sharded(devices)
+                rfn = _aot_fn_mesh(f"rlc_gather:{n_pad}", bb, nb_blocks,
+                                   devices)
+                if rfn is None:
+                    rfn = _compiled_rlc_gather_sharded(devices)
                 rkind = "rlc_gather_sharded"
             else:
                 rkind = "rlc_gather"
@@ -423,7 +414,9 @@ def device_verify_ed25519_cached(valset_pubs, scope, pubs_rows, rs, ss,
                 continue
         lane_args = (idx, r32, s32, blocks, active)
         if len(devices) > 1:
-            fn = _compiled_verify_gather(devices)
+            fn = _aot_fn_mesh(f"gather:{n_pad}", bb, nb_blocks, devices)
+            if fn is None:
+                fn = _compiled_verify_gather(devices)
         else:
             fn = _aot_fn(f"gather:{n_pad}", bb, nb_blocks, place)
             if fn is None:
@@ -532,8 +525,10 @@ def device_verify_ed25519(pubs: np.ndarray, rs: np.ndarray, ss: np.ndarray,
     if b == 0:
         return np.zeros((0,), bool)
     results = np.zeros((b,), bool)
-    # chunk anything beyond the largest bucket
-    cap = _plan.active().lane_buckets[-1]
+    # chunk anything beyond the largest bucket; a mesh multiplies the
+    # cap — one sharded dispatch carries a cap-sized slab per device
+    cap = _plan.active().lane_buckets[-1] * \
+        max(1, len(_resolve_devices(device)))
     for start in range(0, b, cap):
         end = min(start + cap, b)
         results[start:end] = _device_verify_chunk(
@@ -597,25 +592,41 @@ def _aot_fn(kind: str, bb: int, nb: int, place):
     return _aot.lookup(f"{kind}:{bb}x{nb}")
 
 
+def _aot_fn_mesh(kind: str, bb: int, nb: int, devices: tuple):
+    """AOT compile-bundle consult for a SHARDED dispatch: bundle keys
+    carry an ``@m<D>`` mesh tag (and the bundle header records the mesh
+    shape), so a serialized 4-device executable can never run on 8."""
+    from . import aotbundle as _aot
+
+    return _aot.lookup(f"{kind}:{bb}x{nb}@m{len(devices)}")
+
+
 def _device_verify_chunk(pubs, rs, ss, msgs, msg_lens, device):
     b = pubs.shape[0]
     devices = _resolve_devices(device)
     bb = _chunk_bucket(b, devices)
     args = _padded_lane_args(pubs, rs, ss, msgs, msg_lens, bb)
     nb = args[3].shape[1]           # hash-block bucket of this dispatch
+    _note_mesh(devices, b, bb)
     if len(devices) > 1:
-        # production multi-chip path: lane-sharded RLC verdict first
-        # (device-local partial sums, O(windows) cross-chip points), per
-        # lane sharded jit to localize a rejection
+        # production multi-chip path: ONE lane-sharded dispatch over the
+        # mesh (no per-device fan-out) — RLC verdict first (device-local
+        # partial sums, O(windows) cross-chip points), per-lane sharded
+        # program to localize a rejection
         if b >= _rlc_min_lanes():
             rargs = args + (_rlc_args(bb, b),)
+            rfn = _aot_fn_mesh("rlc", bb, nb, devices)
+            if rfn is None:
+                rfn = _compiled_rlc_sharded(devices)
             t0 = time.perf_counter()
-            verdict = bool(np.asarray(_compiled_rlc_sharded(devices)(*rargs)))
+            verdict = bool(np.asarray(rfn(*rargs)))
             _note_dispatch("rlc_sharded", bb, time.perf_counter() - t0)
             if verdict:
                 _metrics()[1].inc(b, route="device_rlc_sharded")
                 return np.ones((b,), bool)
-        fn = _compiled_verify_sharded(devices)
+        fn = _aot_fn_mesh("verify", bb, nb, devices)
+        if fn is None:
+            fn = _compiled_verify_sharded(devices)
         t0 = time.perf_counter()
         out = np.asarray(fn(*args))
         _note_dispatch("verify_sharded", bb, time.perf_counter() - t0)
@@ -659,6 +670,37 @@ def _metrics():
                   "signature lanes verified, by route (device/cpu)"),
         m.counter("crypto_batch_calls_total", "BatchVerifier.verify calls"),
     )
+
+
+@functools.cache
+def _mesh_metrics():
+    """crypto_mesh_*: the sharded-dispatch observability surface — mesh
+    width, how full each sharded dispatch runs, and how often dispatch
+    takes the sharded vs the single-device program."""
+    from ..libs import metrics as m
+
+    return (
+        m.gauge("crypto_mesh_devices",
+                "devices the verify dispatch spans (1 = single-device)"),
+        m.histogram(
+            "crypto_mesh_dispatch_occupancy",
+            "real lanes / padded full-mesh lanes, per sharded dispatch",
+            buckets=(0.25, 0.5, 0.75, 0.85, 0.9, 0.95, 1.0)),
+        m.counter("crypto_mesh_dispatch_total",
+                  "verify dispatches by route (sharded vs single)"),
+    )
+
+
+def _note_mesh(devices: tuple, b: int, bb: int) -> None:
+    """Record one dispatch chunk against the mesh series."""
+    gauge, occ, total = _mesh_metrics()
+    gauge.set(max(1, len(devices)))
+    if len(devices) > 1:
+        total.inc(1, route="sharded")
+        if bb:
+            occ.observe(b / bb)
+    else:
+        total.inc(1, route="single")
 
 
 # -------------------------------------------------- kernel profiling hooks

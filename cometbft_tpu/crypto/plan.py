@@ -83,6 +83,12 @@ class DevicePlan:
     # lands in, so "first real commit" really is warm)
     warm_tables: tuple = ()
     mesh_axis: str = "batch"
+    # explicit device-mesh dims for true SPMD dispatch: () = single-device
+    # (the pre-r19 behavior), (D,) = one sharded program over the first D
+    # visible devices.  Kept OUT of plan_hash so a mesh-shape mismatch is
+    # its own bundle-staleness reason (aotbundle reason="mesh"), distinct
+    # from a plan change.
+    mesh_shape: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -111,6 +117,71 @@ class CompileBucket:
             else:
                 k = f"{self.kind}:{self.lanes}"
             object.__setattr__(self, "key", k)
+
+
+def mesh_size(plan: "DevicePlan | None" = None) -> int:
+    """Devices the plan's mesh spans (1 when no mesh is declared)."""
+    plan = plan or _ACTIVE
+    n = 1
+    for d in plan.mesh_shape:
+        n *= max(1, int(d))
+    return n
+
+
+# Per-kernel sharding labels: which positional argument is lane-sharded
+# over the mesh axis and which is replicated to every device.  This
+# table is the ONE place the argument layout of the sharded programs is
+# declared — parallel/mesh.py turns the labels into NamedShardings and
+# crypto/aotbundle.py compiles from the same source, so a bundle's
+# executable and the live dispatch can never disagree about layout.
+# ``donate`` lists the lane-sharded operands: they are staging copies of
+# host arrays (dispatch always re-transfers from numpy), so the runtime
+# may reuse their device memory for outputs.
+KERNEL_SHARDINGS = {
+    # verify_padded(pub, r, s, msgs, active) -> ok[lane]
+    "verify": {"in": ("lane",) * 5, "out": "lane",
+               "donate": (0, 1, 2, 3, 4)},
+    # rlc(pub, r, s, msgs, active, z10) -> scalar verdict
+    "rlc": {"in": ("lane",) * 6, "out": "repl", "donate": (0, 1, 2, 3, 4)},
+    # gather(tables..., ok_active, idx, r, s, msgs, active) -> ok[lane]
+    # (the Cached table tuple + precomputed ok row are replicated; the
+    # per-lane operands shard)
+    "gather": {"in": ("repl", "repl") + ("lane",) * 5, "out": "lane",
+               "donate": (2, 3, 4, 5, 6)},
+    # rlc_gather(tables..., ok_active, idx, r, s, msgs, active, z10)
+    "rlc_gather": {"in": ("repl", "repl") + ("lane",) * 6, "out": "repl",
+                   "donate": (2, 3, 4, 5, 6)},
+    # merkle_inner_level(left, right) -> parents[lane]
+    "merkle_level": {"in": ("lane", "lane"), "out": "lane",
+                     "donate": (0, 1)},
+}
+
+
+def lane_sharding(mesh):
+    """NamedSharding splitting the leading (lane) axis over the mesh."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+
+
+def replicated_sharding(mesh):
+    """NamedSharding replicating an operand to every mesh device."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def kernel_shardings(kind: str, mesh):
+    """(in_shardings, out_shardings, donate_argnums) for ``kind`` on
+    ``mesh``, realized from :data:`KERNEL_SHARDINGS`.  Single-entry
+    labels expand per positional argument; jit broadcasts a sharding
+    over pytree leaves (the Cached table tuple) by prefix matching."""
+    spec = KERNEL_SHARDINGS[kind]
+    lane, repl = lane_sharding(mesh), replicated_sharding(mesh)
+    pick = {"lane": lane, "repl": repl}
+    ins = tuple(pick[label] for label in spec["in"])
+    out = pick[spec["out"]]
+    return ins, out, spec["donate"]
 
 
 _ACTIVE = DevicePlan()
@@ -165,8 +236,11 @@ def set_devices(devices) -> None:
 
 def resolve_devices(device) -> tuple:
     """Devices a batch should run on: an explicit single device wins,
-    then the configured set, else all visible accelerator chips (so a
-    multi-chip host shards automatically).  Empty tuple = jit default."""
+    then the configured set, then the plan's declared mesh shape (the
+    first ``mesh_size`` visible devices — CPU host-device emulation
+    included, which is how CI exercises the sharded path), else all
+    visible accelerator chips (so a multi-chip host shards
+    automatically).  Empty tuple = jit default."""
     if device is not None:
         return (device,)
     if _DEVICES is not None:
@@ -174,6 +248,11 @@ def resolve_devices(device) -> tuple:
     try:
         import jax
 
+        n = mesh_size(_ACTIVE)
+        if n > 1:
+            devs = tuple(jax.devices())
+            if len(devs) >= n:
+                return devs[:n]
         accels = tuple(d for d in jax.devices() if d.platform != "cpu")
         return accels if len(accels) > 1 else ()
     except Exception:
@@ -220,10 +299,18 @@ def buckets_for_batch(n: int) -> tuple:
 def chunk_bucket(b: int, devices: tuple) -> int:
     """Lane bucket for a dispatch chunk: next size bucket, rounded up so
     each chip of a mesh takes an equal contiguous slab (power-of-two
-    buckets already divide power-of-two meshes)."""
-    bb = bucket(b, _ACTIVE.lane_buckets)
-    if len(devices) > 1:
-        bb += (-bb) % len(devices)
+    buckets already divide power-of-two meshes).  Past the single-device
+    lane cap — a multi-device dispatch chunks at ``cap x mesh`` — the
+    global shape is the per-device bucket times the mesh, so every shard
+    is itself a compiled bucket shape."""
+    lanes = _ACTIVE.lane_buckets
+    nd = len(devices)
+    if nd > 1 and b > lanes[-1]:
+        per = bucket((b + nd - 1) // nd, lanes)
+        return per * nd
+    bb = bucket(b, lanes)
+    if nd > 1:
+        bb += (-bb) % nd
     return bb
 
 
@@ -251,13 +338,36 @@ def mesh_occupancy(n_lanes: int, n_devices: int = 1) -> float:
     the mesh size), so occupancy = real lanes / padded lanes."""
     if n_lanes <= 0:
         return 0.0
-    devices = tuple(range(max(1, int(n_devices))))
-    cap = _ACTIVE.lane_buckets[-1]
+    n_devices = max(1, int(n_devices))
+    devices = tuple(range(n_devices))
+    # a mesh widens the chunk cap: one sharded dispatch carries a
+    # cap-sized slab PER DEVICE, and occupancy is judged against the
+    # full-mesh padded shape (not per device)
+    cap = _ACTIVE.lane_buckets[-1] * n_devices
     padded = 0
     for start in range(0, n_lanes, cap):
         c = min(start + cap, n_lanes) - start
         padded += chunk_bucket(c, devices if n_devices > 1 else ())
     return n_lanes / padded if padded else 0.0
+
+
+def window_blocks(base_blocks: int, lanes_per_block: int) -> int:
+    """Blocks the blocksync accumulator should stage per verify window
+    so ONE sharded dispatch fills the whole mesh.  Without a mesh the
+    configured window stands.  With one, the window's lane count snaps
+    up to ``mesh_size x lane_bucket``: the per-device share of the base
+    window rounds to its bucket, and the window grows (never shrinks) to
+    the block count whose lanes fill that full-mesh shape."""
+    base_blocks = max(1, int(base_blocks))
+    nd = mesh_size(_ACTIVE)
+    if nd <= 1 or lanes_per_block <= 0:
+        return base_blocks
+    lanes = base_blocks * lanes_per_block
+    per = bucket_for_lanes((lanes + nd - 1) // nd)
+    full = per * nd
+    # snap from BELOW: one block past the full-mesh shape would spill
+    # into a second padded dispatch and halve occupancy
+    return max(base_blocks, full // lanes_per_block)
 
 
 # --------------------------------------------- compile-bucket enumeration
@@ -335,5 +445,7 @@ def describe(plan: DevicePlan | None = None) -> dict:
         "min_device_lanes": _b.TpuBatchVerifier.MIN_DEVICE_LANES,
         "mesh_devices": len(_DEVICES) if _DEVICES is not None else None,
         "mesh_axis": plan.mesh_axis,
+        "mesh_shape": list(plan.mesh_shape),
+        "mesh_size": mesh_size(plan),
         "warm_buckets": [b.key for b in enumerate_buckets(plan)],
     }

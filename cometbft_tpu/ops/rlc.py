@@ -251,7 +251,8 @@ def make_verify_batch_rlc_sharded(mesh, gather: bool = False):
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
-    lane = P("batch")
+    axis = mesh.axis_names[0]
+    lane = P(axis)
     ndev = int(np.asarray(mesh.devices).size)
 
     def _local_sums(tab_or_pub, ok_or_none, *args):
@@ -270,10 +271,10 @@ def make_verify_batch_rlc_sharded(mesh, gather: bool = False):
         return (tuple(c[None] for c in sum_a),
                 tuple(c[None] for c in sum_r), zs[None], ok[None])
 
-    dev3 = P("batch", None, None)
+    dev3 = P(axis, None, None)
     out_specs = ((dev3,) * len(Cached._fields),
-                 (dev3,) * len(Cached._fields), P("batch", None),
-                 P("batch"))
+                 (dev3,) * len(Cached._fields), P(axis, None),
+                 P(axis))
     if gather:
         in_specs = ((P(),) * len(Cached._fields), P(),
                     lane, lane, lane, lane, lane, lane)

@@ -204,7 +204,7 @@ def test_bundle_bad_bucket_payload_skipped(tmp_path):
     before = _stale_counter().value(reason="bucket")
     info = aotbundle.load(path=path, plan=plan)
     assert info["status"] == "loaded"            # header was fine
-    assert info["buckets"]["merkle_level:16"] == "failed"
+    assert info["buckets"]["merkle_level:16"] == "degraded:deserialize"
     assert aotbundle.lookup("merkle_level:16") is None
     assert _stale_counter().value(reason="bucket") == before + 1
 
