@@ -35,6 +35,9 @@ so the driver always records a result.
              through the light/serve.py tier — proofs/s + request p99
              with /status probed throughout, vs the per-proof re-hash
              baseline
+- bls:       the r20 aggregate-commit fast path — BLS aggregate verify
+             (two pairings, O(1) in N) vs the Ed25519 batched dense
+             path over the 100/1k/10k-validator curve, plus wire sizes
 - mesh:      the r19 true-SPMD path — weak-scaling over 1/2/4/8 devices
              (ONE sharded dispatch per bucket), blocksync window
              occupancy, a sharded-vs-single equal-work guard, the
@@ -1889,6 +1892,154 @@ def _child_statesync(out_path: str) -> None:
         raise SystemExit(1)
 
 
+def _child_bls(out_path: str) -> None:
+    """``--mode bls``: the aggregate-commit fast path — at each point of
+    the 100/1k/10k-validator curve, a warm ``VerifyCommitLight`` over an
+    aggregate BLS commit (bitmap decode + complement pubkey fold + two
+    pairings, O(1) in N) against the same call over an Ed25519 dense
+    commit (the production batched host path, O(N)), plus the wire size
+    of both commits.  2% of the cohort is absent so the complement fold
+    does real point arithmetic instead of returning the cached
+    full-cohort sum.
+
+    Headline ``value`` is the 10k-validator speedup; ``vs_baseline`` is
+    that speedup / 10 (the acceptance bar is >= 10x, so > 1 means the
+    bar is met).  The full curve goes to ``out_path``."""
+    from cometbft_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+
+    def note(msg):
+        print(f"[bench:bls] {msg}", file=sys.stderr, flush=True)
+
+    from cometbft_tpu.crypto.bls12381 import aggregate_signatures
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.testing import bls_priv_from_secret
+    from cometbft_tpu.types import codec
+    from cometbft_tpu.types.block_id import BlockID
+    from cometbft_tpu.types.canonical import canonical_vote_sign_bytes
+    from cometbft_tpu.types.commit import (
+        BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_AGGREGATE,
+        BLOCK_ID_FLAG_COMMIT, Commit, CommitSig, signer_bitmap)
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.validation import VerifyCommitLight
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+    from cometbft_tpu.types.vote import PRECOMMIT_TYPE
+
+    chain_id = "bench-bls"
+    height = 7
+    bid = BlockID(b"\x01" * 32, PartSetHeader(1, b"\x02" * 32))
+    reps = int(os.environ.get("BENCH_REPS", "5"))
+    curve_ns = [int(x) for x in os.environ.get(
+        "BENCH_BLS_CURVE", "100,1000,10000").split(",")]
+
+    def warm_min(fn):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    curve = []
+    for n in curve_ns:
+        # ---- aggregate side: all-BLS valset, 2% absent
+        note(f"n={n}: building BLS valset + aggregate commit")
+        privs = [bls_priv_from_secret(b"bench-bls%d" % i) for i in range(n)]
+        vals = ValidatorSet([Validator(p.pub_key(), 10) for p in privs])
+        by_addr = {p.pub_key().address(): p for p in privs}
+        absent = set(range(0, n, 50)) if n >= 100 else set()
+        msg = canonical_vote_sign_bytes(chain_id, PRECOMMIT_TYPE, height,
+                                        0, bid, 0)
+        lanes, signers, sigs = [], [], []
+        for i, v in enumerate(vals.validators):
+            if i in absent:
+                lanes.append(CommitSig(BLOCK_ID_FLAG_ABSENT))
+                continue
+            signers.append(i)
+            sigs.append(by_addr[v.address].sign(msg))
+            lanes.append(CommitSig(BLOCK_ID_FLAG_AGGREGATE, v.address,
+                                   1_000_000 + i, b""))
+        agg_commit = Commit(height, 0, bid, lanes,
+                            aggregate_signatures(sigs, check=False),
+                            signer_bitmap(signers, n))
+        note(f"n={n}: cold aggregate verify (builds the cohort table)")
+        t0 = time.perf_counter()
+        VerifyCommitLight(chain_id, vals, bid, height, agg_commit)
+        bls_cold = time.perf_counter() - t0
+        bls_warm = warm_min(lambda: VerifyCommitLight(
+            chain_id, vals, bid, height, agg_commit))
+
+        # ---- dense side: all-Ed25519 valset, same shape/absentees
+        note(f"n={n}: building Ed25519 valset + dense commit")
+        eprivs = [Ed25519PrivKey.from_secret(b"bench-ed%d" % i)
+                  for i in range(n)]
+        evals = ValidatorSet([Validator(p.pub_key(), 10) for p in eprivs])
+        eby_addr = {p.pub_key().address(): p for p in eprivs}
+        elanes = []
+        for i, v in enumerate(evals.validators):
+            if i in absent:
+                elanes.append(CommitSig(BLOCK_ID_FLAG_ABSENT))
+                continue
+            ts = 1_000_000 + i
+            sb = canonical_vote_sign_bytes(chain_id, PRECOMMIT_TYPE,
+                                           height, 0, bid, ts)
+            elanes.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
+                                    eby_addr[v.address].sign(sb)))
+        ed_commit = Commit(height, 0, bid, elanes)
+        note(f"n={n}: cold dense verify (builds the valset table)")
+        t0 = time.perf_counter()
+        VerifyCommitLight(chain_id, evals, bid, height, ed_commit,
+                          backend="cpu")
+        ed_cold = time.perf_counter() - t0
+        ed_warm = warm_min(lambda: VerifyCommitLight(
+            chain_id, evals, bid, height, ed_commit, backend="cpu"))
+
+        bls_wire = len(codec.pack(agg_commit))
+        ed_wire = len(codec.pack(ed_commit))
+        point = {
+            "n_vals": n,
+            "signers": len(signers),
+            "absent": len(absent),
+            "bls_agg_verify_ms": round(bls_warm * 1e3, 3),
+            "ed25519_batched_ms": round(ed_warm * 1e3, 3),
+            "speedup": round(ed_warm / bls_warm, 2),
+            "bls_wire_bytes": bls_wire,
+            "ed25519_wire_bytes": ed_wire,
+            "wire_reduction": round(ed_wire / bls_wire, 2),
+            "bls_cold_s": round(bls_cold, 3),
+            "ed25519_cold_s": round(ed_cold, 3),
+        }
+        note(f"n={n}: agg {point['bls_agg_verify_ms']}ms vs dense "
+             f"{point['ed25519_batched_ms']}ms -> {point['speedup']}x, "
+             f"wire {bls_wire}B vs {ed_wire}B")
+        curve.append(point)
+
+    head = curve[-1]
+    doc = {"metric": "BLS aggregate-commit verify vs Ed25519 batched "
+                     "dense path (warm VerifyCommitLight, CPU host "
+                     "crypto)",
+           "curve": curve, "backend": "cpu"}
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        note(f"curve -> {out_path}")
+    print(json.dumps({
+        "metric": f"BLS aggregate-commit verify speedup vs Ed25519 "
+                  f"batched path @{head['n_vals']} validators",
+        "value": head["speedup"],
+        "unit": "x",
+        # acceptance bar: >= 10x at 10k validators; > 1 means met
+        "vs_baseline": round(head["speedup"] / 10.0, 2),
+        "bls_agg_verify_ms": head["bls_agg_verify_ms"],
+        "ed25519_batched_ms": head["ed25519_batched_ms"],
+        "wire_reduction": head["wire_reduction"],
+        "curve": curve,
+        "backend": "cpu",
+    }), flush=True)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
     if mode == "mempool":
@@ -1906,6 +2057,11 @@ def _child_main(backend: str, nsig: int) -> None:
             os.environ.get("BENCH_OUT",
                            os.path.join(REPO, "docs", "bench",
                                         "r18-statesync-cpu.json")))
+    if mode == "bls":
+        return _child_bls(
+            os.environ.get("BENCH_OUT",
+                           os.path.join(REPO, "docs", "bench",
+                                        "r20-bls-cpu.json")))
     if mode == "node":
         return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
                            float(os.environ.get("BENCH_DURATION", "20")),
@@ -2142,7 +2298,7 @@ def main() -> None:
     want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
     if os.environ.get("BENCH_MODE") in ("node", "light-serve",
                                         "scenarios", "mempool",
-                                        "statesync"):
+                                        "statesync", "bls"):
         # these children hard-force CPU (full-stack measurements whose
         # bottleneck is the node, not a device leg): skip the
         # accelerator probe and the redundant tpu-labeled attempt
@@ -2245,6 +2401,8 @@ def main() -> None:
         "statesync": ("statesync fabric: warm chunks/s served",
                       "chunks/s"),
         "mesh": ("sharded SPMD verify, full-mesh sigs/s", "sigs/s"),
+        "bls": ("BLS aggregate-commit verify speedup vs Ed25519 "
+                "batched path @10k validators", "x"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

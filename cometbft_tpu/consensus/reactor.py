@@ -103,10 +103,16 @@ def _unpack(raw: bytes) -> dict:
 def votes_from_commit(commit: Commit) -> list[Vote]:
     """Reconstruct precommit Votes from a stored commit so lagging peers
     can be caught up vote-by-vote (reactor.go:646 gossip for earlier
-    heights; Commit.ToVoteSet types/block.go:1134)."""
+    heights; Commit.ToVoteSet types/block.go:1134).
+
+    AGGREGATE lanes are skipped: their individual signatures were folded
+    into ``commit.agg_signature`` and no longer exist — a reconstructed
+    empty-signature vote would only earn the sender a misbehavior report
+    at the receiver.  Aggregated commits catch peers up whole
+    (:meth:`ConsensusReactor._send_catchup_commit`)."""
     out = []
     for i, cs in enumerate(commit.signatures):
-        if cs.is_absent():
+        if cs.is_absent() or cs.is_aggregate():
             continue
         out.append(Vote(
             type=PRECOMMIT_TYPE, height=commit.height, round=commit.round,
@@ -141,6 +147,9 @@ class PeerState:
         self.precommits: dict[int, BitArray] = {}
         self.last_commit_round = -1
         self.last_commit: BitArray | None = None
+        # height of the last whole catch-up commit shipped to this peer
+        # (aggregate catch-up; see _send_catchup_commit)
+        self.commit_sent_height = 0
 
     def apply_new_round_step(self, h: int, r: int, step: int,
                              last_commit_round: int) -> None:
@@ -199,7 +208,7 @@ class PeerState:
 # ------------------------------------------------------------------ reactor
 
 _KNOWN_TAGS = ("nrs", "hv", "nvb", "maj23", "prop", "pol", "part",
-               "vote", "vsb")
+               "vote", "vsb", "commit")
 
 
 class ConsensusReactor(Reactor):
@@ -443,6 +452,10 @@ class ConsensusReactor(Reactor):
                 if m is not None:
                     m.inc()
                 self.cs.feed_vote(vote, peer.id)
+            elif tag == "commit":
+                # whole-commit aggregate catch-up: verification happens
+                # in the state machine (feed_commit -> VerifyCommitLight)
+                self.cs.feed_commit(codec.from_dict(d["c"]), peer.id)
         elif channel_id == VOTE_SET_BITS_CHANNEL:
             if tag == "vsb":
                 bits = _ba_from_wire(d["bits"])
@@ -632,8 +645,27 @@ class ConsensusReactor(Reactor):
         theirs.set_index(idx, True)
         return peer.send(VOTE_CHANNEL, _pack("vote", v=codec.to_dict(vote)))
 
+    def _send_catchup_commit(self, peer, ps: PeerState,
+                             commit: Commit) -> bool:
+        """Ship a whole aggregated stored commit to a lagging peer: the
+        folded lanes cannot be replayed vote-by-vote (their individual
+        signatures no longer exist), so the peer verifies the commit as
+        one unit instead.  Sent once per height, re-offered at a low
+        rng-gated rate so one dropped message cannot strand the peer."""
+        if ps.commit_sent_height == commit.height and \
+                ps.rng.random() >= 0.02:
+            return False
+        if peer.send(VOTE_CHANNEL,
+                     _pack("commit", c=codec.to_dict(commit))):
+            ps.commit_sent_height = commit.height
+            return True
+        return False
+
     def _pick_send_commit_vote(self, peer, ps: PeerState,
                                commit: Commit) -> bool:
+        if commit.has_aggregate() and \
+                self._send_catchup_commit(peer, ps, commit):
+            return True
         votes = votes_from_commit(commit)
         present = BitArray.from_indices(
             len(commit.signatures), [v.validator_index for v in votes])

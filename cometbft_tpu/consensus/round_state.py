@@ -48,6 +48,10 @@ class RoundState:
     votes: object = None                  # HeightVoteSet
     commit_round: int = -1
     last_commit: object = None            # prev height precommits (VoteSet)
+    # whole commit received via aggregate catch-up (Commit): the folded
+    # BLS lanes carry no individual signatures, so a lagging node gets
+    # the verified commit as one unit instead of vote-by-vote
+    decided_commit: object = None
     last_validators: ValidatorSet | None = None
     triggered_timeout_precommit: bool = False
 

@@ -36,7 +36,7 @@ from ..storage.statestore import State
 from ..types import codec
 from ..types import events as ev
 from ..types.block_id import BlockID
-from ..types.commit import ExtendedCommit
+from ..types.commit import Commit, ExtendedCommit
 from ..types.part_set import Part, PartSet
 from ..types.priv_validator import PrivValidator
 from ..types.vote import (PRECOMMIT_TYPE, PREVOTE_TYPE, Proposal, Vote)
@@ -255,6 +255,12 @@ class ConsensusState:
                 return
         self.queue.put_nowait(("vote", vote, peer_id))
 
+    def feed_commit(self, commit: Commit, peer_id: str = "") -> None:
+        """Whole-commit catch-up feed: an aggregated stored commit cannot
+        be replayed vote-by-vote (the folded BLS lanes carry no
+        individual signatures), so the reactor ships it as one unit."""
+        self.queue.put_nowait(("commit", commit, peer_id))
+
     def _submit_prefetch(self, sched, vote: Vote, peer_id: str) -> bool:
         """Fire-and-forget pre-verification of one gossiped vote; the
         vote enters the state queue once the verdict lands (a cache hit
@@ -268,7 +274,10 @@ class ConsensusState:
             if pub is None or self.state is None:
                 return False
             chain_id = self.state.chain_id
-            items = [(vote.sign_bytes(chain_id), vote.signature)]
+            # per-key-type domain: BLS votes sign zero-timestamp bytes,
+            # so a prefetch over the reference bytes could never hit
+            items = [(vote.sign_bytes_for(chain_id, pub.type()),
+                      vote.signature)]
             if vote.extension_signature:
                 items.append((vote.extension_sign_bytes(chain_id),
                               vote.extension_signature))
@@ -448,6 +457,8 @@ class ConsensusState:
             await self._add_proposal_block_part(h, r, part)
         elif kind == "vote":
             await self._try_add_vote(payload, peer)
+        elif kind == "commit":
+            await self._handle_catchup_commit(payload, peer)
 
     # ------------------------------------------------------------------ WAL
 
@@ -474,7 +485,7 @@ class ConsensusState:
                     d = rec["ti"]
                     await self._handle_timeout(TimeoutInfo(
                         d["d"], d["h"], d["r"], d["s"]))
-                elif kind in ("proposal", "part", "vote"):
+                elif kind in ("proposal", "part", "vote", "commit"):
                     await self._handle(kind,
                                        _msg_from_wire(kind, rec["data"]),
                                        rec.get("peer", ""), replay=True)
@@ -708,9 +719,14 @@ class ConsensusState:
                 return None
             from ..types.commit import ExtendedCommitSig
 
+            # agg fields ride along: an aggregated seen commit's folded
+            # lanes have no individual signatures, so the promotion must
+            # keep the aggregate or the next proposal's last_commit would
+            # be unverifiable
             return ExtendedCommit(seen.height, seen.round, seen.block_id,
                                   [ExtendedCommitSig(cs)
-                                   for cs in seen.signatures])
+                                   for cs in seen.signatures],
+                                  seen.agg_signature, seen.agg_signers)
         return None
 
     # ------------------------------------------------------------ proposal rx
@@ -980,8 +996,63 @@ class ConsensusState:
                 self.on_valid_block()
         await self._try_finalize_commit(height)
 
+    async def _handle_catchup_commit(self, commit: Commit,
+                                     peer: str) -> None:
+        """A peer shipped a whole stored commit for our height (aggregate
+        catch-up): the folded BLS lanes carry no individual signatures,
+        so vote-by-vote catch-up can never reach +2/3 from an aggregated
+        commit.  Verify the commit as one unit against this height's
+        validator set and treat its block as decided — the block itself
+        still arrives through normal part gossip."""
+        rs = self.rs
+        if self.state is None or commit is None or \
+                commit.height != rs.height or \
+                rs.decided_commit is not None:
+            return
+        if not commit.has_aggregate():
+            return      # individual commits replay fine vote-by-vote
+        err = commit.validate_basic()
+        if err is not None:
+            raise VoteSetError(f"catch-up commit: {err}")
+        from ..types import validation as tval
+
+        try:
+            tval.VerifyCommitLight(
+                self.state.chain_id, rs.validators, commit.block_id,
+                commit.height, commit, use_cache=False)
+        except Exception as e:
+            raise VoteSetError(f"catch-up commit rejected: {e}") from e
+        rs.decided_commit = commit
+        if rs.step != STEP_COMMIT:
+            # mirror _enter_commit's block bookkeeping, with the commit's
+            # BlockID standing in for the precommit majority
+            rs.step = STEP_COMMIT
+            rs.commit_round = commit.round
+            rs.commit_time_ns = self.now_ns()
+            self._note_round_step()
+            maj = commit.block_id
+            if rs.locked_block is not None and \
+                    rs.locked_block.hash() == maj.hash:
+                rs.proposal_block = rs.locked_block
+                rs.proposal_block_parts = rs.locked_block_parts
+            elif rs.proposal_block is None or \
+                    rs.proposal_block.hash() != maj.hash:
+                if rs.proposal_block_parts is None or \
+                        rs.proposal_block_parts.header() != \
+                        maj.part_set_header:
+                    rs.proposal_block = None
+                    rs.proposal_block_parts = PartSet(maj.part_set_header)
+                    self.on_valid_block()
+        await self._try_finalize_commit(rs.height)
+
     async def _try_finalize_commit(self, height: int) -> None:
         rs = self.rs
+        dc = rs.decided_commit
+        if dc is not None:
+            if rs.proposal_block is not None and \
+                    rs.proposal_block.hash() == dc.block_id.hash:
+                await self._finalize_commit(height)
+            return
         precommits = rs.votes.precommits(rs.commit_round)
         maj, has = precommits.two_thirds_majority()
         if not has or maj is None or maj.is_nil():
@@ -993,8 +1064,6 @@ class ConsensusState:
     async def _finalize_commit(self, height: int) -> None:
         """state.go:1829 — save, WAL EndHeight, apply, advance."""
         rs = self.rs
-        precommits = rs.votes.precommits(rs.commit_round)
-        maj, _ = precommits.two_thirds_majority()
         block, parts = rs.proposal_block, rs.proposal_block_parts
         bid = BlockID(block.hash(), parts.header())
 
@@ -1004,9 +1073,18 @@ class ConsensusState:
 
         fail_point("cs:before-save-block")    # state.go:1867-1936 sites
         if self.block_store.height() < height:
-            ext = precommits.make_extended_commit()
-            self.block_store.save_block_with_extended_commit(
-                block, parts, ext)
+            if rs.decided_commit is not None:
+                # aggregate catch-up: no local precommit votes exist —
+                # save the verified received commit itself, as blocksync
+                # does (the seen-commit promotion in
+                # _last_extended_commit covers proposing from it)
+                self.block_store.save_block(block, parts,
+                                            rs.decided_commit)
+            else:
+                ext = rs.votes.precommits(
+                    rs.commit_round).make_extended_commit()
+                self.block_store.save_block_with_extended_commit(
+                    block, parts, ext)
         fail_point("cs:after-save-block")
         if self.wal is not None and not self._replaying:
             self.wal.write_end_height(height)
@@ -1201,9 +1279,7 @@ class ConsensusState:
 # --------------------------------------------------------- WAL wire helpers
 
 def _msg_to_wire(kind: str, payload):
-    if kind == "proposal":
-        return codec.to_dict(payload)
-    if kind == "vote":
+    if kind in ("proposal", "vote", "commit"):
         return codec.to_dict(payload)
     if kind == "part":
         h, r, part = payload
@@ -1214,7 +1290,7 @@ def _msg_to_wire(kind: str, payload):
 
 
 def _msg_from_wire(kind: str, data):
-    if kind in ("proposal", "vote"):
+    if kind in ("proposal", "vote", "commit"):
         return codec.from_dict(data)
     if kind == "part":
         from ..crypto.merkle import Proof

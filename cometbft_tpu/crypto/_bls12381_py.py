@@ -855,3 +855,133 @@ def verify(pk_raw: bytes, msg: bytes, sig_raw: bytes) -> bool:
     # e(pk, H(m)) == e(g1, sig)  <=>  e(pk, H(m)) * e(-g1, sig) == 1
     f = f12_mul(miller_loop(h, pk), miller_loop(sig, g1_neg(G1)))
     return final_exponentiation(f) == F12_ONE
+
+
+# ----------------------------------------------------------- aggregation
+# Same-message aggregation (draft-irtf-cfrg-bls-signature §2.8/§3.3.4):
+# signatures add in G2, pubkeys add in G1, and FastAggregateVerify is one
+# ordinary verification of the aggregate pair.  The Basic (NUL_) suite is
+# rogue-key-UNSAFE for same-message aggregation on its own; the commit
+# layer requires a proof of possession per BLS validator key (the POP_
+# DST below), which restores safety without changing the vote
+# ciphersuite — see docs/explanation/bls-aggregation.md.
+
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def aggregate_signatures(sigs: list) -> bytes:
+    """Sum of G2 signatures, compressed.  Every input must decode to a
+    non-infinity subgroup point; raises ValueError otherwise (an
+    aggregate built from an unchecked signature would pin rejection on
+    the whole cohort instead of the bad lane)."""
+    if not sigs:
+        raise ValueError("cannot aggregate an empty signature set")
+    acc = None
+    for raw in sigs:
+        pt = g2_decompress(bytes(raw))
+        if pt is None or not g2_in_subgroup(pt):
+            raise ValueError("aggregate input not a valid G2 signature")
+        acc = pt if acc is None else g2_add(acc, pt)
+    return g2_compress(acc)
+
+
+def aggregate_pubkeys(pks: list) -> bytes:
+    """Sum of G1 pubkeys, compressed; same strictness as signatures."""
+    if not pks:
+        raise ValueError("cannot aggregate an empty pubkey set")
+    acc = None
+    for raw in pks:
+        pt = g1_decompress(bytes(raw))
+        if pt is None or not g1_in_subgroup(pt):
+            raise ValueError("aggregate input not a valid G1 pubkey")
+        acc = pt if acc is None else g1_add(acc, pt)
+    return g1_compress(acc)
+
+
+def fast_aggregate_verify(pks: list, msg: bytes, sig_raw: bytes) -> bool:
+    """FastAggregateVerify: all signers signed the SAME msg."""
+    if not pks:
+        return False
+    try:
+        agg_pk = aggregate_pubkeys(pks)
+    except ValueError:
+        return False
+    return verify(agg_pk, msg, sig_raw)
+
+
+def pop_prove(sk: int) -> bytes:
+    """Proof of possession: sign the pubkey bytes under the POP_ DST
+    (draft-irtf-cfrg-bls-signature §3.3.2, blst/blspy-compatible)."""
+    pk_raw = sk_to_pk(sk)
+    return g2_compress(g2_mul(hash_to_g2(pk_raw, DST_POP), sk))
+
+
+def pop_verify(pk_raw: bytes, pop_raw: bytes) -> bool:
+    """PopVerify (§3.3.3): the rogue-key gate every BLS validator key
+    must pass before its votes may fold into an aggregate."""
+    try:
+        pk = g1_decompress(bytes(pk_raw))
+        pop = g2_decompress(bytes(pop_raw))
+    except ValueError:
+        return False
+    if pk is None or pop is None:
+        return False
+    if not g1_in_subgroup(pk) or not g2_in_subgroup(pop):
+        return False
+    h = hash_to_g2(bytes(pk_raw), DST_POP)
+    f = f12_mul(miller_loop(h, pk), miller_loop(pop, g1_neg(G1)))
+    return final_exponentiation(f) == F12_ONE
+
+
+# Affine pubkey tables: the per-valset cache decompresses and
+# subgroup-checks each key ONCE (pk_to_affine); per-commit aggregation is
+# then pure affine adds over x||y big-endian coordinates, and the
+# verifier pays exactly two Miller loops (verify_agg_affine).
+
+def _affine_parse(raw: bytes):
+    raw = bytes(raw)
+    if len(raw) != 96:
+        raise ValueError("affine G1 point must be 96 bytes (x||y)")
+    x = int.from_bytes(raw[:48], "big")
+    y = int.from_bytes(raw[48:], "big")
+    if x >= P or y >= P or not g1_is_on_curve((x, y)):
+        raise ValueError("affine input not on the G1 curve")
+    return (x, y)
+
+
+def pk_to_affine(pk_raw: bytes) -> bytes:
+    """Decompress + subgroup-check a pubkey into x||y affine bytes."""
+    pt = g1_decompress(bytes(pk_raw))
+    if pt is None or not g1_in_subgroup(pt):
+        raise ValueError("not a valid G1 pubkey")
+    x, y = pt
+    return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def aggregate_affine(pts: list) -> bytes:
+    """Sum of affine points, as affine bytes; subgroup membership was
+    vouched for by pk_to_affine when the table was built."""
+    if not pts:
+        raise ValueError("cannot aggregate an empty point set")
+    acc = None
+    for raw in pts:
+        acc = g1_add(acc, _affine_parse(raw))
+    if acc is None:
+        raise ValueError("aggregate is the point at infinity")
+    x, y = acc
+    return x.to_bytes(48, "big") + y.to_bytes(48, "big")
+
+
+def verify_agg_affine(xy: bytes, msg: bytes, sig_raw: bytes) -> bool:
+    """Verify an aggregate signature against a pre-aggregated affine
+    pubkey: two Miller loops + one final exponentiation."""
+    try:
+        apk = _affine_parse(xy)
+        sig = g2_decompress(bytes(sig_raw))
+    except ValueError:
+        return False
+    if sig is None or not g2_in_subgroup(sig):
+        return False
+    h = hash_to_g2(msg)
+    f = f12_mul(miller_loop(h, apk), miller_loop(sig, g1_neg(G1)))
+    return final_exponentiation(f) == F12_ONE

@@ -133,6 +133,10 @@ def _kernel_fn(kind: str):
         from ..ops import ed25519 as k
 
         return k.prepare_pubkey_tables
+    if kind == "bls_agg":
+        from ..ops import blsg1 as k
+
+        return k.aggregate_g1_masked
     if kind == "merkle_level":
         from ..ops import sha256 as k
 
@@ -152,6 +156,11 @@ def sample_args(bucket: "_plan.CompileBucket") -> tuple:
         return (row, row)
     if bucket.kind == "tables":
         return (np.zeros((bucket.table_rows, 32), np.int32),)
+    if bucket.kind == "bls_agg":
+        from ..ops import blsg1
+
+        return (np.zeros((bucket.table_rows, 2, blsg1.NLIMB), np.int32),
+                np.zeros((bucket.table_rows,), np.int32))
     from . import batch as _b
 
     bb, nb = bucket.lanes, bucket.blocks
@@ -221,7 +230,8 @@ def build(plan=None, kinds: tuple | None = None, path: str | None = None,
         key = bucket.key
         t0 = time.perf_counter()
         try:
-            if mesh_devices is not None and bucket.kind != "tables":
+            if mesh_devices is not None and bucket.kind not in (
+                    "tables", "bls_agg"):
                 # sharded program over the plan's mesh; the @m<D> key tag
                 # and the header's mesh dims keep it off any other mesh.
                 # ("tables" builds once and replicates, so it stays a
@@ -354,7 +364,7 @@ def load(path: str | None = None, plan=None) -> dict:
     nd = _plan.mesh_size(plan)
     for bucket in _plan.enumerate_buckets(plan):
         k = bucket.key
-        if nd > 1 and bucket.kind != "tables":
+        if nd > 1 and bucket.kind not in ("tables", "bls_agg"):
             k = f"{k}@m{nd}"
         statuses.setdefault(k, "cold")
     for key, ent in (doc.get("buckets") or {}).items():

@@ -57,6 +57,37 @@ class _PyEccBackend:
     def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         return bool(self._impl.Verify(pk, msg, sig))
 
+    # Aggregation is plain group addition — backend-independent math on
+    # standard-suite bytes — so rather than depending on which py_ecc
+    # flavour exposes which Aggregate/_AggregatePKs helper, route it
+    # through the bundled implementation (byte-identical results).
+    def aggregate_signatures(self, sigs, check=True) -> bytes:
+        from . import _bls12381_py as impl
+
+        return impl.aggregate_signatures(list(sigs))
+
+    def aggregate_pubkeys(self, pks, check=True) -> bytes:
+        from . import _bls12381_py as impl
+
+        return impl.aggregate_pubkeys(list(pks))
+
+    def fast_aggregate_verify(self, pks, msg: bytes, sig: bytes) -> bool:
+        try:
+            agg_pk = self.aggregate_pubkeys(pks)
+        except ValueError:
+            return False
+        return self.verify(agg_pk, msg, sig)
+
+    def pop_prove(self, sk: int) -> bytes:
+        from . import _bls12381_py as impl
+
+        return impl.pop_prove(sk)
+
+    def pop_verify(self, pk: bytes, pop: bytes) -> bool:
+        from . import _bls12381_py as impl
+
+        return impl.pop_verify(pk, pop)
+
 
 class _BlspyBackend:
     """Adapter over blspy's BasicSchemeMPL (same ciphersuite)."""
@@ -82,6 +113,44 @@ class _BlspyBackend:
         m = self._mod
         return bool(m.BasicSchemeMPL.verify(
             m.G1Element.from_bytes(pk), msg, m.G2Element.from_bytes(sig)))
+
+    # from_bytes does full validation (decompress + subgroup) in blst, so
+    # the `check` knob is honored implicitly; element `+` is the group op.
+    def aggregate_signatures(self, sigs, check=True) -> bytes:
+        m = self._mod
+        return bytes(m.BasicSchemeMPL.aggregate(
+            [m.G2Element.from_bytes(bytes(s)) for s in sigs]))
+
+    def aggregate_pubkeys(self, pks, check=True) -> bytes:
+        m = self._mod
+        acc = m.G1Element()                      # identity
+        for raw in pks:
+            acc = acc + m.G1Element.from_bytes(bytes(raw))
+        return bytes(acc)
+
+    def fast_aggregate_verify(self, pks, msg: bytes, sig: bytes) -> bool:
+        # NOT PopSchemeMPL.fast_aggregate_verify — that hashes under the
+        # POP_ DST; the repo signs votes under the Basic (NUL_) suite, so
+        # aggregate the pubkeys and verify with BasicSchemeMPL.
+        try:
+            agg_pk = self.aggregate_pubkeys(pks)
+        except Exception:
+            return False
+        return self.verify(agg_pk, msg, sig)
+
+    def pop_prove(self, sk: int) -> bytes:
+        # PopSchemeMPL's possession proof IS the draft's §3.3.2: sign the
+        # pubkey bytes under the POP_ DST — byte-compatible with ours.
+        return bytes(self._mod.PopSchemeMPL.pop_prove(self._sk(sk)))
+
+    def pop_verify(self, pk: bytes, pop: bytes) -> bool:
+        m = self._mod
+        try:
+            return bool(m.PopSchemeMPL.pop_verify(
+                m.G1Element.from_bytes(bytes(pk)),
+                m.G2Element.from_bytes(bytes(pop))))
+        except Exception:
+            return False
 
 
 class _NativeBackend:
@@ -109,6 +178,25 @@ class _NativeBackend:
                                  ctypes.c_size_t, ctypes.c_char_p]
         lib.bls_sk_to_pk.restype = ctypes.c_int
         lib.bls_sk_to_pk.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        for name, argtypes in (
+            ("bls_agg_sigs", [ctypes.c_char_p, ctypes.c_size_t,
+                              ctypes.c_int, ctypes.c_char_p]),
+            ("bls_agg_pks", [ctypes.c_char_p, ctypes.c_size_t,
+                             ctypes.c_int, ctypes.c_char_p]),
+            ("bls_fagg_verify", [ctypes.c_char_p, ctypes.c_size_t,
+                                 ctypes.c_char_p, ctypes.c_size_t,
+                                 ctypes.c_char_p]),
+            ("bls_pk_to_affine", [ctypes.c_char_p, ctypes.c_char_p]),
+            ("bls_agg_affine", [ctypes.c_char_p, ctypes.c_size_t,
+                                ctypes.c_char_p]),
+            ("bls_verify_agg_affine", [ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_size_t, ctypes.c_char_p]),
+            ("bls_pop_prove", [ctypes.c_char_p, ctypes.c_char_p]),
+            ("bls_pop_verify", [ctypes.c_char_p, ctypes.c_char_p]),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int
+            fn.argtypes = argtypes
         lib.bls_selftest.restype = ctypes.c_int
         if lib.bls_selftest() != 1:
             raise RuntimeError("native bls12381 selftest failed")
@@ -135,6 +223,54 @@ class _NativeBackend:
 
     def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         return self._lib.bls_verify(pk, msg, len(msg), sig) == 1
+
+    def aggregate_signatures(self, sigs, check=True) -> bytes:
+        out = self._ctypes.create_string_buffer(SIGNATURE_LENGTH)
+        if self._lib.bls_agg_sigs(b"".join(sigs), len(sigs),
+                                  1 if check else 0, out) != 1:
+            raise ValueError("aggregate input not a valid G2 signature")
+        return out.raw
+
+    def aggregate_pubkeys(self, pks, check=True) -> bytes:
+        out = self._ctypes.create_string_buffer(PUB_KEY_SIZE)
+        if self._lib.bls_agg_pks(b"".join(pks), len(pks),
+                                 1 if check else 0, out) != 1:
+            raise ValueError("aggregate input not a valid G1 pubkey")
+        return out.raw
+
+    def fast_aggregate_verify(self, pks, msg: bytes, sig: bytes) -> bool:
+        return self._lib.bls_fagg_verify(
+            b"".join(pks), len(pks), msg, len(msg), sig) == 1
+
+    def pop_prove(self, sk: int) -> bytes:
+        out = self._ctypes.create_string_buffer(SIGNATURE_LENGTH)
+        self._lib.bls_pop_prove(sk.to_bytes(PRIV_KEY_SIZE, "big"), out)
+        return out.raw
+
+    def pop_verify(self, pk: bytes, pop: bytes) -> bool:
+        return self._lib.bls_pop_verify(pk, pop) == 1
+
+    # affine pubkey-table fast path (see the module-level helpers)
+
+    def pk_to_affine(self, pk: bytes) -> bytes:
+        out = self._ctypes.create_string_buffer(96)
+        if self._lib.bls_pk_to_affine(pk, out) != 1:
+            raise ValueError("not a valid G1 pubkey")
+        return out.raw
+
+    def aggregate_affine(self, pts) -> bytes:
+        out = self._ctypes.create_string_buffer(96)
+        rc = self._lib.bls_agg_affine(b"".join(pts), len(pts), out)
+        if rc == 2:
+            raise ValueError("aggregate is the point at infinity")
+        if rc != 1:
+            raise ValueError("affine input not on the G1 curve"
+                             if pts else
+                             "cannot aggregate an empty point set")
+        return out.raw
+
+    def verify_agg_affine(self, xy: bytes, msg: bytes, sig: bytes) -> bool:
+        return self._lib.bls_verify_agg_affine(xy, msg, len(msg), sig) == 1
 
 
 class _PurePyBackend:
@@ -163,6 +299,30 @@ class _PurePyBackend:
 
     def verify(self, pk: bytes, msg: bytes, sig: bytes) -> bool:
         return self._impl.verify(pk, msg, sig)
+
+    def aggregate_signatures(self, sigs, check=True) -> bytes:
+        return self._impl.aggregate_signatures(list(sigs))
+
+    def aggregate_pubkeys(self, pks, check=True) -> bytes:
+        return self._impl.aggregate_pubkeys(list(pks))
+
+    def fast_aggregate_verify(self, pks, msg: bytes, sig: bytes) -> bool:
+        return self._impl.fast_aggregate_verify(list(pks), msg, sig)
+
+    def pop_prove(self, sk: int) -> bytes:
+        return self._impl.pop_prove(sk)
+
+    def pop_verify(self, pk: bytes, pop: bytes) -> bool:
+        return self._impl.pop_verify(pk, pop)
+
+    def pk_to_affine(self, pk: bytes) -> bytes:
+        return self._impl.pk_to_affine(pk)
+
+    def aggregate_affine(self, pts) -> bytes:
+        return self._impl.aggregate_affine(list(pts))
+
+    def verify_agg_affine(self, xy: bytes, msg: bytes, sig: bytes) -> bool:
+        return self._impl.verify_agg_affine(xy, msg, sig)
 
 
 def _try_blspy():
@@ -279,6 +439,131 @@ def _warn_purepy_signing() -> None:
           file=sys.stderr)
 
 
+# --------------------------------------------------------- aggregation
+# Same-message (FastAggregateVerify) aggregation for the commit fast
+# path: N BLS precommits over identical sign-bytes fold into one G2
+# point, and verification costs two pairings plus a G1 pubkey sum
+# regardless of N.  The Basic suite is rogue-key-UNSAFE under same-
+# message aggregation, so every BLS validator key must carry a proof of
+# possession (pop_prove/pop_verify, POP_ DST) checked at key admission —
+# see docs/explanation/bls-aggregation.md.  Policy (empty-set and
+# duplicate-signer rejection) lives HERE at the module seam; the
+# backends underneath stay purely mathematical.
+
+DST_POP = b"BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+
+def aggregate_signatures(sigs, check: bool = True) -> bytes:
+    """Fold compressed G2 signatures into one.  ``check=False`` skips
+    per-input subgroup checks for inputs that already passed individual
+    verification (e.g. precommits entering a commit)."""
+    sigs = [bytes(s) for s in sigs]
+    if not sigs:
+        raise ValueError("cannot aggregate an empty signature set")
+    for s in sigs:
+        if len(s) != SIGNATURE_LENGTH:
+            raise ValueError(
+                f"signature must be {SIGNATURE_LENGTH} bytes, got {len(s)}")
+    return _BACKEND.aggregate_signatures(sigs, check=check)
+
+
+def aggregate_pubkeys(pks) -> bytes:
+    """Sum compressed G1 pubkeys.  Duplicates are rejected: in the
+    commit path the signer bitmap guarantees distinct validators, so a
+    repeated key can only mean a caller bug or a forged commit."""
+    pks = [bytes(p) for p in pks]
+    if not pks:
+        raise ValueError("cannot aggregate an empty pubkey set")
+    seen = set()
+    for p in pks:
+        if len(p) != PUB_KEY_SIZE:
+            raise ValueError(
+                f"pubkey must be {PUB_KEY_SIZE} bytes, got {len(p)}")
+        if p in seen:
+            raise ValueError("duplicate pubkey in aggregate")
+        seen.add(p)
+    return _BACKEND.aggregate_pubkeys(pks)
+
+
+def fast_aggregate_verify(pks, msg: bytes, sig: bytes) -> bool:
+    """Verify that every pk's holder signed the SAME msg.  Returns False
+    (never raises) on empty sets, duplicate signers, or malformed input."""
+    pks = [bytes(p) for p in pks]
+    if not pks or len(bytes(sig)) != SIGNATURE_LENGTH:
+        return False
+    if any(len(p) != PUB_KEY_SIZE for p in pks):
+        return False
+    if len(set(pks)) != len(pks):
+        return False
+    try:
+        return _BACKEND.fast_aggregate_verify(pks, msg, bytes(sig))
+    except Exception:
+        return False
+
+
+def pop_prove(priv: bytes) -> bytes:
+    """Proof of possession for a raw 32-byte secret key: sign the pubkey
+    bytes under the POP_ DST (draft-irtf-cfrg-bls-signature §3.3.2)."""
+    priv = bytes(priv)
+    if len(priv) != PRIV_KEY_SIZE:
+        raise ValueError(f"privkey must be {PRIV_KEY_SIZE} bytes")
+    return _BACKEND.pop_prove(int.from_bytes(priv, "big"))
+
+
+def pop_verify(pk: bytes, pop: bytes) -> bool:
+    """The rogue-key gate: every BLS validator key must pass this before
+    its votes may fold into an aggregate."""
+    try:
+        return bool(_BACKEND.pop_verify(bytes(pk), bytes(pop)))
+    except Exception:
+        return False
+
+
+def _affine_impl():
+    """Affine-table helpers are internal cache plumbing (not consensus-
+    visible backend behavior), so backends without them borrow the
+    bundled math — byte-identical by construction."""
+    if hasattr(_BACKEND, "pk_to_affine"):
+        return _BACKEND
+    from . import _bls12381_py as impl
+
+    return impl
+
+
+def pk_to_affine(pk: bytes) -> bytes:
+    """Decompress + subgroup-check a pubkey ONCE into 96 x||y bytes; the
+    per-valset cache stores these so per-commit work is pure adds."""
+    return _affine_impl().pk_to_affine(bytes(pk))
+
+
+def aggregate_affine(pts) -> bytes:
+    """Sum affine G1 points (x||y each).  Raises ValueError on malformed
+    input or an infinity sum."""
+    return _affine_impl().aggregate_affine([bytes(p) for p in pts])
+
+
+def negate_affine(xy: bytes) -> bytes:
+    """-P for an affine point: y -> p - y.  Host-side big-int — lets the
+    cached full-cohort sum serve near-full commits as sum - missing."""
+    xy = bytes(xy)
+    if len(xy) != 96:
+        raise ValueError("affine G1 point must be 96 bytes (x||y)")
+    from ._bls12381_py import P as _P
+
+    y = int.from_bytes(xy[48:], "big")
+    return xy[:48] + ((_P - y) % _P).to_bytes(48, "big")
+
+
+def verify_aggregate_affine(xy: bytes, msg: bytes, sig: bytes) -> bool:
+    """Verify an aggregate signature against a pre-aggregated affine
+    pubkey: exactly two pairings."""
+    try:
+        return bool(_affine_impl().verify_agg_affine(
+            bytes(xy), msg, bytes(sig)))
+    except Exception:
+        return False
+
+
 class Bls12381PubKey(PubKey):
     def __init__(self, raw: bytes):
         if len(raw) != PUB_KEY_SIZE:
@@ -322,6 +607,18 @@ class Bls12381PrivKey(PrivKey):
         import os as _os
 
         sk = impl.key_gen(_os.urandom(48))
+        return cls(sk.to_bytes(PRIV_KEY_SIZE, "big"))
+
+    @classmethod
+    def from_secret(cls, secret: bytes) -> "Bls12381PrivKey":
+        """Deterministic test key from a short secret (the BLS analogue
+        of ``Ed25519PrivKey.from_secret``): the secret is padded to the
+        32 bytes of KeyGen IKM entropy RFC 9380's HKDF requires.  Tests
+        and sim genesis only — real keys come from :meth:`generate`."""
+        impl = _BACKEND
+        if impl is None:
+            raise ErrDisabled()
+        sk = impl.key_gen(secret.ljust(48, b"\x9b"))
         return cls(sk.to_bytes(PRIV_KEY_SIZE, "big"))
 
     def bytes(self) -> bytes:

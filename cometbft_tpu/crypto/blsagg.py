@@ -1,0 +1,232 @@
+"""BLS aggregate-commit verification: the orchestrator between
+``types/validation.py`` and the BLS backends/kernels.
+
+The fast path this module owns (ISSUE 18 tentpole): a commit's BLS
+for-block cohort arrives as ONE aggregate G2 signature plus a signer
+bitmap (``types/commit.py``), and verifying it costs two pairings plus a
+G1 pubkey fold — instead of one signature verification per validator.
+The fold is where the time goes at 10k validators, so it is engineered
+like the Ed25519 dense path:
+
+- **Per-valset table** (:func:`valset_table`): every cohort pubkey is
+  decompressed + subgroup-checked ONCE (``bls12381.pk_to_affine``) and
+  cached on the validator set itself (``vals.__dict__['_bls_agg_tbl']``
+  — popped by ``update_with_change_set`` exactly like the dense
+  columns), together with the full-cohort affine sum.
+- **Complement fold**: a healthy commit carries most of the cohort, so
+  the aggregate pubkey is computed as ``full_sum - sum(absentees)``
+  (affine negation is one field subtraction) — O(missing) point
+  additions instead of O(signers).
+- **Device route**: when the plan declares ``bls_agg`` compile buckets
+  (``plan.warm_bls`` / ``plan.bls_buckets``), the masked fold dispatches
+  the ``ops/blsg1`` kernel through the same AOT-bundle lookup and
+  wedge-protected device call as the Ed25519 kernels; any failure or
+  timeout falls back to the host fold.  Default plans declare none —
+  the host fold is already sub-millisecond and the kernel is a
+  multi-minute XLA compile.
+
+Observability: ``crypto_bls_*`` metrics (documented in
+docs/explanation/observability.md) — verify wall time by route, call
+results, lanes folded, table builds.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import NamedTuple
+
+from . import bls12381 as _bls
+
+
+class AggTable(NamedTuple):
+    """Per-valset aggregation table (all derived once, cached on the
+    set): ``affine`` maps cohort valset index -> 96-byte affine pubkey;
+    ``full`` is the whole-cohort affine sum (None when the cohort sums
+    to infinity or is empty); the numpy columns back the vectorized
+    commit checks in types/validation.py — ``cohort_mask`` bool (N,),
+    ``addr_mat`` uint8 (N, 20) with cohort rows filled, ``powers``
+    int64 (N,).  ``neg`` memoizes negated cohort points for the
+    complement fold (filled lazily — absentee churn is tiny between
+    commits, so steady state re-negates almost nothing)."""
+
+    affine: dict
+    full: bytes | None
+    cohort_mask: object
+    addr_mat: object
+    powers: object
+    neg: dict
+
+
+@functools.cache
+def _metrics():
+    from ..libs import metrics as m
+
+    return (
+        m.histogram("crypto_bls_verify_seconds",
+                    "wall time of one aggregate-commit verification "
+                    "(pubkey fold + two pairings), labeled by fold route"),
+        m.counter("crypto_bls_verify_total",
+                  "aggregate-commit verifications by result"),
+        m.counter("crypto_bls_lanes_total",
+                  "commit lanes proven via the aggregate (signatures "
+                  "that never became individual verify lanes)"),
+        m.counter("crypto_bls_table_builds_total",
+                  "per-valset cohort table builds (pk decompress + "
+                  "subgroup check, full-cohort sum)"),
+    )
+
+
+def valset_table(vals) -> AggTable:
+    """The per-valset :class:`AggTable`, built once and cached on the
+    set.  Raises ValueError if a cohort pubkey fails decompression or
+    the subgroup check — such a validator could never have entered a
+    correct valset."""
+    tbl = vals.__dict__.get("_bls_agg_tbl")
+    if tbl is None:
+        import numpy as np
+
+        idx, pks = vals.bls_cohort()
+        affine = {i: _bls.pk_to_affine(pk) for i, pk in zip(idx, pks)}
+        full = None
+        if affine:
+            try:
+                full = _bls.aggregate_affine(list(affine.values()))
+            except ValueError:
+                # a cohort summing to infinity is a (contrived) valid
+                # set; the complement fold just stays unavailable
+                full = None
+        n = vals.size()
+        cohort_mask = np.zeros((n,), np.bool_)
+        addr_mat = np.zeros((n, 20), np.uint8)
+        powers = np.zeros((n,), np.int64)
+        for i, val in enumerate(vals.validators):
+            powers[i] = val.voting_power
+            if i in affine:
+                cohort_mask[i] = True
+                addr_mat[i] = np.frombuffer(val.address, np.uint8)
+        tbl = AggTable(affine, full, cohort_mask, addr_mat, powers, {})
+        vals.__dict__["_bls_agg_tbl"] = tbl
+        _metrics()[3].inc(lanes=str(_lanes_bucket(len(affine))))
+    return tbl
+
+
+def _lanes_bucket(n: int) -> int:
+    from . import plan as _plan
+
+    return _plan.bucket(max(1, n), _plan.active().bls_buckets)
+
+
+def _device_fold(vals, tbl, signer_rows) -> bytes | None:
+    """Masked fold on the accelerator: one ``bls_agg:<rows>`` dispatch
+    over the valset's padded cohort table.  Returns the affine aggregate
+    pubkey, None when the route is unavailable (no bucket declared, no
+    kernel warm, device busy/wedged) or the sum is infinity — callers
+    fall back to the host fold / reject."""
+    from . import aotbundle, batch as _b, plan as _plan
+
+    affine = tbl.affine
+    if not _plan.active().warm_bls:
+        return None
+    rows = _lanes_bucket(len(affine))
+    key = f"bls_agg:{rows}"
+    fn = aotbundle.lookup(key)
+    if fn is None:
+        return None
+    import numpy as np
+
+    cached = vals.__dict__.get("_bls_dev_tbl")
+    if cached is None or cached[0] != rows:
+        from ..ops import blsg1
+
+        pts = np.zeros((rows, 2, blsg1.NLIMB), np.int32)
+        order = sorted(affine)        # valset index -> table row
+        for r, i in enumerate(order):
+            pts[r] = blsg1.limbs_from_xy(affine[i])
+        cached = (rows, order, pts)
+        vals.__dict__["_bls_dev_tbl"] = cached
+    _, order, pts = cached
+    row_of = {i: r for r, i in enumerate(order)}
+    mask = np.zeros((rows,), np.int32)
+    for i in signer_rows:
+        mask[row_of[i]] = 1
+
+    t0 = time.perf_counter()
+    out = _b._device_call(lambda: np.asarray(fn(pts, mask)))
+    if out is None:
+        return None
+    _b._note_dispatch("bls_agg", rows, time.perf_counter() - t0)
+    from ..ops import blsg1
+
+    return blsg1.xy_from_projective(out)
+
+
+def verify_commit_aggregate(vals, signer_indices, msg: bytes,
+                            agg_sig: bytes) -> bool:
+    """Verify one commit's aggregate lane block: ``signer_indices`` are
+    valset indices (the decoded bitmap) — either an iterable of ints or
+    a numpy bool mask of shape (valset size,) (the vectorized path in
+    types/validation.py hands the mask straight through, so the hot
+    path never materializes a per-signer Python list).  ``msg`` is the
+    shared zero-timestamp sign bytes, ``agg_sig`` the 96-byte
+    aggregate.  Returns False — never raises — on any crypto failure,
+    including a signer outside the valset's BLS cohort."""
+    import numpy as np
+
+    hist, calls, lanes, _ = _metrics()
+    t0 = time.perf_counter()
+    route = "host"
+    try:
+        tbl = valset_table(vals)
+    except ValueError:
+        calls.inc(result="bad_table")
+        return False
+    affine, full = tbl.affine, tbl.full
+    if isinstance(signer_indices, np.ndarray):
+        mask = signer_indices
+        n_signers = int(mask.sum())
+        if (not n_signers or mask.shape != tbl.cohort_mask.shape
+                or bool((mask & ~tbl.cohort_mask).any())):
+            calls.inc(result="bad_signer")
+            return False
+        signers = None          # materialized lazily, off the hot path
+        missing = [int(i) for i in np.nonzero(tbl.cohort_mask & ~mask)[0]]
+    else:
+        signers = list(signer_indices)
+        n_signers = len(signers)
+        if not signers or any(i not in affine for i in signers):
+            calls.inc(result="bad_signer")
+            return False
+        missing = sorted(set(affine) - set(signers))
+    try:
+        from . import plan as _plan
+
+        if signers is None and _plan.active().warm_bls:
+            signers = [int(i) for i in np.nonzero(mask)[0]]
+        agg_pk = (_device_fold(vals, tbl, signers)
+                  if signers is not None else None)
+        if agg_pk is not None:
+            route = "device"
+        else:
+            if full is not None and len(missing) < n_signers:
+                # complement fold: full-cohort sum minus the absentees
+                neg = tbl.neg
+                for i in missing:
+                    if i not in neg:
+                        neg[i] = _bls.negate_affine(affine[i])
+                pts = [full] + [neg[i] for i in missing]
+            else:
+                if signers is None:
+                    signers = [int(i) for i in np.nonzero(mask)[0]]
+                pts = [affine[i] for i in signers]
+            agg_pk = _bls.aggregate_affine(pts) if len(pts) > 1 else pts[0]
+        ok = _bls.verify_aggregate_affine(agg_pk, msg, agg_sig)
+    except ValueError:
+        # aggregate pubkey is the point at infinity (cancelling cohort)
+        # or a malformed signature: reject, never crash the verify path
+        ok = False
+    hist.observe(time.perf_counter() - t0, route=route)
+    calls.inc(result="ok" if ok else "bad_signature")
+    if ok:
+        lanes.inc(n_signers)
+    return ok

@@ -50,6 +50,10 @@ LANE_BUCKETS = (16, 64, 256, 1024, 2048, 4096)
 TABLE_BUCKETS = LANE_BUCKETS + (8192, 16384, 32768, 65536)
 BLOCK_BUCKETS = (2, 3, 4, 8, 16)
 MERKLE_BUCKETS = (256, 1024, 4096)
+# BLS cohort-table row buckets (ops/blsg1 masked G1 fold): powers of two
+# only — the kernel's tree reduction pads to one anyway, so intermediate
+# sizes would compile distinct shapes for identical work
+BLS_BUCKETS = (16, 64, 256, 1024, 4096, 16384)
 
 
 @dataclass(frozen=True)
@@ -63,6 +67,7 @@ class DevicePlan:
     block_buckets: tuple = BLOCK_BUCKETS
     table_buckets: tuple = TABLE_BUCKETS
     merkle_buckets: tuple = MERKLE_BUCKETS
+    bls_buckets: tuple = BLS_BUCKETS
     # routing thresholds (crypto/batch dispatch):
     rlc_min_lanes: int = 128        # lanes before the one-shot RLC verdict
     min_device_lanes: int = 1       # below: host crypto even with a device
@@ -82,6 +87,12 @@ class DevicePlan:
     # real commit takes (the node wires the bucket its CURRENT valset
     # lands in, so "first real commit" really is warm)
     warm_tables: tuple = ()
+    # BLS aggregation row buckets to bundle (``bls_agg:<rows>`` — the
+    # ops/blsg1 masked cohort fold).  Default EMPTY: the host complement
+    # fold is already sub-millisecond, and each bls_agg shape is a
+    # multi-minute XLA compile; a BLS-heavy deployment opts in with the
+    # bucket its valset cohort lands in.
+    warm_bls: tuple = ()
     mesh_axis: str = "batch"
     # explicit device-mesh dims for true SPMD dispatch: () = single-device
     # (the pre-r19 behavior), (D,) = one sharded program over the first D
@@ -96,7 +107,8 @@ class CompileBucket:
     """One compiled shape the plan implies.  ``key`` is the bundle/
     status identity: ``"<kind>:<lanes>x<blocks>"`` for the plain verify
     kernels, ``"<kind>:<rows>:<lanes>x<blocks>"`` for the cached-table
-    gather kernels, ``"tables:<rows>"`` for the table build, and
+    gather kernels, ``"tables:<rows>"`` for the table build,
+    ``"bls_agg:<rows>"`` for the BLS cohort fold, and
     ``"merkle_level:<lanes>"`` for the tree kernel."""
 
     kind: str
@@ -109,6 +121,8 @@ class CompileBucket:
         if not self.key:
             if self.kind == "tables":
                 k = f"tables:{self.table_rows}"
+            elif self.kind == "bls_agg":
+                k = f"bls_agg:{self.table_rows}"
             elif self.table_rows:
                 k = (f"{self.kind}:{self.table_rows}:"
                      f"{self.lanes}x{self.blocks}")
@@ -384,7 +398,8 @@ def enumerate_buckets(plan: DevicePlan | None = None,
         tuple(plan.warm_kinds)
         + (("merkle_level",) if plan.warm_merkle else ())
         + (("tables", "gather", "rlc_gather") if plan.warm_tables
-           else ()))
+           else ())
+        + (("bls_agg",) if plan.warm_bls else ()))
     out: list[CompileBucket] = []
     for kind in plan.warm_kinds:
         if kind not in want:
@@ -404,6 +419,9 @@ def enumerate_buckets(plan: DevicePlan | None = None,
                 for nb in plan.warm_blocks:
                     out.append(CompileBucket(kind, lanes, nb,
                                              table_rows=rows))
+    if "bls_agg" in want:
+        for rows in plan.warm_bls:
+            out.append(CompileBucket("bls_agg", 0, table_rows=rows))
     if "merkle_level" in want:
         for lanes in (plan.warm_merkle or plan.merkle_buckets):
             out.append(CompileBucket("merkle_level", lanes))
@@ -421,6 +439,7 @@ def plan_hash(plan: DevicePlan | None = None) -> str:
         "block_buckets": list(plan.block_buckets),
         "table_buckets": list(plan.table_buckets),
         "merkle_buckets": list(plan.merkle_buckets),
+        "bls_buckets": list(plan.bls_buckets),
         "rlc_min_lanes": plan.rlc_min_lanes,
         "warm": [b.key for b in enumerate_buckets(plan)],
         "mesh_axis": plan.mesh_axis,
@@ -441,6 +460,7 @@ def describe(plan: DevicePlan | None = None) -> dict:
         "block_buckets": list(plan.block_buckets),
         "table_buckets": list(plan.table_buckets),
         "merkle_buckets": list(plan.merkle_buckets),
+        "bls_buckets": list(plan.bls_buckets),
         "rlc_min_lanes": plan.rlc_min_lanes,
         "min_device_lanes": _b.TpuBatchVerifier.MIN_DEVICE_LANES,
         "mesh_devices": len(_DEVICES) if _DEVICES is not None else None,

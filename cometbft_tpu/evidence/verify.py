@@ -85,8 +85,11 @@ def _verify_duplicate_vote(ev: DuplicateVoteEvidence, chain_id: str,
             f"total power mismatch {ev.total_voting_power} != "
             f"{vals.total_voting_power()}")
     for v in (ev.vote_a, ev.vote_b):
-        if not val.pub_key.verify_signature(v.sign_bytes(chain_id),
-                                            v.signature):
+        # BLS validators sign the zero-timestamp aggregation domain
+        # (types/vote.py sign_bytes_for); Ed25519 the reference encoding
+        if not val.pub_key.verify_signature(
+                v.sign_bytes_for(chain_id, val.pub_key.type()),
+                v.signature):
             raise EvidenceError("invalid vote signature in evidence")
 
 

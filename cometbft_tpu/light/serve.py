@@ -287,9 +287,14 @@ class LightServeTier:
             "canonical": canonical,
             "time_ns": block.header.time_ns,
             # rough retained-size estimate for the byte budget: commit
-            # sigs dominate (~200 B of JSON each); the shared valset
-            # dict is accounted once in its own small LRU
-            "bytes": 2048 + 200 * len(commit.signatures),
+            # sigs dominate (~200 B of JSON each); aggregate lanes carry
+            # no per-lane signature (~70 B addr+ts) and the one shared
+            # aggregate+bitmap is ~300 B; the shared valset dict is
+            # accounted once in its own small LRU
+            "bytes": 2048
+            + sum(70 if cs.is_aggregate() else 200
+                  for cs in commit.signatures)
+            + (300 if commit.agg_signature else 0),
             "light_block": {
                 "header": self._jsonable(block.header),
                 "commit": self._jsonable(commit),

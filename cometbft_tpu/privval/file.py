@@ -128,12 +128,39 @@ class FilePV(PrivValidator):
 
     def save_key(self) -> None:
         pub = self.priv_key.pub_key()
-        _atomic_write_json(self.key_path, {
+        doc = {
             "address": pub.address().hex(),
             "type": pub.type(),
             "pub_key": pub.bytes().hex(),
             "priv_key": self.priv_key.bytes().hex(),
-        })
+        }
+        if pub.type() == "bls12_381":
+            # proof of possession: the rogue-key defense the aggregate
+            # fast path rests on.  Generated once at keygen, persisted
+            # beside the key, published with the pubkey (genesis /
+            # validator updates) and checked at admission.
+            from ..crypto import bls12381 as _bls
+
+            doc["pop"] = _bls.pop_prove(self.priv_key.bytes()).hex()
+        _atomic_write_json(self.key_path, doc)
+
+    def pop(self) -> bytes:
+        """The key's proof of possession (BLS only; b"" otherwise) —
+        read back from the key file when present, derived for legacy
+        key files that predate the field.  An unreadable or corrupt key
+        file raises: silently re-deriving would mask the same IO fault
+        that load() refuses to paper over."""
+        if self.priv_key.type() != "bls12_381":
+            return b""
+        stored = ""
+        if os.path.exists(self.key_path):
+            with open(self.key_path) as f:
+                stored = json.load(f).get("pop", "")
+        if stored:
+            return bytes.fromhex(stored)
+        from ..crypto import bls12381 as _bls
+
+        return _bls.pop_prove(self.priv_key.bytes())
 
     def _check_alive(self) -> None:
         if self._io_failed is not None:
@@ -197,7 +224,12 @@ class FilePV(PrivValidator):
         self._check_bls_backend()
         step = _VOTE_STEP[vote.type]
         same_hrs = self._check_hrs(vote.height, vote.round, step)
-        sb = vote.sign_bytes(chain_id)
+        # sign bytes follow the key type: a BLS validator signs the
+        # zero-timestamp aggregation domain (types/vote.py
+        # sign_bytes_for), so its precommits can fold into the commit's
+        # aggregate.  The sign-state discipline is unchanged — the
+        # stored sign_bytes are whatever was actually signed.
+        sb = vote.sign_bytes_for(chain_id, self.priv_key.type())
         if same_hrs:
             if sb == self.sign_bytes:
                 vote.signature = self.signature

@@ -91,8 +91,8 @@ def _attach_equivocator(node: SimNode, rng: random.Random) -> None:
             dup = replace(vote, block_id=alt, signature=b"",
                           extension=b"", extension_signature=b"",
                           _sb_memo=None)
-            dup.signature = priv.sign(
-                dup.sign_bytes(cs.state.chain_id))
+            dup.signature = priv.sign(dup.sign_bytes_for(
+                cs.state.chain_id, priv.type()))
             orig(dup)
         except Exception:
             pass                    # an attack must never crash its host

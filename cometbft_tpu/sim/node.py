@@ -146,9 +146,20 @@ class SimNode:
 
 
 def make_genesis(n_validators: int, chain_id: str = "sim-net",
-                 secret_prefix: bytes = b"sim-val-") -> \
-        tuple[GenesisDoc, list[MockPV]]:
-    pvs = [MockPV.from_secret(secret_prefix + b"%d" % i)
+                 secret_prefix: bytes = b"sim-val-",
+                 key_types=None) -> tuple[GenesisDoc, list[MockPV]]:
+    """Deterministic genesis + signers.  ``key_types`` mixes key
+    algorithms: a string applies to every validator, a sequence sets
+    validator i's type (shorter sequences pad with ed25519) — BLS
+    validators' precommits then fold into the commit's aggregate lane
+    block exactly as on a production mixed-key net."""
+    if key_types is None:
+        key_types = ()
+    elif isinstance(key_types, str):
+        key_types = (key_types,) * n_validators
+    pvs = [MockPV.from_secret(
+               secret_prefix + b"%d" % i,
+               key_type=(key_types[i] if i < len(key_types) else "ed25519"))
            for i in range(n_validators)]
     doc = GenesisDoc(chain_id=chain_id,
                      validators=[GenesisValidator(pv.get_pub_key(), 10)

@@ -77,6 +77,7 @@ class Scenario:
     faults: list[str] = field(default_factory=list)      # chaos specs, t=0
     link_specs: list[str] = field(default_factory=list)  # transport, t=0
     tuning: SimTuning = field(default_factory=SimTuning)
+    key_types: list[str] = field(default_factory=list)   # per-validator algo
 
     def to_dict(self) -> dict:
         return {"name": self.name, "seed": self.seed,
@@ -86,7 +87,8 @@ class Scenario:
                 "byzantine": {str(k): v for k, v in self.byzantine.items()},
                 "steps": list(self.steps), "faults": list(self.faults),
                 "link_specs": list(self.link_specs),
-                "tuning": self.tuning.to_dict()}
+                "tuning": self.tuning.to_dict(),
+                "key_types": list(self.key_types)}
 
     @classmethod
     def from_dict(cls, d: dict) -> "Scenario":
@@ -101,7 +103,8 @@ class Scenario:
                    faults=list(d.get("faults", [])),
                    link_specs=list(d.get("link_specs", [])),
                    tuning=SimTuning.from_dict(d["tuning"])
-                   if "tuning" in d else SimTuning())
+                   if "tuning" in d else SimTuning(),
+                   key_types=list(d.get("key_types", [])))
 
     def honest_indices(self) -> list[int]:
         return [i for i in range(self.n_nodes) if i not in self.byzantine]
@@ -146,7 +149,8 @@ class _Run:
         for spec in scn.link_specs:
             self.network.apply_spec(spec)
         doc, pvs = make_genesis(scn.n_nodes,
-                                chain_id=f"sim-{scn.name}")
+                                chain_id=f"sim-{scn.name}",
+                                key_types=scn.key_types)
         for i, pv in enumerate(pvs):
             node = await make_sim_node(i, doc, pv, self.network,
                                        tuning=scn.tuning)
@@ -517,6 +521,29 @@ def curated_suite() -> list[Scenario]:
             steps=[
                 {"at": 1.5, "op": "crash", "node": 5},
                 {"at": 4.0, "op": "restore", "node": 5},
+            ]),
+        Scenario(
+            # ISSUE 18 mixed-key lab: half the valset signs BLS (their
+            # precommits fold into the commit's aggregate lane block),
+            # half Ed25519, under a partition, a crash+wipe restart of a
+            # BLS validator, and a BLS equivocator whose duplicate votes
+            # must still become committed evidence.  Fork-free +
+            # replay-identical is the aggregation-correctness acceptance
+            # gate: a domain mix-up between the zero-timestamp fold and
+            # the reference encoding would surface here as a fork or a
+            # stalled chain, not as a unit-test failure.
+            name="bls-mixed-lab-12",
+            seed=1108, n_nodes=12, out_links=3, target_height=6,
+            max_virtual_s=900.0,
+            key_types=["bls12_381" if i % 2 == 0 else "ed25519"
+                       for i in range(12)],
+            byzantine={4: "equivocator"},     # BLS-keyed equivocator
+            steps=[
+                {"at": 1.0, "op": "partition",
+                 "groups": [list(range(4)), list(range(4, 12))]},
+                {"at": 3.0, "op": "heal"},
+                {"at": 4.0, "op": "crash", "node": 2},
+                {"at": 6.0, "op": "restore", "node": 2},
             ]),
         Scenario(
             name="megamix-100",

@@ -66,7 +66,14 @@ class BlockExecutor:
             state.consensus_params.evidence.max_bytes)
         max_data = max_bytes - 2048 if max_bytes > 0 else -1
         txs = self.mempool.reap_max_bytes_max_gas(max_data, max_gas)
-        last_commit = last_ext_commit.to_commit()
+        from ..types.commit import aggregate_commit
+
+        # fold the BLS for-block cohort into the aggregate lane block
+        # (one signature + signer bitmap); deterministic, so every
+        # correct proposer derives the identical last_commit bytes
+        last_commit = aggregate_commit(
+            last_ext_commit.to_commit(),
+            state.last_validators or state.validators)
 
         if height == state.initial_height:
             block_time = max(state.last_block_time_ns + 1, now_ns)

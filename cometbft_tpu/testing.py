@@ -44,12 +44,22 @@ def dense_signature_batch(bsz: int, msg_len: int = 120, seed: int = 7,
     return (pubs, rs, ss, blocks, active), host_items
 
 
+def bls_priv_from_secret(secret: bytes):
+    """Deterministic bls12_381 key for tests/benches (the BLS analog of
+    ``Ed25519PrivKey.from_secret``): RFC 9380 KeyGen over the padded
+    secret, so the same seed yields the same key on every backend."""
+    from .crypto import bls12381 as _bls
+
+    return _bls.Bls12381PrivKey.from_secret(secret)
+
+
 def make_light_chain(n_blocks: int, n_vals: int = 4, *,
                      chain_id: str = "light-chain", power: int = 10,
                      rotate_every: int = 0, seed: bytes = b"lc",
                      base_time_ns: int = 1_700_000_000_000_000_000,
                      block_interval_ns: int = 1_000_000_000,
-                     fork_at: int = 0, fork_skew_ns: int = 0):
+                     fork_at: int = 0, fork_skew_ns: int = 0,
+                     key_types=None):
     """Deterministic signed header chain for light-client tests/benches
     (role of the reference's ``light/helpers_test.go`` genLightBlocks).
 
@@ -60,18 +70,36 @@ def make_light_chain(n_blocks: int, n_vals: int = 4, *,
     f get skewed timestamps: two calls differing only in these args
     share an identical, validly-signed prefix through f and diverge
     from f+1 — a real fork for detector tests (the same validator set
-    double-signs both branches)."""
+    double-signs both branches).
+
+    ``key_types`` mixes key algorithms into the valset: a string applies
+    to every validator, a sequence sets validator i's type (shorter
+    sequences pad with ed25519).  BLS validators sign the zero-timestamp
+    aggregation domain and each commit's BLS cohort is folded into the
+    aggregate lane block (``types/commit.aggregate_commit``), exactly as
+    ``VoteSet.make_commit`` would."""
     from .crypto.keys import Ed25519PrivKey
     from .light.types import LightBlock
     from .types.block_id import BlockID, PartSetHeader
     from .types.canonical import canonical_vote_sign_bytes
-    from .types.commit import (BLOCK_ID_FLAG_COMMIT, Commit, CommitSig)
+    from .types.commit import (BLOCK_ID_FLAG_COMMIT, Commit, CommitSig,
+                               aggregate_commit)
     from .types.header import Header
     from .types.validator_set import Validator, ValidatorSet
     from .types.vote import PRECOMMIT_TYPE
 
-    privs = [Ed25519PrivKey.from_secret(seed + b"%d" % i)
-             for i in range(n_vals)]
+    if key_types is None:
+        key_types = ()
+    elif isinstance(key_types, str):
+        key_types = (key_types,) * n_vals
+
+    def _priv(i: int):
+        kt = key_types[i] if i < len(key_types) else "ed25519"
+        if kt == "bls12_381":
+            return bls_priv_from_secret(seed + b"bls%d" % i)
+        return Ed25519PrivKey.from_secret(seed + b"%d" % i)
+
+    privs = [_priv(i) for i in range(n_vals)]
     by_addr = {p.pub_key().address(): p for p in privs}
     vals = ValidatorSet([Validator(p.pub_key(), power) for p in privs])
     next_fresh = n_vals
@@ -101,11 +129,15 @@ def make_light_chain(n_blocks: int, n_vals: int = 4, *,
         sigs = []
         for v in vals.validators:
             ts = header.time_ns + 1
+            priv = by_addr[v.address]
+            # BLS lanes sign the shared zero-timestamp aggregation
+            # domain (types/vote.py sign_bytes_for)
+            sign_ts = 0 if priv.type() == "bls12_381" else ts
             sb = canonical_vote_sign_bytes(chain_id, PRECOMMIT_TYPE, h, 0,
-                                           bid, ts)
+                                           bid, sign_ts)
             sigs.append(CommitSig(BLOCK_ID_FLAG_COMMIT, v.address, ts,
-                                  by_addr[v.address].sign(sb)))
-        commit = Commit(h, 0, bid, sigs)
+                                  priv.sign(sb)))
+        commit = aggregate_commit(Commit(h, 0, bid, sigs), vals)
         blocks.append(LightBlock(header=header, commit=commit,
                                  validators=vals.copy()))
         vals = next_vals

@@ -48,16 +48,26 @@ def to_dict(obj):
                 "addr": obj.validator_address, "ts": obj.timestamp_ns,
                 "sig": obj.signature}
     if isinstance(obj, Commit):
-        return {"!": t, "h": obj.height, "r": obj.round,
-                "bid": to_dict(obj.block_id),
-                "sigs": [to_dict(s) for s in obj.signatures]}
+        d = {"!": t, "h": obj.height, "r": obj.round,
+             "bid": to_dict(obj.block_id),
+             "sigs": [to_dict(s) for s in obj.signatures]}
+        if obj.agg_signature or obj.agg_signers:
+            # emitted only when present: pure-Ed25519 commit dicts stay
+            # byte-identical to the pre-aggregation codec
+            d["agg"] = obj.agg_signature
+            d["asg"] = obj.agg_signers
+        return d
     if isinstance(obj, ExtendedCommitSig):
         return {"!": t, "cs": to_dict(obj.commit_sig), "ext": obj.extension,
                 "extsig": obj.extension_signature}
     if isinstance(obj, ExtendedCommit):
-        return {"!": t, "h": obj.height, "r": obj.round,
-                "bid": to_dict(obj.block_id),
-                "sigs": [to_dict(s) for s in obj.extended_signatures]}
+        d = {"!": t, "h": obj.height, "r": obj.round,
+             "bid": to_dict(obj.block_id),
+             "sigs": [to_dict(s) for s in obj.extended_signatures]}
+        if obj.agg_signature or obj.agg_signers:
+            d["agg"] = obj.agg_signature
+            d["asg"] = obj.agg_signers
+        return d
     if isinstance(obj, Vote):
         return {"!": t, "t": obj.type, "h": obj.height, "r": obj.round,
                 "bid": to_dict(obj.block_id), "ts": obj.timestamp_ns,
@@ -124,12 +134,14 @@ def from_dict(d):
         return CommitSig(d["flag"], d["addr"], d["ts"], d["sig"])
     if t == "Commit":
         return Commit(d["h"], d["r"], from_dict(d["bid"]),
-                      [from_dict(s) for s in d["sigs"]])
+                      [from_dict(s) for s in d["sigs"]],
+                      d.get("agg", b""), d.get("asg", b""))
     if t == "ExtendedCommitSig":
         return ExtendedCommitSig(from_dict(d["cs"]), d["ext"], d["extsig"])
     if t == "ExtendedCommit":
         return ExtendedCommit(d["h"], d["r"], from_dict(d["bid"]),
-                              [from_dict(s) for s in d["sigs"]])
+                              [from_dict(s) for s in d["sigs"]],
+                              d.get("agg", b""), d.get("asg", b""))
     if t == "Vote":
         return Vote(type=d["t"], height=d["h"], round=d["r"],
                     block_id=from_dict(d["bid"]), timestamp_ns=d["ts"],

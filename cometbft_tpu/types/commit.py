@@ -18,6 +18,43 @@ from .vote import PRECOMMIT_TYPE, Vote
 BLOCK_ID_FLAG_ABSENT = 1
 BLOCK_ID_FLAG_COMMIT = 2
 BLOCK_ID_FLAG_NIL = 3
+# a for-block precommit whose signature was folded into the commit's
+# aggregate (Commit.agg_signature): the lane keeps address + timestamp
+# but carries NO individual signature — the signer bitmap + one G2 point
+# replace the whole cohort's 96-byte lanes
+BLOCK_ID_FLAG_AGGREGATE = 4
+
+# max individual signature size: 64 ed25519, 96 bls12_381 G2
+MAX_SIGNATURE_SIZE = 96
+
+
+def signer_bitmap(indices, n: int) -> bytes:
+    """Aggregate-signer bitmap: bit i (byte i//8, bit i%8, LSB-first)
+    set when validator-set index i signed into the aggregate."""
+    buf = bytearray((n + 7) // 8)
+    for i in indices:
+        if not 0 <= i < n:
+            raise ValueError(f"signer index {i} out of range for {n}")
+        buf[i // 8] |= 1 << (i % 8)
+    return bytes(buf)
+
+
+def bitmap_indices(bitmap: bytes, n: int) -> list[int] | None:
+    """Decode a signer bitmap; None when the length is wrong or a bit
+    beyond n is set (a malformed commit, never a silent truncation)."""
+    if len(bitmap) != (n + 7) // 8:
+        return None
+    out = []
+    for i, byte in enumerate(bitmap):
+        base = i * 8
+        while byte:
+            low = byte & -byte
+            idx = base + low.bit_length() - 1
+            if idx >= n:
+                return None
+            out.append(idx)
+            byte ^= low
+    return out
 
 
 @dataclass
@@ -35,30 +72,41 @@ class CommitSig:
         return self.block_id_flag == BLOCK_ID_FLAG_ABSENT
 
     def is_commit(self) -> bool:
-        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+        return self.block_id_flag in (BLOCK_ID_FLAG_COMMIT,
+                                      BLOCK_ID_FLAG_AGGREGATE)
+
+    def is_aggregate(self) -> bool:
+        return self.block_id_flag == BLOCK_ID_FLAG_AGGREGATE
 
     def for_block(self) -> bool:
-        return self.block_id_flag == BLOCK_ID_FLAG_COMMIT
+        return self.is_commit()
 
     def block_id(self, commit_block_id: BlockID) -> BlockID:
-        """The BlockID this sig actually signed (commit -> the commit's,
-        nil -> nil, absent -> nil)  (types/block.go CommitSig.BlockID)."""
-        if self.block_id_flag == BLOCK_ID_FLAG_COMMIT:
+        """The BlockID this sig actually signed (commit/aggregate -> the
+        commit's, nil -> nil, absent -> nil)
+        (types/block.go CommitSig.BlockID)."""
+        if self.is_commit():
             return commit_block_id
         return BlockID()
 
     def validate_basic(self) -> str | None:
         if self.block_id_flag not in (BLOCK_ID_FLAG_ABSENT,
                                       BLOCK_ID_FLAG_COMMIT,
-                                      BLOCK_ID_FLAG_NIL):
+                                      BLOCK_ID_FLAG_NIL,
+                                      BLOCK_ID_FLAG_AGGREGATE):
             return "unknown block ID flag"
         if self.is_absent():
             if self.validator_address or self.signature:
                 return "absent sig with address/signature"
+        elif self.is_aggregate():
+            if len(self.validator_address) != 20:
+                return "invalid validator address size"
+            if self.signature:
+                return "aggregate lane carries an individual signature"
         else:
             if len(self.validator_address) != 20:
                 return "invalid validator address size"
-            if not self.signature or len(self.signature) > 64:
+            if not self.signature or len(self.signature) > MAX_SIGNATURE_SIZE:
                 return "signature absent or too big"
         return None
 
@@ -76,6 +124,13 @@ class Commit:
     round: int
     block_id: BlockID
     signatures: list[CommitSig] = field(default_factory=list)
+    # BLS aggregate-commit fast path: one compressed G2 signature over the
+    # zero-timestamp canonical precommit, covering exactly the lanes
+    # flagged BLOCK_ID_FLAG_AGGREGATE (agg_signers is their bitmap —
+    # see signer_bitmap).  Empty on pure-Ed25519 commits: wire encoding
+    # and hash are then byte-identical to the pre-aggregation format.
+    agg_signature: bytes = b""
+    agg_signers: bytes = b""
 
     def size(self) -> int:
         return len(self.signatures)
@@ -86,9 +141,44 @@ class Commit:
         verifies.  Uses a per-commit template encoder (only the timestamp
         and the commit-vs-nil block id vary between a commit's sigs)."""
         cs = self.signatures[idx]
-        enc = self._sb_encoder(chain_id,
-                               cs.block_id_flag == BLOCK_ID_FLAG_COMMIT)
+        enc = self._sb_encoder(chain_id, cs.is_commit())
         return enc.sign_bytes(cs.timestamp_ns)
+
+    def vote_sign_bytes_for(self, chain_id: str, idx: int,
+                            key_type: str) -> bytes:
+        """Sign bytes for lane idx as a function of the signer's key
+        type: BLS validators sign the zero-timestamp aggregation domain
+        (Vote.sign_bytes_for), Ed25519 the reference encoding."""
+        cs = self.signatures[idx]
+        enc = self._sb_encoder(chain_id, cs.is_commit())
+        return enc.sign_bytes(0 if key_type == "bls12_381"
+                              else cs.timestamp_ns)
+
+    def aggregate_sign_bytes(self, chain_id: str) -> bytes:
+        """THE message under the aggregate signature: every BLS for-block
+        precommit in this commit signed these exact bytes (canonical
+        precommit for the commit's BlockID, timestamp pinned to zero)."""
+        return self._sb_encoder(chain_id, True).sign_bytes(0)
+
+    def has_aggregate(self) -> bool:
+        """True when this commit carries an aggregate signature or any
+        AGGREGATE-flag lane (cached: commits are immutable once decoded)."""
+        h = self.__dict__.get("_has_agg")
+        if h is None:
+            h = bool(self.agg_signature) or bool(self.agg_signers) or any(
+                cs.block_id_flag == BLOCK_ID_FLAG_AGGREGATE
+                for cs in self.signatures)
+            self.__dict__["_has_agg"] = h
+        return h
+
+    def aggregate_lanes(self) -> list[int]:
+        """Indices of AGGREGATE-flag lanes, in index order (cached)."""
+        lanes = self.__dict__.get("_agg_lanes")
+        if lanes is None:
+            lanes = [i for i, cs in enumerate(self.signatures)
+                     if cs.block_id_flag == BLOCK_ID_FLAG_AGGREGATE]
+            self.__dict__["_agg_lanes"] = lanes
+        return lanes
 
     def __deepcopy__(self, memo):
         # derived caches (_dense_cols, _sb_encoders) must not survive a
@@ -99,7 +189,8 @@ class Commit:
 
         return Commit(self.height, self.round,
                       _copy.deepcopy(self.block_id, memo),
-                      _copy.deepcopy(self.signatures, memo))
+                      _copy.deepcopy(self.signatures, memo),
+                      self.agg_signature, self.agg_signers)
 
     def dense_columns(self):
         """Columnar view for the dense VerifyCommit fast path: ``(flags
@@ -137,7 +228,11 @@ class Commit:
         buf = bytearray(n * 64)
         cols = None
         for i, cs in enumerate(sigs):
-            if cs.block_id_flag == BLOCK_ID_FLAG_ABSENT:
+            # aggregate lanes carry no individual signature — their lane
+            # stays zeroed like an absent one (the aggregate is verified
+            # up front and dense kernels never select flag-4 lanes)
+            if cs.block_id_flag in (BLOCK_ID_FLAG_ABSENT,
+                                    BLOCK_ID_FLAG_AGGREGATE):
                 continue
             if len(cs.signature) != 64:
                 break
@@ -185,8 +280,15 @@ class Commit:
                     validator_index=idx, signature=cs.signature)
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices_fast(
-            [cs.encode() for cs in self.signatures])
+        leaves = [cs.encode() for cs in self.signatures]
+        if self.agg_signature or self.agg_signers:
+            # one extra leaf binds the aggregate signature + bitmap into
+            # the header's commit hash; pure-Ed25519 commits append
+            # nothing, keeping their hashes byte-identical to the
+            # pre-aggregation format
+            leaves.append(wire.field_bytes(1, self.agg_signature)
+                          + wire.field_bytes(2, self.agg_signers))
+        return merkle.hash_from_byte_slices_fast(leaves)
 
     def validate_basic(self) -> str | None:
         if self.height < 0:
@@ -202,6 +304,35 @@ class Commit:
                 err = cs.validate_basic()
                 if err:
                     return f"invalid signature {i}: {err}"
+            err = self._validate_aggregate()
+            if err:
+                return err
+        return None
+
+    def _validate_aggregate(self) -> str | None:
+        """Structural aggregate checks: the bitmap must name exactly the
+        AGGREGATE-flag lanes, and signature/bitmap must come and go
+        together.  Cryptographic verification lives in
+        types/validation.py; this is pure shape."""
+        lanes = self.aggregate_lanes()
+        if not self.agg_signature and not self.agg_signers and not lanes:
+            return None
+        if len(self.agg_signature) != 96:
+            return "aggregate signature must be 96 bytes"
+        if not lanes:
+            return "aggregate signature without aggregate lanes"
+        if len(self.agg_signers) != (len(self.signatures) + 7) // 8:
+            return "malformed aggregate signer bitmap"
+        # one bytes compare against the re-encoded lane set (cached —
+        # commits are immutable once decoded) instead of an O(N) decode
+        # per call; a stray bit beyond the lanes fails the same way a
+        # missing one does
+        expect = self.__dict__.get("_agg_bitmap")
+        if expect is None:
+            expect = signer_bitmap(lanes, len(self.signatures))
+            self.__dict__["_agg_bitmap"] = expect
+        if self.agg_signers != expect:
+            return "aggregate signer bitmap does not match aggregate lanes"
         return None
 
     def encode(self) -> bytes:
@@ -210,7 +341,49 @@ class Commit:
                 + wire.field_message(3, self.block_id.encode(), force=True))
         for cs in self.signatures:
             body += wire.field_message(4, cs.encode(), force=True)
+        body += (wire.field_bytes(5, self.agg_signature)
+                 + wire.field_bytes(6, self.agg_signers))
         return body
+
+
+def aggregate_commit(commit: Commit, val_set) -> Commit:
+    """Fold the BLS for-block cohort of a freshly made commit into one
+    aggregate signature + signer bitmap (the proposer-side half of the
+    fast path; VoteSet.make_commit calls this).  Deterministic — lanes
+    fold in validator-index order — so replays are byte-identical.
+    Cohorts smaller than 2 stay as individual lanes (no wire saving);
+    NIL votes always stay individual (they sign a different message).
+    Ed25519 lanes are untouched."""
+    if commit.has_aggregate():
+        # already folded (a promoted seen commit after catch-up):
+        # re-folding would overwrite the aggregate with a partial one
+        return commit
+    if not val_set.has_bls():
+        return commit
+    cohort = []
+    sigs = []
+    for i, cs in enumerate(commit.signatures):
+        if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+            continue
+        val = val_set.get_by_index(i)
+        if val is None or val.pub_key.type() != "bls12_381":
+            continue
+        cohort.append(i)
+        sigs.append(cs.signature)
+    if len(cohort) < 2:
+        return commit
+    from ..crypto import bls12381 as _bls
+
+    # check=False: every input already passed individual vote
+    # verification on its way into the VoteSet
+    agg = _bls.aggregate_signatures(sigs, check=False)
+    new_sigs = list(commit.signatures)
+    for i in cohort:
+        cs = commit.signatures[i]
+        new_sigs[i] = CommitSig(BLOCK_ID_FLAG_AGGREGATE,
+                                cs.validator_address, cs.timestamp_ns, b"")
+    return Commit(commit.height, commit.round, commit.block_id, new_sigs,
+                  agg, signer_bitmap(cohort, len(new_sigs)))
 
 
 @dataclass
@@ -224,7 +397,7 @@ class ExtendedCommitSig:
         if err:
             return err
         if self.commit_sig.is_commit():
-            if len(self.extension_signature) > 64:
+            if len(self.extension_signature) > MAX_SIGNATURE_SIZE:
                 return "extension signature too big"
         elif self.extension or self.extension_signature:
             return "extension on non-commit vote"
@@ -247,6 +420,12 @@ class ExtendedCommit:
     round: int
     block_id: BlockID
     extended_signatures: list[ExtendedCommitSig] = field(default_factory=list)
+    # carried through when an already-aggregated commit is promoted
+    # (seen-commit path after catch-up): the folded lanes have no
+    # individual signatures, so dropping these would make the commit
+    # unverifiable
+    agg_signature: bytes = b""
+    agg_signers: bytes = b""
 
     def size(self) -> int:
         return len(self.extended_signatures)
@@ -256,7 +435,9 @@ class ExtendedCommit:
         return Commit(height=self.height, round=self.round,
                       block_id=self.block_id,
                       signatures=[e.commit_sig
-                                  for e in self.extended_signatures])
+                                  for e in self.extended_signatures],
+                      agg_signature=self.agg_signature,
+                      agg_signers=self.agg_signers)
 
     def ensure_extensions(self, ext_enabled: bool) -> bool:
         """types/block.go:1154 EnsureExtensions."""

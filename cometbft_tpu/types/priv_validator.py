@@ -29,13 +29,21 @@ class PrivValidator(ABC):
 
 
 class MockPV(PrivValidator):
-    """Unprotected signer for tests (types/priv_validator.go MockPV)."""
+    """Unprotected signer for tests (types/priv_validator.go MockPV).
+    Key-type aware: a bls12_381 key signs votes over the zero-timestamp
+    aggregation domain (``Vote.sign_bytes_for``), so sim networks can
+    mix BLS and Ed25519 validators in one genesis."""
 
-    def __init__(self, priv_key: Ed25519PrivKey | None = None):
+    def __init__(self, priv_key=None):
         self.priv_key = priv_key or Ed25519PrivKey.generate()
 
     @classmethod
-    def from_secret(cls, secret: bytes) -> "MockPV":
+    def from_secret(cls, secret: bytes,
+                    key_type: str = "ed25519") -> "MockPV":
+        if key_type == "bls12_381":
+            from ..crypto import bls12381 as _bls
+
+            return cls(_bls.Bls12381PrivKey.from_secret(secret))
         return cls(Ed25519PrivKey.from_secret(secret))
 
     def get_pub_key(self) -> PubKey:
@@ -43,7 +51,8 @@ class MockPV(PrivValidator):
 
     async def sign_vote(self, chain_id: str, vote: Vote,
                         sign_extension: bool) -> None:
-        vote.signature = self.priv_key.sign(vote.sign_bytes(chain_id))
+        vote.signature = self.priv_key.sign(
+            vote.sign_bytes_for(chain_id, self.priv_key.type()))
         if sign_extension:
             vote.extension_signature = self.priv_key.sign(
                 vote.extension_sign_bytes(chain_id))

@@ -17,6 +17,12 @@ reference's config.Config-driven selection point.  Where the reference
 falls back to one-by-one verification for mixed key types
 (``shouldBatchVerify``), our device verifier routes non-ed25519 lanes to
 CPU inside the batch instead.
+
+Commits carrying a BLS aggregate (``types/commit.py``) verify the whole
+folded cohort up front — two pairings via ``crypto/blsagg``, regardless
+of cohort size — and the per-lane machinery then only sees the Ed25519
+cohort plus any individual BLS lanes (NIL votes sign a different
+message and never fold).
 """
 
 from __future__ import annotations
@@ -70,6 +76,100 @@ def _check_commit_basics(vals: ValidatorSet, commit: Commit, height: int,
         raise ErrInvalidCommit("invalid commit: wrong block ID")
 
 
+def _verify_aggregate(chain_id: str, vals: ValidatorSet, commit: Commit,
+                      *, lookup_by_address: bool) -> tuple[frozenset, int]:
+    """Verify the commit's BLS aggregate lane block up front; the main
+    loop then TALLIES the proven lanes without re-verifying them.
+
+    Returns ``(proven aggregate lane indices, pre-tallied power)``.  The
+    lane set is empty when the commit carries no aggregate, or (trusting
+    path only) when the signer cohort could not be resolved in the
+    trusted set, in which case the aggregate lanes simply contribute no
+    power.  The power is the proven lanes' summed voting power on the
+    index path — where lanes align 1:1 with the valset, so no duplicate
+    bookkeeping is possible and the caller's loop can skip AGGREGATE
+    lanes entirely — and 0 on the trusting path, whose loop still owns
+    the by-address tally and duplicate detection.
+
+    Index path (``lookup_by_address=False``): lanes align with the
+    valset, so the structure is fully checkable — any malformation
+    raises ErrInvalidCommit, a failing aggregate signature raises
+    ErrInvalidSignature on the first aggregate lane.  The structural
+    checks (every lane a cohort member, addresses matching the valset)
+    run vectorized over numpy columns cached per commit (``_agg_np``)
+    and per valset (``blsagg.valset_table``) — at 10k validators the
+    per-lane object loop was costing more than the pairings.
+
+    Trusting path: signers resolve BY ADDRESS into a possibly different
+    trusted set, all-or-nothing.  If every signer resolves to a BLS
+    validator there, the aggregate is verified against those pubkeys
+    (a bad signature then raises — a commit carrying a provably false
+    aggregate is invalid, not merely unproven).  If ANY signer is
+    unknown, the cohort's power cannot be attributed and the whole
+    aggregate is skipped — exactly how the trusting loop skips
+    individual lanes from unknown validators.
+    """
+    if not commit.has_aggregate():
+        return frozenset(), 0
+    err = commit._validate_aggregate()
+    if err:
+        raise ErrInvalidCommit(f"invalid commit: {err}")
+    from ..crypto import blsagg as _blsagg
+
+    lanes = commit.aggregate_lanes()
+    power = 0
+    if not lookup_by_address:
+        import numpy as np
+
+        try:
+            tbl = _blsagg.valset_table(vals)
+        except ValueError:
+            raise ErrInvalidSignature(
+                lanes[0], "invalid BLS cohort pubkey in valset")
+        n = len(commit.signatures)
+        if tbl.cohort_mask.shape[0] != n:
+            raise ErrInvalidCommit(
+                f"invalid commit: {n} sigs for {vals.size()} vals")
+        cached = commit.__dict__.get("_agg_np")
+        if cached is None:
+            mask = np.zeros((n,), np.bool_)
+            lane_addrs = np.zeros((len(lanes), 20), np.uint8)
+            for r, idx in enumerate(lanes):
+                mask[idx] = True
+                addr = commit.signatures[idx].validator_address
+                if len(addr) == 20:
+                    lane_addrs[r] = np.frombuffer(addr, np.uint8)
+            cached = (mask, lane_addrs)
+            commit.__dict__["_agg_np"] = cached
+        mask, lane_addrs = cached
+        stray = mask & ~tbl.cohort_mask
+        if bool(stray.any()):
+            raise ErrInvalidCommit(
+                f"aggregate lane {int(np.nonzero(stray)[0][0])} "
+                "is not a BLS validator")
+        addr_bad = (tbl.addr_mat[mask] != lane_addrs).any(axis=1)
+        if bool(addr_bad.any()):
+            raise ErrInvalidCommit(
+                f"aggregate lane {lanes[int(np.nonzero(addr_bad)[0][0])]} "
+                "address does not match valset")
+        power = int(tbl.powers[mask].sum())
+        signers = mask
+    else:
+        signers = []
+        for idx in lanes:
+            vi, val = vals.get_by_address(
+                commit.signatures[idx].validator_address)
+            if vi < 0 or val.pub_key.type() != "bls12_381":
+                return frozenset(), 0       # unattributable: contributes 0
+            signers.append(vi)
+    if not _blsagg.verify_commit_aggregate(
+            vals, signers, commit.aggregate_sign_bytes(chain_id),
+            commit.agg_signature):
+        raise ErrInvalidSignature(
+            lanes[0], f"wrong aggregate signature (lanes {lanes})")
+    return frozenset(lanes), power
+
+
 def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             voting_power_needed: int, *, count_all: bool,
             verify_nil_sigs: bool, lookup_by_address: bool,
@@ -86,6 +186,19 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     verification never trusts the cache.
     """
     from ..crypto import scheduler as _vsched
+
+    # BLS aggregate lanes verify up front (one pairing check covers the
+    # whole cohort); the loop below only tallies the proven lanes.  The
+    # dense paths never see aggregates: any valset with a BLS member has
+    # vals.dense() None, and each dense core also guards explicitly.
+    agg_proven, agg_power = _verify_aggregate(
+        chain_id, vals, commit, lookup_by_address=lookup_by_address)
+    if (agg_power > voting_power_needed and not count_all
+            and not verify_nil_sigs):
+        # VerifyCommitLight semantics: the proven aggregate alone clears
+        # the threshold, remaining lanes need not be verified — the
+        # O(1)-pairing fast path never enters the per-lane loop at all
+        return
 
     if not lookup_by_address:
         if _dense_verify(chain_id, vals, commit, voting_power_needed,
@@ -105,7 +218,7 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
     lanes: list[int] = []          # commit-sig indices added to the batch
     seeds: list[tuple] = []        # lanes to seed into the cache on success
     cache_on = use_cache and _vsched.cache_active()
-    tally = 0
+    tally = agg_power
     seen: set[bytes] = set()
 
     for idx, cs in enumerate(commit.signatures):
@@ -116,6 +229,15 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             # (validation.go:243-266): a NIL sig then a COMMIT sig from
             # the same address is legal on the trusting path
             continue
+        if cs.is_aggregate():
+            if not lookup_by_address:
+                # index path: pre-tallied into agg_power (every lane is
+                # proven — _verify_aggregate raises otherwise)
+                if not count_all and tally > voting_power_needed:
+                    break
+                continue
+            if idx not in agg_proven:
+                continue   # trusting path, unresolved cohort: no power
         if lookup_by_address:
             vi, val = vals.get_by_address(cs.validator_address)
             if vi < 0:
@@ -126,7 +248,16 @@ def _verify(chain_id: str, vals: ValidatorSet, commit: Commit,
             seen.add(cs.validator_address)
         else:
             val = vals.get_by_index(idx)
-        msg = commit.vote_sign_bytes(chain_id, idx)
+        if cs.is_aggregate():
+            # proven by the up-front aggregate verification: tally only
+            tally += val.voting_power
+            if not count_all and tally > voting_power_needed:
+                break
+            continue
+        # BLS validators' individual lanes (NIL votes, or a cohort too
+        # small to fold) sign the zero-timestamp aggregation domain
+        msg = commit.vote_sign_bytes_for(chain_id, idx,
+                                         val.pub_key.type())
         if cache_on and _vsched.cache_lookup(val.pub_key.bytes(), msg,
                                              cs.signature):
             pass            # verified before (gossip/scheduler): free lane
@@ -194,6 +325,11 @@ def _dense_verify(chain_id: str, vals: ValidatorSet, commit: Commit,
         # no caller uses this combination; the early-exit cumsum below
         # would count nil-vote power toward the threshold (the loop only
         # tallies commit lanes) — refuse rather than miscount
+        return False
+    if commit.has_aggregate():
+        # aggregate lanes tally through the loop path (any valset with a
+        # BLS member has dense() None anyway; this guards the malformed
+        # all-Ed25519-commit-with-aggregate case into the strict loop)
         return False
     dense = vals.dense()
     cols = commit.dense_columns()
@@ -267,6 +403,8 @@ def _dense_verify_trusting(chain_id: str, vals: ValidatorSet,
     from ..crypto import _native_ed25519 as nat
     from .commit import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
 
+    if commit.has_aggregate():
+        return False                   # aggregate lanes: loop path only
     dense = vals.dense()
     cols = commit.dense_columns()
     if dense is None or cols is None or not nat.available():
@@ -483,14 +621,23 @@ def verify_commits_light_batched(chain_id: str, vals: ValidatorSet,
     for k, (block_id, height, commit) in enumerate(items):
         try:
             _check_commit_basics(vals, commit, height, block_id)
+            # index path: raises on any aggregate problem, so every
+            # AGGREGATE lane is proven — its power is pre-tallied
+            _, agg_power = _verify_aggregate(chain_id, vals, commit,
+                                             lookup_by_address=False)
         except CommitVerificationError as e:
             raise ErrBatchItemInvalid(k, height, e) from e
-        tally = 0
+        tally = agg_power
+        if tally > needed:
+            continue       # aggregate alone clears the threshold
         for idx, cs in enumerate(commit.signatures):
             if not cs.is_commit():
                 continue
+            if cs.is_aggregate():
+                continue   # pre-tallied above
             val = vals.get_by_index(idx)
-            msg = commit.vote_sign_bytes(chain_id, idx)
+            msg = commit.vote_sign_bytes_for(chain_id, idx,
+                                             val.pub_key.type())
             if cache_on and _vsched.cache_lookup(val.pub_key.bytes(), msg,
                                                  cs.signature):
                 n_hits += 1            # proven before: free lane
@@ -534,6 +681,8 @@ def _dense_verify_commits_batched(chain_id: str, vals: ValidatorSet,
     dense = vals.dense()
     if dense is None or not nat.available():
         return None
+    if any(item[2].has_aggregate() for item in items):
+        return None                    # aggregate lanes: loop path only
     pubs, powers = dense
     needed = vals.total_voting_power() * 2 // 3
     sel_pubs, sel_sigs, sel_msgs, sel_lens = [], [], [], []

@@ -140,6 +140,26 @@ class ValidatorSet:
         self.__dict__["_dense"] = d
         return d
 
+    def bls_cohort(self) -> tuple:
+        """Cached BLS membership view for the aggregate-commit fast
+        path: ``(indices tuple, pubkeys tuple)`` of validators holding
+        bls12_381 keys, in validator-set index order.  Empty tuples on a
+        pure-Ed25519 set.  Same invalidation discipline as
+        :meth:`dense` (popped by :meth:`update_with_change_set`)."""
+        c = self.__dict__.get("_bls_cohort")
+        if c is None:
+            idx, pks = [], []
+            for i, v in enumerate(self.validators):
+                if v.pub_key.type() == "bls12_381":
+                    idx.append(i)
+                    pks.append(v.pub_key.bytes())
+            c = (tuple(idx), tuple(pks))
+            self.__dict__["_bls_cohort"] = c
+        return c
+
+    def has_bls(self) -> bool:
+        return bool(self.bls_cohort()[0])
+
     def address_index(self) -> dict:
         """Cached address -> row map for the dense trusting path (same
         invalidation discipline as :meth:`dense`)."""
@@ -275,6 +295,8 @@ class ValidatorSet:
         self._total = None
         self.__dict__.pop("_dense", None)     # membership/powers changed
         self.__dict__.pop("_addr_idx", None)
+        self.__dict__.pop("_bls_cohort", None)
+        self.__dict__.pop("_bls_agg_tbl", None)   # crypto/blsagg tables
         self.total_voting_power()
         self._rescale_priorities(
             PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())

@@ -39,6 +39,8 @@ class Vote:
     # vote (privval timestamp adjustment, WAL decode reuse) can never
     # serve stale bytes.  Excluded from equality/repr.
     _sb_memo: tuple | None = field(default=None, compare=False, repr=False)
+    # zero-timestamp variant memo (BLS aggregation domain, sign_bytes_for)
+    _sbz_memo: tuple | None = field(default=None, compare=False, repr=False)
 
     def sign_bytes(self, chain_id: str) -> bytes:
         guard = (chain_id, self.type, self.height, self.round,
@@ -51,6 +53,27 @@ class Vote:
             self.timestamp_ns)
         # plain attribute write: dataclass is not frozen
         object.__setattr__(self, "_sb_memo", (guard, sb))
+        return sb
+
+    def sign_bytes_for(self, chain_id: str, key_type: str) -> bytes:
+        """Sign bytes as a function of the signer's KEY TYPE: BLS keys
+        sign the canonical vote with the timestamp pinned to zero, so
+        every BLS precommit for the same (chain_id, h, r, block) is a
+        signature over ONE message and the cohort folds into a single
+        aggregate (FastAggregateVerify, two pairings).  The CommitSig
+        timestamp stays on the wire but is unauthenticated for BLS
+        lanes; BFT time draws from the Ed25519 cohort.  Ed25519 keys
+        keep the reference encoding unchanged."""
+        if key_type != "bls12_381":
+            return self.sign_bytes(chain_id)
+        guard = (chain_id, self.type, self.height, self.round,
+                 self.block_id, 0)
+        memo = self._sbz_memo
+        if memo is not None and memo[0] == guard:
+            return memo[1]
+        sb = canonical.canonical_vote_sign_bytes(
+            chain_id, self.type, self.height, self.round, self.block_id, 0)
+        object.__setattr__(self, "_sbz_memo", (guard, sb))
         return sb
 
     def extension_sign_bytes(self, chain_id: str) -> bytes:
@@ -76,7 +99,7 @@ class Vote:
             return "negative validator index"
         if not self.signature:
             return "signature is missing"
-        if len(self.signature) > 64:
+        if len(self.signature) > 96:      # 64 ed25519, 96 bls12_381 G2
             return "signature too big"
         if self.type != PRECOMMIT_TYPE and (self.extension or
                                             self.extension_signature):
@@ -85,9 +108,10 @@ class Vote:
 
     def verify(self, chain_id: str, pub_key: PubKey) -> bool:
         """Single-signature verify — the per-gossiped-vote hot path
-        (types/vote.go:235; consensus addVote)."""
-        return pub_key.verify_signature(self.sign_bytes(chain_id),
-                                        self.signature)
+        (types/vote.go:235; consensus addVote).  Sign bytes follow the
+        key type (BLS keys sign the zero-timestamp aggregation domain)."""
+        return pub_key.verify_signature(
+            self.sign_bytes_for(chain_id, pub_key.type()), self.signature)
 
     def verify_vote_and_extension(self, chain_id: str, pub_key: PubKey,
                                   require_extension: bool) -> bool:

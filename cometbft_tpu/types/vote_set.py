@@ -130,9 +130,11 @@ class VoteSet:
 
         check = _vsched.verify_cached if use_cache \
             else _vsched.verify_uncached
+        # sign bytes follow the signer's key type: BLS validators sign
+        # the zero-timestamp aggregation domain (Vote.sign_bytes_for)
+        sb = vote.sign_bytes_for(self.chain_id, val.pub_key.type())
         if self.extensions_enabled and vote.type == PRECOMMIT_TYPE:
-            if not check(val.pub_key, vote.sign_bytes(self.chain_id),
-                         vote.signature):
+            if not check(val.pub_key, sb, vote.signature):
                 return False
             if vote.block_id.is_nil():
                 # nil precommits carry no extension to require
@@ -143,8 +145,7 @@ class VoteSet:
                          vote.extension_signature)
         if vote.extension_signature and not self.extensions_enabled:
             return False
-        return check(val.pub_key, vote.sign_bytes(self.chain_id),
-                     vote.signature)
+        return check(val.pub_key, sb, vote.signature)
 
     def _get_or_make_block_votes(self, block_id: BlockID) -> _BlockVotes:
         key = block_id.key()
@@ -216,8 +217,14 @@ class VoteSet:
     # --------------------------------------------------------------- commit
 
     def make_commit(self) -> Commit:
-        """Commit from a +2/3 precommit set (types/vote_set.go MakeCommit)."""
-        return self.make_extended_commit().to_commit()
+        """Commit from a +2/3 precommit set (types/vote_set.go
+        MakeCommit), with the BLS for-block cohort folded into one
+        aggregate signature + signer bitmap (``aggregate_commit`` — the
+        fold is deterministic, so replays stay byte-identical)."""
+        from .commit import aggregate_commit
+
+        return aggregate_commit(self.make_extended_commit().to_commit(),
+                                self.val_set)
 
     def make_extended_commit(self) -> ExtendedCommit:
         if self.type != PRECOMMIT_TYPE:

@@ -1,0 +1,121 @@
+"""Regenerate tests/vectors/bls12381_conformance.json.
+
+The vector file pins cross-backend BLS12-381 behavior that consensus
+depends on but that a plausible backend could silently get wrong —
+above all the G2/G1 SUBGROUP checks.  A same-message aggregate is the
+one place where the subgroup check is the ONLY defense (verification of
+an individual signature fails the pairing equation anyway; aggregation
+does no pairing at all), so a backend that skips the check would accept
+a poisoned aggregate input here and nowhere else.  These vectors make
+that a test failure instead of a consensus fork.
+
+Deterministic: fixed IKM seeds, fixed message, smallest-x curve scan
+for the out-of-subgroup points.  Run from the repo root:
+
+    python scripts/gen_bls_vectors.py
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cometbft_tpu.crypto import _bls12381_py as py  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "vectors", "bls12381_conformance.json")
+
+MESSAGE = b"tpu-bft bls conformance r20"
+
+
+def _hex(b: bytes) -> str:
+    return bytes(b).hex()
+
+
+def find_g1_wrong_subgroup() -> bytes:
+    """Smallest-x on-curve G1 point outside the order-r subgroup,
+    compressed.  The G1 cofactor is ~2^125 so the scan terminates almost
+    immediately; g1_in_subgroup pins the exclusion."""
+    x = 0
+    while True:
+        x += 1
+        y2 = (x * x * x + 4) % py.P
+        y = pow(y2, (py.P + 1) // 4, py.P)
+        if y * y % py.P != y2:
+            continue
+        pt = (x, min(y, py.P - y))
+        if not py.g1_in_subgroup(pt):
+            return py.g1_compress(pt)
+
+
+def find_g2_wrong_subgroup() -> bytes:
+    """Same scan over the twist: x = x0 (real), smallest x0 whose curve
+    equation has a root and whose point is outside the subgroup."""
+    x0 = 0
+    while True:
+        x0 += 1
+        raw = bytearray(96)
+        raw[0] = 0x80                       # compressed, positive y
+        raw[48:96] = x0.to_bytes(48, "big")  # c0 in the low half
+        try:
+            pt = py.g2_decompress(bytes(raw))
+        except ValueError:
+            continue
+        if pt is None or py.g2_in_subgroup(pt):
+            continue
+        return py.g2_compress(pt)
+
+
+def main() -> None:
+    keys = []
+    sigs = []
+    for i in range(1, 5):
+        sk = py.keygen(bytes([i]) * 48)
+        pk = py.sk_to_pk(sk)
+        keys.append({
+            "ikm": _hex(bytes([i]) * 48),
+            "sk": sk.to_bytes(32, "big").hex(),
+            "pk": _hex(pk),
+            "pop": _hex(py.pop_prove(sk)),
+            "sig": _hex(py.sign(sk, MESSAGE)),
+        })
+        sigs.append(py.sign(sk, MESSAGE))
+
+    pks = [bytes.fromhex(k["pk"]) for k in keys]
+    g1_bad = find_g1_wrong_subgroup()
+    g2_bad = find_g2_wrong_subgroup()
+    assert py.g1_decompress(g1_bad) is not None
+    assert py.g2_decompress(g2_bad) is not None
+
+    vectors = {
+        "comment": "Pinned BLS12-381 conformance vectors; regenerate "
+                   "with scripts/gen_bls_vectors.py. Every constructible "
+                   "backend must agree with every byte in this file.",
+        "ciphersuite": "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_",
+        "pop_dst": "BLS_POP_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_",
+        "message": _hex(MESSAGE),
+        "keys": keys,
+        "aggregate_signature": _hex(py.aggregate_signatures(sigs)),
+        "aggregate_pubkey": _hex(py.aggregate_pubkeys(pks)),
+        "g1_infinity": "c0" + "00" * 47,
+        "g2_infinity": "c0" + "00" * 95,
+        "g1_wrong_subgroup": _hex(g1_bad),
+        "g2_wrong_subgroup": _hex(g2_bad),
+        # a Basic-suite signature over the pk bytes: must NOT verify as a
+        # proof of possession (the POP_ DST exists precisely so vote
+        # signatures can never double as possession proofs)
+        "pop_wrong_dst": _hex(py.sign(
+            int.from_bytes(bytes.fromhex(keys[0]["sk"]), "big"),
+            bytes.fromhex(keys[0]["pk"]))),
+    }
+
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(vectors, f, indent=1)
+        f.write("\n")
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()

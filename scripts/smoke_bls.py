@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""CI BLS aggregate-commit smoke: one seeded 4-node MIXED-KEY net
+(validators 0/2 sign bls12_381, 1/3 ed25519) on the virtual clock.
+
+The run must:
+
+- reach the target height FORK-FREE — BLS precommits fold into ONE
+  aggregate signature + signer bitmap per commit (types/commit.py
+  aggregate lane block), so any domain mix-up between the
+  zero-timestamp aggregation encoding and the reference Ed25519
+  encoding stalls or forks the chain here;
+- actually exercise the aggregate fast path, confirmed via the
+  ``crypto_bls_*`` metrics: successful aggregate-commit verifications,
+  lanes proven via the aggregate (never individually verified), and at
+  least one per-valset cohort table build;
+- replay byte-identically: a second same-seed run must produce the
+  identical verdict JSON (block hashes included).
+
+Exit 0 on success, 1 with a reason on any failure.  Wired into the lint
+workflow beside smoke_scenarios; runnable locally:
+
+    JAX_PLATFORMS=cpu python scripts/smoke_bls.py
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SEED = 20260807
+
+
+def scenario():
+    from cometbft_tpu.sim import Scenario
+
+    return Scenario(
+        name="smoke-bls-mixed",
+        seed=SEED, n_nodes=4, out_links=2, target_height=5,
+        max_virtual_s=600.0,
+        key_types=["bls12_381", "ed25519", "bls12_381", "ed25519"])
+
+
+def fail(msg: str) -> None:
+    print(f"[smoke-bls] FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    from cometbft_tpu.libs import metrics as m
+    from cometbft_tpu.sim.scenario import run_scenario
+
+    ok_before = m.counter("crypto_bls_verify_total").value(result="ok")
+    bad_before = (m.counter("crypto_bls_verify_total")
+                  .value(result="bad_signature"))
+    lanes_before = m.counter("crypto_bls_lanes_total").value()
+
+    t0 = time.monotonic()
+    v1 = run_scenario(scenario())
+    t1 = time.monotonic() - t0
+    v2 = run_scenario(scenario())
+    wall = time.monotonic() - t0
+    print(f"[smoke-bls] run1 {t1:.1f}s, total {wall:.1f}s real for "
+          f"2 x {v1['virtual_duration_s']}s virtual (4 nodes, 2 BLS)")
+
+    if not v1["fork_free"]:
+        fail(f"fork detected: {v1['block_hashes']}")
+    if not v1["reached_target"]:
+        fail(f"stuck at height {v1['common_height']} "
+             f"< {v1['target_height']}")
+
+    agg_ok = m.counter("crypto_bls_verify_total").value(result="ok") \
+        - ok_before
+    agg_bad = (m.counter("crypto_bls_verify_total")
+               .value(result="bad_signature")) - bad_before
+    agg_lanes = m.counter("crypto_bls_lanes_total").value() - lanes_before
+    if agg_ok < 1:
+        fail("no successful aggregate-commit verification recorded "
+             "(crypto_bls_verify_total{result=ok}) — the BLS cohort "
+             "never folded")
+    if agg_bad > 0:
+        fail(f"{agg_bad:.0f} aggregate verifications FAILED "
+             "(crypto_bls_verify_total{result=bad_signature}) on an "
+             "honest net — aggregation domain mismatch")
+    if agg_lanes < 2 * agg_ok:
+        fail(f"aggregate proved only {agg_lanes:.0f} lanes over "
+             f"{agg_ok:.0f} verifications — the 2-validator BLS cohort "
+             "should fold both lanes every time")
+
+    j1 = json.dumps(v1, sort_keys=True)
+    j2 = json.dumps(v2, sort_keys=True)
+    if j1 != j2:
+        for k in v1:
+            if json.dumps(v1[k], sort_keys=True) != \
+                    json.dumps(v2[k], sort_keys=True):
+                print(f"  diverged field {k!r}:\n    {v1[k]}\n    {v2[k]}",
+                      file=sys.stderr)
+        fail("verdict JSON diverged across same-seed runs")
+
+    print(f"[smoke-bls] OK: fork-free at {v1['common_height']}, "
+          f"{agg_ok:.0f} aggregate verifications proving "
+          f"{agg_lanes:.0f} lanes, replay identical")
+
+
+if __name__ == "__main__":
+    main()

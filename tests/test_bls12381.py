@@ -185,3 +185,152 @@ def test_native_backend_rejects_malleated_inputs():
     inf_sig = bytes([0xC0] + [0] * 95)
     assert not n.verify(inf_pk, msg, sig)
     assert not n.verify(pk, msg, inf_sig)
+
+
+# ------------------------------------------------- aggregation (r20)
+
+
+def _conformance_vectors():
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "vectors",
+                        "bls12381_conformance.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_aggregate_cross_backend_byte_parity():
+    """Aggregation must be a consensus-stable operation: the native C++
+    backend and the pure-Python oracle produce byte-identical aggregate
+    signatures/pubkeys and agree on every FastAggregateVerify verdict."""
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    n = keys._NativeBackend()
+    sks = [(7 ** i + 13) % b.R for i in range(1, 6)]
+    pks = [b.sk_to_pk(k) for k in sks]
+    msg = b"cross-backend-aggregate"
+    sigs = [b.sign(k, msg) for k in sks]
+
+    agg_sig = n.aggregate_signatures(sigs)
+    agg_pk = n.aggregate_pubkeys(pks)
+    assert agg_sig == b.aggregate_signatures(sigs)
+    assert agg_pk == b.aggregate_pubkeys(pks)
+    # check=False must not change the bytes, only skip validation
+    assert n.aggregate_signatures(sigs, check=False) == agg_sig
+    assert n.aggregate_pubkeys(pks, check=False) == agg_pk
+
+    assert n.fast_aggregate_verify(pks, msg, agg_sig)
+    assert b.fast_aggregate_verify(pks, msg, agg_sig)
+    # verdict agreement on wrong cohorts: extra signer, dropped signer,
+    # wrong message
+    extra = b.sk_to_pk(424242)
+    for bad_pks, bad_msg in (
+            (pks + [extra], msg), (pks[:-1], msg), (pks, msg + b".")):
+        assert not n.fast_aggregate_verify(bad_pks, bad_msg, agg_sig)
+        assert not b.fast_aggregate_verify(bad_pks, bad_msg, agg_sig)
+
+    # proof-of-possession parity (the rogue-key gate)
+    for k in sks[:2]:
+        pop = n.pop_prove(k)
+        assert pop == b.pop_prove(k)
+        assert n.pop_verify(b.sk_to_pk(k), pop)
+        assert b.pop_verify(b.sk_to_pk(k), pop)
+
+
+def test_conformance_vectors_pinned():
+    """Sweep tests/vectors/bls12381_conformance.json: keygen, pubkey
+    derivation, per-key signatures and possession proofs, and the
+    aggregate signature/pubkey — all pinned byte-exactly.  A backend
+    change that shifts any of these bytes is a consensus break."""
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    v = _conformance_vectors()
+    assert v["ciphersuite"] == keys.STANDARD_CIPHERSUITE
+    assert v["pop_dst"].encode() == keys.DST_POP
+    msg = bytes.fromhex(v["message"])
+
+    sigs, pks = [], []
+    for i, k in enumerate(v["keys"]):
+        sk = b.keygen(bytes.fromhex(k["ikm"]))
+        assert sk == int.from_bytes(bytes.fromhex(k["sk"]), "big"), i
+        pk = b.sk_to_pk(sk)
+        assert pk == bytes.fromhex(k["pk"]), i
+        sig = b.sign(sk, msg)
+        assert sig == bytes.fromhex(k["sig"]), i
+        assert keys.pop_prove(sk.to_bytes(32, "big")) == \
+            bytes.fromhex(k["pop"]), i
+        assert keys.pop_verify(pk, bytes.fromhex(k["pop"])), i
+        pks.append(pk)
+        sigs.append(sig)
+
+    assert keys.aggregate_signatures(sigs) == \
+        bytes.fromhex(v["aggregate_signature"])
+    assert keys.aggregate_pubkeys(pks) == \
+        bytes.fromhex(v["aggregate_pubkey"])
+    assert keys.fast_aggregate_verify(
+        pks, msg, bytes.fromhex(v["aggregate_signature"]))
+
+
+def test_conformance_subgroup_and_infinity_rejects():
+    """The subgroup-check pin: wrong-subgroup and infinity encodings from
+    the conformance vectors must be rejected by every aggregate entry
+    point, and a possession proof under the wrong DST must not verify."""
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    v = _conformance_vectors()
+    msg = bytes.fromhex(v["message"])
+    pk0 = bytes.fromhex(v["keys"][0]["pk"])
+    sig0 = bytes.fromhex(v["keys"][0]["sig"])
+
+    # THE pin: a valid signature aggregated with a wrong-subgroup G2
+    # point must raise — not silently poison the cohort's aggregate
+    with pytest.raises(ValueError):
+        keys.aggregate_signatures(
+            [sig0, bytes.fromhex(v["g2_wrong_subgroup"])], check=True)
+    with pytest.raises(ValueError):
+        keys.aggregate_signatures([bytes.fromhex(v["g2_infinity"])])
+    with pytest.raises(ValueError):
+        keys.aggregate_pubkeys(
+            [pk0, bytes.fromhex(v["g1_wrong_subgroup"])])
+    with pytest.raises(ValueError):
+        keys.aggregate_pubkeys([bytes.fromhex(v["g1_infinity"])])
+    # the never-raises entry point degrades to False on the same inputs
+    assert not keys.fast_aggregate_verify(
+        [bytes.fromhex(v["g1_wrong_subgroup"])], msg, sig0)
+    assert not keys.fast_aggregate_verify(
+        [bytes.fromhex(v["g1_infinity"])], msg, sig0)
+    # PoP domain separation: the same key's "proof" hashed under the
+    # vote (NUL_) DST must fail PopVerify
+    assert not keys.pop_verify(pk0, bytes.fromhex(v["pop_wrong_dst"]))
+    assert keys.pop_verify(pk0, bytes.fromhex(v["keys"][0]["pop"]))
+
+
+def test_aggregate_module_seam_policy():
+    """Policy lives at the module seam (crypto/bls12381.py), not in the
+    backends: empty sets and duplicate signers are caller bugs that must
+    raise, while fast_aggregate_verify is documented never-raises."""
+    from cometbft_tpu.crypto import bls12381 as keys
+
+    sk, msg = 31337, b"seam-policy"
+    pk = b.sk_to_pk(sk)
+    sig = b.sign(sk, msg)
+
+    with pytest.raises(ValueError):
+        keys.aggregate_signatures([])
+    with pytest.raises(ValueError):
+        keys.aggregate_signatures([sig[:-1]])
+    with pytest.raises(ValueError):
+        keys.aggregate_pubkeys([])
+    with pytest.raises(ValueError):
+        keys.aggregate_pubkeys([pk, pk])     # bitmap can't repeat a signer
+    with pytest.raises(ValueError):
+        keys.aggregate_pubkeys([pk[:-1]])
+
+    # never-raises: empty cohort, duplicate signer, truncated inputs
+    assert keys.fast_aggregate_verify([], msg, sig) is False
+    assert keys.fast_aggregate_verify([pk, pk], msg, sig) is False
+    assert keys.fast_aggregate_verify([pk[:-1]], msg, sig) is False
+    assert keys.fast_aggregate_verify([pk], msg, sig[:-1]) is False
+    # and the single-signer aggregate degenerates to plain verification
+    assert keys.fast_aggregate_verify([pk], msg, sig) is True

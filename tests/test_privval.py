@@ -287,6 +287,69 @@ def test_filepv_secp256k1_key_type(tmp_path):
     assert pv4.get_pub_key().type() == "ed25519"
 
 
+def test_filepv_bls_key_roundtrip_and_pop(tmp_path):
+    """FilePV with a bls12_381 key persists the proof of possession
+    beside the key (the rogue-key gate the aggregate fast path rests
+    on) and round-trips both through the key file."""
+    import json as _json
+
+    from cometbft_tpu.crypto import bls12381 as _bls
+    from cometbft_tpu.privval import FilePV
+
+    kp, sp = str(tmp_path / "key.json"), str(tmp_path / "state.json")
+    pv = FilePV.generate(kp, sp, key_type="bls12_381")
+    pub = pv.get_pub_key()
+    assert pub.type() == "bls12_381"
+    assert len(pub.bytes()) == 48
+
+    with open(kp) as f:
+        kd = _json.load(f)
+    assert kd["type"] == "bls12_381"
+    stored_pop = bytes.fromhex(kd["pop"])
+    assert _bls.pop_verify(pub.bytes(), stored_pop)
+    # the proof is bound to THIS key, not transferable to another
+    other = FilePV.generate(str(tmp_path / "k2.json"),
+                            str(tmp_path / "s2.json"),
+                            key_type="bls12_381")
+    assert not _bls.pop_verify(other.get_pub_key().bytes(), stored_pop)
+
+    pv2 = FilePV.load(kp, sp)
+    assert pv2.get_pub_key() == pub
+    assert pv2.pop() == stored_pop
+
+
+def test_filepv_bls_signs_aggregation_domain(tmp_path):
+    """A BLS FilePV signs votes in the zero-timestamp aggregation domain
+    (Vote.sign_bytes_for) — NOT the reference timestamped encoding — so
+    its precommits can fold into an aggregate commit."""
+    from cometbft_tpu.privval import FilePV
+
+    pv = FilePV.generate(str(tmp_path / "key.json"),
+                         str(tmp_path / "state.json"),
+                         key_type="bls12_381")
+    v = _vote(pv, typ=PRECOMMIT_TYPE, ts=1_000)
+
+    async def main():
+        await pv.sign_vote(CHAIN, v, sign_extension=False)
+        pub = pv.get_pub_key()
+        assert len(v.signature) == 96
+        assert pub.verify_signature(
+            v.sign_bytes_for(CHAIN, "bls12_381"), v.signature)
+        # the timestamped reference encoding is a DIFFERENT message —
+        # the signature must not transfer across the domain split
+        assert v.sign_bytes(CHAIN) != v.sign_bytes_for(CHAIN, "bls12_381")
+        assert not pub.verify_signature(v.sign_bytes(CHAIN), v.signature)
+        # double-sign protection still holds in the BLS domain
+        other = _vote(pv, typ=PRECOMMIT_TYPE,
+                      bid=BlockID(b"\xcc" * 32,
+                                  PartSetHeader(1, b"\xdd" * 32)))
+        with pytest.raises(DoubleSignError):
+            await pv.sign_vote(CHAIN, other, sign_extension=False)
+        return True
+
+    assert run(main())
+
+
 # ----------------------------------------------- sign-state hardening
 
 
