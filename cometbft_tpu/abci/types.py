@@ -72,6 +72,12 @@ class ValidatorUpdate:
     pub_key_type: str
     pub_key_bytes: bytes
     power: int
+    # proof of possession — REQUIRED when admitting a new bls12_381 key
+    # (the rogue-key defense the aggregate-commit fast path rests on);
+    # ignored for other key types, removals, and power changes of
+    # already-admitted keys.  sm/execution.py rejects the update when
+    # the proof is missing or fails bls12381.pop_verify.
+    pop: bytes = b""
 
 
 @dataclass

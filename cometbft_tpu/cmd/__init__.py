@@ -127,7 +127,7 @@ def cmd_init(args) -> int:
             chain_id=args.chain_id or f"test-chain-{nk.id[:6]}",
             genesis_time_ns=time.time_ns(),
             validators=[GenesisValidator(pv.get_pub_key(), 10,
-                                         cfg.base.moniker)])
+                                         cfg.base.moniker, pop=pv.pop())])
         doc.save(gen_path)
     print(f"Initialized node in {home} (node id {nk.id})")
     return 0

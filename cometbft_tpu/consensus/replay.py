@@ -174,7 +174,7 @@ class Handshaker:
     async def _init_chain(self, state: State, app_conns: AppConns) -> State:
         """InitChain + genesis-response overrides (replay.go:310)."""
         vals = [abci.ValidatorUpdate(v.pub_key.type(), v.pub_key.bytes(),
-                                     v.power)
+                                     v.power, pop=v.pop)
                 for v in self.genesis.validators]
         resp = await app_conns.consensus.init_chain(abci.InitChainRequest(
             chain_id=self.genesis.chain_id,
@@ -186,6 +186,21 @@ class Handshaker:
         if resp.validators:
             from ..crypto.keys import pub_key_from_type_bytes
 
+            # the app's genesis response ADMITS keys (it replaces the
+            # genesis valset wholesale), so bls12_381 entries must carry
+            # a verifying proof of possession exactly like genesis-doc
+            # validators and later ABCI updates — rogue-key gate
+            for vu in resp.validators:
+                if vu.pub_key_type != "bls12_381" or vu.power <= 0:
+                    continue
+                from ..crypto import bls12381 as _bls
+
+                if not vu.pop or not _bls.pop_verify(vu.pub_key_bytes,
+                                                     vu.pop):
+                    raise HandshakeError(
+                        "InitChain response admits bls12_381 key "
+                        f"{vu.pub_key_bytes.hex()[:16]}… without a "
+                        "verifying proof of possession")
             new_vals = ValidatorSet(
                 [Validator(pub_key_from_type_bytes(vu.pub_key_type,
                                                    vu.pub_key_bytes),

@@ -135,21 +135,28 @@ def _device_fold(vals, tbl, signer_rows) -> bytes | None:
         return None
     import numpy as np
 
+    # keyed off the AggTable identity, not just the bucket size: a valset
+    # change rebuilds the AggTable (update_with_change_set pops both
+    # caches), and a stale point table must never survive it — folding
+    # rotated-out pubkeys yields a wrong aggregate pubkey
     cached = vals.__dict__.get("_bls_dev_tbl")
-    if cached is None or cached[0] != rows:
+    if cached is None or cached[0] is not tbl or cached[1] != rows:
         from ..ops import blsg1
 
         pts = np.zeros((rows, 2, blsg1.NLIMB), np.int32)
         order = sorted(affine)        # valset index -> table row
         for r, i in enumerate(order):
             pts[r] = blsg1.limbs_from_xy(affine[i])
-        cached = (rows, order, pts)
+        cached = (tbl, rows, order, pts)
         vals.__dict__["_bls_dev_tbl"] = cached
-    _, order, pts = cached
+    _, _, order, pts = cached
     row_of = {i: r for r, i in enumerate(order)}
     mask = np.zeros((rows,), np.int32)
     for i in signer_rows:
-        mask[row_of[i]] = 1
+        r = row_of.get(i)
+        if r is None:
+            return None     # table out of sync: fall back to the host fold
+        mask[r] = 1
 
     t0 = time.perf_counter()
     out = _b._device_call(lambda: np.asarray(fn(pts, mask)))

@@ -49,7 +49,8 @@ def generate_homes(base_dir: str, specs: list[HomeSpec], chain_id: str,
         genesis_time_ns=time.time_ns(),
         initial_height=initial_height,
         validators=[GenesisValidator(pvs[s.name].get_pub_key(),
-                                     s.power, s.name)
+                                     s.power, s.name,
+                                     pop=pvs[s.name].pop())
                     for s in specs if s.power is not None])
 
     for spec in specs:

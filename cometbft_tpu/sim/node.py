@@ -162,7 +162,8 @@ def make_genesis(n_validators: int, chain_id: str = "sim-net",
                key_type=(key_types[i] if i < len(key_types) else "ed25519"))
            for i in range(n_validators)]
     doc = GenesisDoc(chain_id=chain_id,
-                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                     validators=[GenesisValidator(pv.get_pub_key(), 10,
+                                                  pop=pv.pop())
                                  for pv in pvs])
     return doc, pvs
 
@@ -191,7 +192,7 @@ async def make_sim_node(index: int, doc: GenesisDoc, pv: MockPV,
     await client.init_chain(abci_t.InitChainRequest(
         chain_id=doc.chain_id, initial_height=1, time_ns=0,
         validators=[abci_t.ValidatorUpdate(
-            "ed25519", v.pub_key.bytes(), v.power)
+            v.pub_key.type(), v.pub_key.bytes(), v.power, pop=v.pop)
             for v in doc.validators],
         app_state_bytes=doc.app_state))
 

@@ -80,8 +80,12 @@ class BlockExecutor:
         elif state.consensus_params.feature.pbts_enabled(height):
             block_time = now_ns
         else:
+            # BFT time over the authenticated (Ed25519) lanes only; a
+            # commit with none (pure-BLS valset) falls back to the
+            # deterministic minimum advance, matching validate_block
             block_time = median_time(
-                last_commit, state.last_validators or state.validators)
+                last_commit, state.last_validators or state.validators) \
+                or state.last_block_time_ns + 1
 
         req = abci.PrepareProposalRequest(
             max_tx_bytes=max_data, txs=txs, height=height,
@@ -210,6 +214,27 @@ class BlockExecutor:
                                                   vu.pub_key_bytes)
                 except ValueError as e:
                     raise BlockValidationError(str(e)) from e
+                # rogue-key gate at ADMISSION: a bls12_381 key entering
+                # the set must prove possession of its secret, or
+                # basic-ciphersuite aggregation over the shared
+                # zero-timestamp message is forgeable.  Removals
+                # (power 0) and power changes of already-admitted keys
+                # (address = hash(pubkey), so same address = same key)
+                # need no fresh proof.
+                if (vu.pub_key_type == "bls12_381" and vu.power > 0
+                        and not next_vals.has_address(key.address())):
+                    from ..crypto import bls12381 as _bls
+
+                    if not vu.pop:
+                        raise BlockValidationError(
+                            "bls12_381 validator update admits key "
+                            f"{key.bytes().hex()[:16]}… without a proof "
+                            "of possession")
+                    if not _bls.pop_verify(key.bytes(), vu.pop):
+                        raise BlockValidationError(
+                            "bls12_381 validator update for key "
+                            f"{key.bytes().hex()[:16]}…: proof of "
+                            "possession failed to verify")
                 changes.append(Validator(key, vu.power))
             next_vals.update_with_change_set(changes)
             changed_height = height + 1

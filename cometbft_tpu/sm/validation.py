@@ -17,7 +17,16 @@ class BlockValidationError(Exception):
 
 def median_time(commit: Commit, validators) -> int:
     """Voting-power-weighted median of commit timestamps — BFT time
-    (types/block.go:949 MedianTime)."""
+    (types/block.go:949 MedianTime).
+
+    Only AUTHENTICATED timestamps count: BLS validators sign the
+    zero-timestamp aggregation domain (types/vote.py sign_bytes_for), so
+    the timestamps riding in their commit lanes are proposer-editable
+    and must not influence block time — BFT time draws from the Ed25519
+    cohort only.  Returns 0 when the commit carries no authenticated
+    lane (pure-BLS valsets); callers fall back to
+    ``last_block_time_ns + 1``, which is deterministic and denies the
+    proposer any control over block time."""
     pairs = []
     total = 0
     for i, cs in enumerate(commit.signatures):
@@ -26,6 +35,8 @@ def median_time(commit: Commit, validators) -> int:
         _, val = validators.get_by_address(cs.validator_address)
         if val is None:
             continue
+        if val.pub_key.type() == "bls12_381":
+            continue        # timestamp not covered by the signature
         pairs.append((cs.timestamp_ns, val.voting_power))
         total += val.voting_power
     if not pairs:
@@ -103,7 +114,10 @@ def validate_block(state: State, block: Block,
         if h.time_ns <= state.last_block_time_ns:
             raise BlockValidationError("block time not monotonic")
         if not state.consensus_params.feature.pbts_enabled(h.height):
-            want = median_time(block.last_commit, state.last_validators)
+            # no authenticated (Ed25519) timestamp in the commit → the
+            # deterministic fallback the proposer used (BLS-only valset)
+            want = median_time(block.last_commit, state.last_validators) \
+                or state.last_block_time_ns + 1
             if h.time_ns != want:
                 raise BlockValidationError(
                     f"block time {h.time_ns} != median time {want}")

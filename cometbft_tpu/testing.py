@@ -294,7 +294,8 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
     doc = GenesisDoc(chain_id=chain_id,
                      validators=[GenesisValidator(
                          pv.get_pub_key(),
-                         (power[i] if power else 10))
+                         (power[i] if power else 10),
+                         pop=getattr(pv, "pop", lambda: b"")())
                          for i, pv in enumerate(pvs)])
     doc.consensus_params.feature.vote_extensions_enable_height = \
         vote_extensions_height
@@ -322,7 +323,7 @@ async def make_inproc_network(n_validators: int = 4, *, chain_id="test-net",
         await client.init_chain(abci_t.InitChainRequest(
             chain_id=chain_id, initial_height=1, time_ns=0,
             validators=[abci_t.ValidatorUpdate(
-                "ed25519", v.pub_key.bytes(), v.power)
+                v.pub_key.type(), v.pub_key.bytes(), v.power, pop=v.pop)
                 for v in doc.validators],
             app_state_bytes=doc.app_state))
         wal = WAL(f"{wal_dir}/wal{i}.log") if wal_dir else None

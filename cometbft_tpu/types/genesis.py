@@ -22,6 +22,10 @@ class GenesisValidator:
     pub_key: PubKey
     power: int
     name: str = ""
+    # proof of possession, REQUIRED for bls12_381 keys (rogue-key
+    # defense for aggregate commits): validate_and_complete refuses a
+    # BLS genesis key whose proof is missing or fails pop_verify
+    pop: bytes = b""
 
 
 @dataclass
@@ -58,6 +62,20 @@ class GenesisDoc:
             err = _bls.check_validator_backend()
             if err:
                 raise GenesisError(err)
+            # rogue-key gate: basic-ciphersuite aggregation over the
+            # shared zero-timestamp message is forgeable unless every
+            # admitted BLS key proves possession of its secret
+            for v in self.validators:
+                if v.pub_key.type() != "bls12_381":
+                    continue
+                if not v.pop:
+                    raise GenesisError(
+                        f"genesis validator {v.name or v.pub_key!r} has a "
+                        "bls12_381 key but no proof of possession ('pop')")
+                if not _bls.pop_verify(v.pub_key.bytes(), v.pop):
+                    raise GenesisError(
+                        f"genesis validator {v.name or v.pub_key!r}: "
+                        "bls12_381 proof of possession failed to verify")
 
     def validator_set(self) -> ValidatorSet:
         return ValidatorSet([Validator(v.pub_key, v.power)
@@ -76,6 +94,7 @@ class GenesisDoc:
                                 v.pub_key.bytes()).decode()},
                 "power": v.power,
                 "name": v.name,
+                **({"pop": v.pop.hex()} if v.pop else {}),
             } for v in self.validators],
             "app_hash": self.app_hash.hex(),
             "app_state": self.app_state.decode("utf-8", "replace"),
@@ -137,8 +156,12 @@ class GenesisDoc:
                     base64.b64decode(v["pub_key"]["value"]))
             except ValueError as e:
                 raise GenesisError(f"bad genesis validator key: {e}") from e
+            try:
+                pop = bytes.fromhex(v.get("pop", ""))
+            except ValueError as e:
+                raise GenesisError(f"bad genesis validator pop: {e}") from e
             vals.append(GenesisValidator(key, int(v["power"]),
-                                         v.get("name", "")))
+                                         v.get("name", ""), pop))
         doc = cls(chain_id=d["chain_id"],
                   genesis_time_ns=d.get("genesis_time_ns", 0),
                   initial_height=d.get("initial_height", 1),

@@ -49,6 +49,17 @@ class MockPV(PrivValidator):
     def get_pub_key(self) -> PubKey:
         return self.priv_key.pub_key()
 
+    def pop(self) -> bytes:
+        """Proof of possession (BLS keys only; b"" otherwise) — what a
+        genesis doc or validator update publishes beside the pubkey so
+        admission can run the rogue-key gate (same contract as
+        ``privval.FilePV.pop``)."""
+        if self.priv_key.type() != "bls12_381":
+            return b""
+        from ..crypto import bls12381 as _bls
+
+        return _bls.pop_prove(self.priv_key.bytes())
+
     async def sign_vote(self, chain_id: str, vote: Vote,
                         sign_extension: bool) -> None:
         vote.signature = self.priv_key.sign(

@@ -297,6 +297,7 @@ class ValidatorSet:
         self.__dict__.pop("_addr_idx", None)
         self.__dict__.pop("_bls_cohort", None)
         self.__dict__.pop("_bls_agg_tbl", None)   # crypto/blsagg tables
+        self.__dict__.pop("_bls_dev_tbl", None)   # blsagg device-fold points
         self.total_voting_power()
         self._rescale_priorities(
             PRIORITY_WINDOW_SIZE_FACTOR * self.total_voting_power())

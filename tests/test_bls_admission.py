@@ -1,0 +1,255 @@
+"""BLS key-admission (proof of possession) and BFT-time authentication.
+
+The aggregate-commit fast path uses the IETF Basic ciphersuite over a
+SHARED zero-timestamp message, which is exactly the rogue-key setting:
+admission of any BLS pubkey without a verified proof of possession lets
+an attacker forge aggregate lanes for cohorts it does not control.
+These tests pin the three admission gates (genesis validation, ABCI
+validator updates, InitChain response) and the companion BFT-time rule:
+BLS lanes' commit timestamps are unauthenticated (the signature covers
+the zero-timestamp domain), so ``median_time`` must never read them.
+"""
+
+import pytest
+
+from cometbft_tpu.crypto import bls12381 as bls
+
+pytestmark = pytest.mark.skipif(not bls.ENABLED,
+                                reason="no BLS backend in this build")
+
+CHAIN = "pop-chain"
+
+
+def _bls_sk(tag: bytes):
+    return bls.Bls12381PrivKey.from_secret(tag)
+
+
+# ----------------------------------------------------------------- genesis
+
+
+def _bls_genesis(pop: bytes):
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    sk = _bls_sk(b"genesis-val")
+    return GenesisDoc(chain_id=CHAIN,
+                      validators=[GenesisValidator(sk.pub_key(), 10,
+                                                   "v0", pop)])
+
+
+def test_genesis_requires_pop(monkeypatch):
+    from cometbft_tpu.types.genesis import GenesisError
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    with pytest.raises(GenesisError, match="proof of possession"):
+        _bls_genesis(b"").validate_and_complete()
+
+
+def test_genesis_rejects_wrong_pop(monkeypatch):
+    from cometbft_tpu.types.genesis import GenesisError
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    wrong = bls.pop_prove(_bls_sk(b"some-other-key").bytes())
+    with pytest.raises(GenesisError, match="failed to verify"):
+        _bls_genesis(wrong).validate_and_complete()
+
+
+def test_genesis_pop_roundtrips_and_verifies(monkeypatch):
+    from cometbft_tpu.types.genesis import GenesisDoc
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    sk = _bls_sk(b"genesis-val")
+    doc = _bls_genesis(bls.pop_prove(sk.bytes()))
+    doc.validate_and_complete()
+    doc2 = GenesisDoc.from_json(doc.to_json())     # from_json re-validates
+    assert doc2.validators[0].pop == doc.validators[0].pop
+    assert doc2.validators[0].pub_key == sk.pub_key()
+
+
+def test_genesis_from_json_drops_pop_refused(monkeypatch):
+    """A hand-edited genesis.json that strips the pop must be refused."""
+    import json
+
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisError
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    sk = _bls_sk(b"genesis-val")
+    doc = _bls_genesis(bls.pop_prove(sk.bytes()))
+    d = json.loads(doc.to_json())
+    del d["validators"][0]["pop"]
+    with pytest.raises(GenesisError, match="proof of possession"):
+        GenesisDoc.from_json(json.dumps(d))
+
+
+# ------------------------------------------------- ABCI validator updates
+
+
+def _exec_state(monkeypatch):
+    from cometbft_tpu.storage.statestore import State
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    pvs = [MockPV.from_secret(b"upd%d" % i) for i in range(2)]
+    doc = GenesisDoc(chain_id=CHAIN,
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)
+                                 for pv in pvs])
+    doc.consensus_params.validator.pub_key_types = ["ed25519", "bls12_381"]
+    return State.from_genesis(doc)
+
+
+def _apply_updates(state, updates):
+    from cometbft_tpu.abci.types import FinalizeBlockResponse
+    from cometbft_tpu.sm.execution import BlockExecutor
+    from cometbft_tpu.types.block_id import BlockID
+    from cometbft_tpu.types.header import Block, Data, Header
+
+    execu = BlockExecutor(None, None, None, None)
+    block = Block(header=Header(chain_id=CHAIN, height=1, time_ns=1),
+                  data=Data(txs=[]))
+    resp = FinalizeBlockResponse(validator_updates=updates)
+    return execu._update_state(state, BlockID(), block, resp)
+
+
+def test_update_admitting_bls_key_requires_pop(monkeypatch):
+    from cometbft_tpu.abci.types import ValidatorUpdate
+    from cometbft_tpu.sm.validation import BlockValidationError
+
+    state = _exec_state(monkeypatch)
+    sk = _bls_sk(b"new-bls-val")
+    with pytest.raises(BlockValidationError, match="proof of possession"):
+        _apply_updates(state, [ValidatorUpdate(
+            "bls12_381", sk.pub_key().bytes(), 5)])
+    wrong = bls.pop_prove(_bls_sk(b"unrelated").bytes())
+    with pytest.raises(BlockValidationError, match="failed to verify"):
+        _apply_updates(state, [ValidatorUpdate(
+            "bls12_381", sk.pub_key().bytes(), 5, pop=wrong)])
+
+
+def test_update_with_valid_pop_admits(monkeypatch):
+    from cometbft_tpu.abci.types import ValidatorUpdate
+
+    state = _exec_state(monkeypatch)
+    sk = _bls_sk(b"new-bls-val")
+    new_state = _apply_updates(state, [ValidatorUpdate(
+        "bls12_381", sk.pub_key().bytes(), 5,
+        pop=bls.pop_prove(sk.bytes()))])
+    assert new_state.next_validators.has_address(sk.pub_key().address())
+
+
+def test_update_of_admitted_key_needs_no_fresh_pop(monkeypatch):
+    """Power changes and removals of an already-admitted BLS key carry
+    no proof — the address IS the hash of the proven pubkey."""
+    from cometbft_tpu.abci.types import ValidatorUpdate
+
+    state = _exec_state(monkeypatch)
+    sk = _bls_sk(b"new-bls-val")
+    pk = sk.pub_key()
+    state = _apply_updates(state, [ValidatorUpdate(
+        "bls12_381", pk.bytes(), 5, pop=bls.pop_prove(sk.bytes()))])
+    # next height: bump power with no pop, then remove with no pop
+    state = _apply_updates(state, [ValidatorUpdate(
+        "bls12_381", pk.bytes(), 9)])
+    _, val = state.next_validators.get_by_address(pk.address())
+    assert val is not None and val.voting_power == 9
+    state = _apply_updates(state, [ValidatorUpdate(
+        "bls12_381", pk.bytes(), 0)])
+    assert not state.next_validators.has_address(pk.address())
+
+
+def test_init_chain_response_admission_checked(monkeypatch):
+    """An app's InitChain response replaces the valset wholesale — BLS
+    entries there are admissions and must carry a verifying pop."""
+    import asyncio
+
+    from cometbft_tpu.abci.types import (InitChainResponse, ValidatorUpdate)
+    from cometbft_tpu.consensus.replay import Handshaker, HandshakeError
+    from cometbft_tpu.storage.statestore import State, StateStore
+    from cometbft_tpu.storage.db import MemDB
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.priv_validator import MockPV
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    pv = MockPV.from_secret(b"ic")
+    doc = GenesisDoc(chain_id=CHAIN,
+                     validators=[GenesisValidator(pv.get_pub_key(), 10)])
+    sk = _bls_sk(b"app-admitted")
+
+    class _Conn:
+        async def init_chain(self, req):
+            return InitChainResponse(validators=[ValidatorUpdate(
+                "bls12_381", sk.pub_key().bytes(), 10, pop=self.pop)])
+
+    class _Conns:
+        consensus = _Conn()
+
+    hs = Handshaker(StateStore(MemDB()), None, doc)
+    conns = _Conns()
+
+    conns.consensus.pop = b""
+    with pytest.raises(HandshakeError, match="proof of possession"):
+        asyncio.run(hs._init_chain(State.from_genesis(doc), conns))
+
+    conns.consensus.pop = bls.pop_prove(sk.bytes())
+    st = asyncio.run(hs._init_chain(State.from_genesis(doc), conns))
+    assert st.validators.has_address(sk.pub_key().address())
+
+
+# ------------------------------------------------------------- BFT time
+
+
+def test_median_time_excludes_unauthenticated_bls_lanes():
+    """BLS validators sign the zero-timestamp domain, so their CommitSig
+    timestamps are proposer-editable and must not move block time."""
+    from cometbft_tpu.sm.validation import median_time
+    from cometbft_tpu.types.block_id import BlockID
+    from cometbft_tpu.types.commit import (BLOCK_ID_FLAG_AGGREGATE,
+                                           BLOCK_ID_FLAG_COMMIT, Commit,
+                                           CommitSig)
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    kts = ["ed25519", "bls12_381", "ed25519", "bls12_381"]
+    pvs = [MockPV.from_secret(b"mt%d" % i, key_type=kt)
+           for i, kt in enumerate(kts)]
+    vals = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+
+    ed_ts, bls_ts = 1_000, 999_999_999
+    sigs = []
+    for v in vals.validators:
+        is_bls = v.pub_key.type() == "bls12_381"
+        sigs.append(CommitSig(
+            BLOCK_ID_FLAG_AGGREGATE if is_bls else BLOCK_ID_FLAG_COMMIT,
+            v.address, bls_ts if is_bls else ed_ts, b""))
+    commit = Commit(1, 0, BlockID(), sigs)
+    # the proposer-controlled BLS timestamps are ignored entirely
+    assert median_time(commit, vals) == ed_ts
+
+    # a commit with no authenticated lane yields 0 — callers fall back
+    # to the deterministic last_block_time_ns + 1
+    only_bls = Commit(1, 0, BlockID(), [
+        cs if cs.block_id_flag == BLOCK_ID_FLAG_AGGREGATE
+        else CommitSig.absent() for cs in sigs])
+    assert median_time(only_bls, vals) == 0
+
+
+# --------------------------------------------------- device-table cache
+
+
+def test_valset_update_invalidates_bls_device_table(monkeypatch):
+    """update_with_change_set must drop the blsagg device-fold point
+    table with the other cached views: a stale table would fold
+    rotated-out pubkeys into the aggregate pubkey."""
+    from cometbft_tpu.crypto.keys import Ed25519PrivKey
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.validator_set import Validator, ValidatorSet
+
+    monkeypatch.setenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", "1")
+    pvs = [MockPV.from_secret(b"dt%d" % i, key_type="bls12_381")
+           for i in range(3)]
+    vals = ValidatorSet([Validator(pv.get_pub_key(), 10) for pv in pvs])
+    vals.__dict__["_bls_dev_tbl"] = ("stale-sentinel",)
+    vals.__dict__["_bls_agg_tbl"] = ("stale-sentinel",)
+    vals.update_with_change_set(
+        [Validator(Ed25519PrivKey.from_secret(b"fresh").pub_key(), 10)])
+    assert "_bls_dev_tbl" not in vals.__dict__
+    assert "_bls_agg_tbl" not in vals.__dict__

@@ -184,9 +184,13 @@ def test_bls_validator_backend_guard(monkeypatch):
     from cometbft_tpu.types.genesis import (GenesisDoc, GenesisError,
                                             GenesisValidator)
 
-    pub = bls.Bls12381PubKey(b"\x01" * 48)
+    if not bls.ENABLED:
+        _pytest.skip("no BLS backend in this build")
+    sk = bls.Bls12381PrivKey.from_secret(b"backend-guard")
     doc = GenesisDoc(chain_id="bls-chain",
-                     validators=[GenesisValidator(pub_key=pub, power=10)])
+                     validators=[GenesisValidator(
+                         pub_key=sk.pub_key(), power=10,
+                         pop=bls.pop_prove(sk.bytes()))])
 
     monkeypatch.delenv("COMETBFT_TPU_ALLOW_NONSTANDARD_BLS", raising=False)
     if bls.is_standard_backend():
