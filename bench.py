@@ -2040,6 +2040,119 @@ def _child_bls(out_path: str) -> None:
     }), flush=True)
 
 
+def _child_profile(out_path: str) -> None:
+    """``--mode profile``: the hot-path profiling harness — run one
+    scenario-lab scenario (default ``megamix-100``, the 100-node mixed-
+    adversary fleet) under ``tracemalloc`` + ``cProfile`` and write a
+    ranked top-allocators / top-callers report to ``out_path``.
+
+    This is a *diagnostic* mode, not a guard: its job is to point at
+    the dominant allocator and the dominant CPU sink so an optimisation
+    PR can kill them and commit before/after reports side by side.
+    Numbers here are NOT comparable to ``--mode scenarios`` wall times —
+    tracemalloc alone multiplies allocation cost several-fold."""
+    import cProfile
+    import pstats
+    import tracemalloc
+
+    from cometbft_tpu.jaxenv import force_cpu_backend
+
+    force_cpu_backend()
+    from cometbft_tpu.sim.scenario import curated_suite, run_scenario
+
+    def note(msg):
+        print(f"[bench:profile] {msg}", file=sys.stderr, flush=True)
+
+    want = os.environ.get("BENCH_PROFILE_SCENARIO", "megamix-100")
+    cands = [s for s in curated_suite() if s.name == want]
+    if not cands:
+        raise SystemExit(f"unknown BENCH_PROFILE_SCENARIO {want!r}")
+    scn = cands[0]
+    top_n = int(os.environ.get("BENCH_PROFILE_TOP", "25"))
+
+    def _rel(path: str) -> str:
+        if path.startswith(REPO):
+            return path[len(REPO):].lstrip(os.sep)
+        # site-packages / stdlib frames: keep the last 3 components
+        return os.sep.join(path.split(os.sep)[-3:])
+
+    note(f"profiling {scn.name} ({scn.n_nodes} nodes, "
+         f"target h{scn.target_height}) under tracemalloc+cProfile")
+    tracemalloc.start(1)           # 1 frame: rank by allocation site
+    prof = cProfile.Profile()
+    t0 = time.perf_counter()
+    prof.enable()
+    verdict = run_scenario(scn)
+    prof.disable()
+    real_s = time.perf_counter() - t0
+    snap = tracemalloc.take_snapshot()
+    peak_b = tracemalloc.get_traced_memory()[1]
+    tracemalloc.stop()
+
+    snap = snap.filter_traces((
+        tracemalloc.Filter(False, tracemalloc.__file__),
+        tracemalloc.Filter(False, "<frozen importlib._bootstrap>"),
+    ))
+    allocs = []
+    for stat in snap.statistics("lineno")[:top_n]:
+        fr = stat.traceback[0]
+        allocs.append({"site": f"{_rel(fr.filename)}:{fr.lineno}",
+                       "size_kb": round(stat.size / 1024, 1),
+                       "count": stat.count})
+
+    st = pstats.Stats(prof)
+    rows = []   # (file, line, func, ncalls, tottime, cumtime)
+    for (fn, line, func), (_cc, nc, tt, ct, _cal) in st.stats.items():
+        rows.append((fn, line, func, nc, tt, ct))
+
+    def _fmt(r):
+        fn, line, func, nc, tt, ct = r
+        where = func if fn == "~" else f"{_rel(fn)}:{line}({func})"
+        return {"func": where, "ncalls": nc,
+                "tottime_s": round(tt, 3), "cumtime_s": round(ct, 3)}
+
+    by_tot = [_fmt(r) for r in
+              sorted(rows, key=lambda r: -r[4])[:top_n]]
+    by_cum = [_fmt(r) for r in
+              sorted(rows, key=lambda r: -r[5])[:top_n]]
+
+    doc = {
+        "metric": "hot-path profile: one scenario-lab run under "
+                  "tracemalloc(1 frame) + cProfile (diagnostic; not "
+                  "comparable to --mode scenarios timings)",
+        "scenario": scn.name,
+        "real_s": round(real_s, 1),
+        "virtual_s": verdict["virtual_duration_s"],
+        "reached_target": verdict["reached_target"],
+        "fork_free": verdict["fork_free"],
+        "peak_traced_mb": round(peak_b / 1e6, 1),
+        "top_allocators": allocs,
+        "top_functions_by_tottime": by_tot,
+        "top_functions_by_cumtime": by_cum,
+        "backend": "cpu",
+    }
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        note(f"report -> {out_path}")
+    top_alloc = allocs[0] if allocs else {}
+    note(f"peak traced {doc['peak_traced_mb']} MB; top allocator "
+         f"{top_alloc.get('site')} ({top_alloc.get('size_kb')} KB live, "
+         f"{top_alloc.get('count')} blocks)")
+    print(json.dumps({
+        "metric": doc["metric"],
+        "value": doc["peak_traced_mb"],
+        "unit": "MB-peak",
+        "vs_baseline": 1.0 if verdict["reached_target"] else 0.0,
+        "scenario": scn.name,
+        "real_s": doc["real_s"],
+        "top_allocator": top_alloc.get("site"),
+        "report": out_path,
+        "backend": "cpu",
+    }), flush=True)
+
+
 def _child_main(backend: str, nsig: int) -> None:
     mode = os.environ.get("BENCH_MODE", "commit")
     if mode == "mempool":
@@ -2062,6 +2175,11 @@ def _child_main(backend: str, nsig: int) -> None:
             os.environ.get("BENCH_OUT",
                            os.path.join(REPO, "docs", "bench",
                                         "r20-bls-cpu.json")))
+    if mode == "profile":
+        return _child_profile(
+            os.environ.get("BENCH_OUT",
+                           os.path.join(REPO, "docs", "bench",
+                                        "r21-profile-cpu.json")))
     if mode == "node":
         return _child_node(float(os.environ.get("BENCH_RATE", "2000")),
                            float(os.environ.get("BENCH_DURATION", "20")),
@@ -2298,7 +2416,7 @@ def main() -> None:
     want_tpu = ("cpu" != platforms.strip().lower()) and forced != "cpu"
     if os.environ.get("BENCH_MODE") in ("node", "light-serve",
                                         "scenarios", "mempool",
-                                        "statesync", "bls"):
+                                        "statesync", "bls", "profile"):
         # these children hard-force CPU (full-stack measurements whose
         # bottleneck is the node, not a device leg): skip the
         # accelerator probe and the redundant tpu-labeled attempt
@@ -2403,6 +2521,8 @@ def main() -> None:
         "mesh": ("sharded SPMD verify, full-mesh sigs/s", "sigs/s"),
         "bls": ("BLS aggregate-commit verify speedup vs Ed25519 "
                 "batched path @10k validators", "x"),
+        "profile": ("hot-path profile: scenario-lab run under "
+                    "tracemalloc + cProfile", "MB-peak"),
     }.get(mode, (mode, "ops/s"))
     print(json.dumps({
         "metric": metric,

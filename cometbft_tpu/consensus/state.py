@@ -87,6 +87,14 @@ class ConsensusState:
             "consensus_block_assembly_seconds",
             "gossip block-part assembly time (first part -> complete)",
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5))
+        self.m_phase = metrics.histogram(
+            "consensus_phase_seconds",
+            "commit-latency attribution by phase, observed once per "
+            "committed height (propose/gossip/prevote/precommit/commit/"
+            "wal/app/total — the live-metrics face of the height "
+            "timeline in libs/timeline)",
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1, 2.5, 5, 10, 30))
 
         self.rs = RoundState()
         self.state: State | None = None
@@ -125,6 +133,10 @@ class ConsensusState:
         self._step_info: tuple[str, float] | None = None
         self._step_mono = clock.monotonic()
         self._assembly_t0: float | None = None
+        # per-height phase marks (clock.monotonic seconds) feeding
+        # consensus_phase_seconds at commit; reset in _update_to_state
+        self._height_t0 = clock.monotonic()
+        self._phase_marks: dict[str, float] = {}
 
         self._update_to_state(state)
 
@@ -292,7 +304,8 @@ class ConsensusState:
                 self.queue.put_nowait(("vote", vote, peer_id))
 
         for msg, sig in items:
-            sched.submit_nowait(pub, msg, sig, on_done=_done)
+            sched.submit_nowait(pub, msg, sig, on_done=_done,
+                                height=vote.height)
         return True
 
     def _vote_pub_key(self, vote: Vote):
@@ -521,6 +534,8 @@ class ConsensusState:
         )
         self.rs.start_time_ns = self.rs.commit_time_ns + \
             self.cfg.commit_timeout()
+        self._height_t0 = clock.monotonic()
+        self._phase_marks = {}
         self._note_round_step()
 
     def _schedule_round0_now(self) -> None:
@@ -746,6 +761,11 @@ class ConsensusState:
             raise VoteSetError("invalid proposal signature")
         rs.proposal = proposal
         rs.proposal_receive_time_ns = self.now_ns()
+        if not self._replaying:
+            self._phase_marks["proposal"] = clock.monotonic()
+            tracing.event("consensus", "proposal_received",
+                          node=self.name, height=rs.height,
+                          round=rs.round)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header)
@@ -770,8 +790,10 @@ class ConsensusState:
             self._assembly_t0 = None
             if not self._replaying:     # replayed parts aren't gossip
                 self.m_assembly.observe(dt, node=self.name)
+                self._phase_marks["parts"] = clock.monotonic()
                 tracing.event("consensus", "block_assembled",
                               node=self.name, height=height,
+                              round=rs.round,
                               parts=rs.proposal_block_parts.total,
                               dur_us=int(dt * 1e6))
         rs.proposal_block = codec.unpack(rs.proposal_block_parts.get_data())
@@ -915,6 +937,8 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
             return
         rs.step = STEP_PRECOMMIT
+        if not self._replaying:
+            self._phase_marks["prevote_23"] = clock.monotonic()
         self._note_round_step()
         await self._do_precommit(height, round_)
         await self._recheck_step_thresholds()
@@ -974,6 +998,8 @@ class ConsensusState:
             return
         rs.step = STEP_COMMIT
         rs.commit_round = commit_round
+        if not self._replaying:
+            self._phase_marks["precommit_23"] = clock.monotonic()
         self._note_round_step()
         rs.commit_time_ns = self.now_ns()
         precommits = rs.votes.precommits(commit_round)
@@ -1029,6 +1055,8 @@ class ConsensusState:
             rs.step = STEP_COMMIT
             rs.commit_round = commit.round
             rs.commit_time_ns = self.now_ns()
+            if not self._replaying:
+                self._phase_marks["precommit_23"] = clock.monotonic()
             self._note_round_step()
             maj = commit.block_id
             if rs.locked_block is not None and \
@@ -1086,14 +1114,21 @@ class ConsensusState:
                 self.block_store.save_block_with_extended_commit(
                     block, parts, ext)
         fail_point("cs:after-save-block")
+        t_wal0 = clock.monotonic()
         if self.wal is not None and not self._replaying:
             self.wal.write_end_height(height)
+        t_wal = clock.monotonic() - t_wal0
         fail_point("cs:after-wal-endheight")
 
         new_state = await self.block_exec.apply_block(
             self.state, bid, block, verified=True)
+        t_app = clock.monotonic() - t_wal0 - t_wal
         fail_point("cs:after-apply-block")
 
+        # _update_to_state resets the phase marks for the next height:
+        # capture this height's attribution first
+        marks, t0h = self._phase_marks, self._height_t0
+        t_commit = clock.monotonic()
         self._update_to_state(new_state)
         if not self._replaying:       # replayed commits would pollute stats
             now = self.now_ns()
@@ -1104,9 +1139,11 @@ class ConsensusState:
                 self.m_block_interval.observe(
                     max(now - last_wall, 0) / 1e9, node=self.name)
             self._last_commit_wall_ns = now
+            self._observe_phases(marks, t0h, t_commit, t_wal, t_app)
             tracing.event("consensus", "commit", node=self.name,
                           height=height, round=rs.commit_round,
-                          txs=len(block.data.txs))
+                          txs=len(block.data.txs),
+                          catchup=rs.decided_commit is not None)
             self.log.debug("committed block", height=height,
                            round=rs.commit_round, hash=block.hash(),
                            n_txs=len(block.data.txs))
@@ -1114,6 +1151,29 @@ class ConsensusState:
         self.decided = asyncio.Event()
         self.decided_height = height
         self._schedule_round0_now()
+
+    def _observe_phases(self, marks: dict, t0h: float, t_commit: float,
+                        t_wal: float, t_app: float) -> None:
+        """Fold one committed height's phase marks into
+        ``consensus_phase_seconds{phase}`` — the always-on (metrics-only)
+        face of the height timeline.  Missing marks (catch-up commits
+        skip the vote phases; a restart loses the height start) skip
+        their phase rather than observing a garbage duration."""
+        bounds = [("propose", t0h)]
+        for phase, key in (("gossip", "proposal"), ("prevote", "parts"),
+                           ("precommit", "prevote_23"),
+                           ("commit", "precommit_23")):
+            m = marks.get(key)
+            if m is not None:
+                bounds.append((phase, max(m, bounds[-1][1])))
+        for i, (phase, t) in enumerate(bounds):
+            nxt = bounds[i + 1][1] if i + 1 < len(bounds) else t_commit
+            self.m_phase.observe(max(0.0, min(nxt, t_commit) - t),
+                                 phase=phase, node=self.name)
+        self.m_phase.observe(max(0.0, t_wal), phase="wal", node=self.name)
+        self.m_phase.observe(max(0.0, t_app), phase="app", node=self.name)
+        self.m_phase.observe(max(0.0, t_commit - t0h), phase="total",
+                             node=self.name)
 
     # ----------------------------------------------------------------- votes
 

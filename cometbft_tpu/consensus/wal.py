@@ -178,6 +178,10 @@ class WAL:
         # (every further write/sync raises); recovery is a process
         # restart reopening the file, which truncates the torn tail.
         self._io_failed: Exception | None = None
+        # height attribution for fsync tracing events: EndHeight(h)
+        # stamps h on its own fsync, then advances the hint — every
+        # later fsync (own votes, timeouts) belongs to height h+1
+        self._height_hint = 0
 
     # ------------------------------------------------------------ segments
 
@@ -300,7 +304,9 @@ class WAL:
     def write_end_height(self, height: int) -> None:
         """fsync'd height sentinel (wal.go:202 EndHeightMessage)."""
         sentinel_seg = self._cur_path
+        self._height_hint = height
         self.write_sync({"#": "endheight", "h": height})
+        self._height_hint = height + 1
         try:
             self.prune_completed_segments()
         except OSError:  # bftlint: disable=EXC001 -- prune is best-effort cleanup AFTER the fsync'd sentinel; failure leaves extra segments, never loses records
@@ -327,7 +333,7 @@ class WAL:
         dt = time.perf_counter() - t0
         _wal_metrics()[1].observe(dt)
         tracing.event("wal", "fsync", path=self._cur_path,
-                      dur_us=int(dt * 1e6))
+                      height=self._height_hint, dur_us=int(dt * 1e6))
 
     # --------------------------------------------------------------- read
 

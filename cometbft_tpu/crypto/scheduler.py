@@ -167,13 +167,17 @@ _OVERDUE = _OverdueSentinel()
 
 class _Request:
     __slots__ = ("key", "pub", "msg", "sig", "future", "callbacks",
-                 "t_enqueue", "timer")
+                 "t_enqueue", "timer", "height")
 
-    def __init__(self, key, pub, msg, sig):
+    def __init__(self, key, pub, msg, sig, height=0):
         self.key = key
         self.pub = pub
         self.msg = msg
         self.sig = sig
+        # block height the signature belongs to (0 = unknown): dispatch
+        # spans stamp the batch's h_lo..h_hi window so the height
+        # timeline (libs/timeline) can attribute verify time
+        self.height = height
         # ONE shared future for every awaiting caller (asyncio futures
         # support multiple awaiters) plus plain callbacks for the
         # fire-and-forget path — a 384-arrival gossip burst must not pay
@@ -296,7 +300,8 @@ class VerificationScheduler(BaseService):
 
     # -------------------------------------------------------------- verify
 
-    def _enqueue(self, pub, msg, sig, key) -> "_Request | None":
+    def _enqueue(self, pub, msg, sig, key,
+                 height: int = 0) -> "_Request | None":
         """Shared enqueue core: returns the (possibly pre-existing)
         request to attach to, or None when the verdict was served
         directly (cache hit handled by callers)."""
@@ -305,7 +310,7 @@ class VerificationScheduler(BaseService):
             self._dedup_b.inc()
             self._t_dedup += 1
             return req
-        req = _Request(key, pub, bytes(msg), bytes(sig))
+        req = _Request(key, pub, bytes(msg), bytes(sig), height)
         self._pending[key] = req
         if len(self._pending) >= self.max_lanes:
             self._flush("size")
@@ -379,7 +384,7 @@ class VerificationScheduler(BaseService):
             req.future.set_result(_OVERDUE)
 
     def submit_nowait(self, pub: PubKey, msg: bytes, sig: bytes,
-                      on_done=None) -> None:
+                      on_done=None, height: int = 0) -> None:
         """Fire-and-forget coalescing submission — the consensus reactor's
         entry point: no future, no task, no await.  ``on_done(ok)`` (if
         given) runs on the event loop after the verdict lands; cache hits
@@ -403,7 +408,7 @@ class VerificationScheduler(BaseService):
             if on_done is not None:
                 on_done(ok)
             return
-        req = self._enqueue(pub, msg, sig, key)
+        req = self._enqueue(pub, msg, sig, key, height)
         if on_done is not None:
             req.callbacks.append(on_done)
 
@@ -451,8 +456,11 @@ class VerificationScheduler(BaseService):
         self._occ_b.observe(len(batch))                     # occupancy
         for req in batch:
             self._wait_b.observe(now - req.t_enqueue)       # wait time
-        tracing.event("crypto.sched", "flush", reason=reason,
-                      lanes=len(batch))
+        if tracing.is_enabled():
+            hs = [r.height for r in batch if r.height]
+            tracing.event("crypto.sched", "flush", reason=reason,
+                          lanes=len(batch), h_lo=min(hs, default=0),
+                          h_hi=max(hs, default=0))
         loop = self._loop or asyncio.get_running_loop()
         task = loop.create_task(self._dispatch(batch))
         self._dispatches.add(task)
@@ -465,8 +473,13 @@ class VerificationScheduler(BaseService):
 
             self._pool = cf.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="vote-sched")
-        sp = tracing.begin("crypto.sched", "dispatch", lanes=len(batch),
-                           backend=self.backend)
+        sp = None
+        if tracing.is_enabled():
+            hs = [r.height for r in batch if r.height]
+            sp = tracing.begin("crypto.sched", "dispatch",
+                               lanes=len(batch), backend=self.backend,
+                               h_lo=min(hs, default=0),
+                               h_hi=max(hs, default=0))
         try:
             oks = await loop.run_in_executor(
                 self._pool, self._verify_batch, batch)

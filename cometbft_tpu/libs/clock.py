@@ -99,6 +99,16 @@ def walltime_ns() -> int:
     return _CLOCK.walltime_ns()
 
 
+def monotonic_ns() -> int:
+    """``time.monotonic_ns`` through the seam.  The flight recorder
+    (``libs/tracing``) stamps records with this so a scenario-lab run's
+    span timestamps — hence the per-height timeline attribution in the
+    verdict — are a pure function of the scenario seed."""
+    if _CLOCK is None:
+        return _time.monotonic_ns()
+    return int(_CLOCK.monotonic() * 1e9)
+
+
 def walltime() -> float:
     """``time.time`` through the seam (ban expiries, report stamps)."""
     if _CLOCK is None:

@@ -44,6 +44,8 @@ import time
 from collections import deque
 from contextvars import ContextVar
 
+from . import clock as _clock
+
 _ENABLED = False
 _MAXLEN = 8192
 _RING: deque = deque(maxlen=_MAXLEN)
@@ -105,8 +107,16 @@ def begin(sub: str, name: str, **attrs) -> "_Open | None":
     o.sub = sub
     o.name = name
     o.attrs = attrs
-    o.wall0 = time.time_ns()
-    o.t0 = time.monotonic_ns()
+    # stamps ride the clock seam: under the sim's virtual clock the
+    # ring orders by VIRTUAL time, which is what makes the scenario
+    # lab's timeline verdicts a pure function of the seed.  With no
+    # clock installed these are the exact raw calls they replace.
+    if _clock._CLOCK is None:
+        o.wall0 = time.time_ns()
+        o.t0 = time.monotonic_ns()
+    else:
+        o.wall0 = _clock.walltime_ns()
+        o.t0 = _clock.monotonic_ns()
     return o
 
 
@@ -115,7 +125,8 @@ def finish(open_: "_Open | None", **extra) -> None:
     the verdict that was only known at the end)."""
     if open_ is None:
         return
-    end = time.monotonic_ns()
+    end = time.monotonic_ns() if _clock._CLOCK is None \
+        else _clock.monotonic_ns()
     if extra:
         open_.attrs.update(extra)
     _RING.append(("span", open_.id, open_.parent, open_.sub, open_.name,
@@ -126,9 +137,12 @@ def event(sub: str, name: str, **attrs) -> None:
     """Fire-and-forget point event."""
     if not _ENABLED:
         return
-    t = time.monotonic_ns()
+    if _clock._CLOCK is None:
+        wall, t = time.time_ns(), time.monotonic_ns()
+    else:
+        wall, t = _clock.walltime_ns(), _clock.monotonic_ns()
     _RING.append(("event", next(_SEQ), _CUR.get(), sub, name,
-                  time.time_ns(), t, t, attrs))
+                  wall, t, t, attrs))
 
 
 class _SpanCM:
@@ -202,11 +216,41 @@ def _to_dict(rec) -> dict:
     }
 
 
-def dump(limit: int = 1000) -> list[dict]:
+def _rec_matches_height(attrs: dict, height: int) -> bool:
+    """A record belongs to ``height`` when it stamps ``height`` exactly
+    or its ``h_lo``..``h_hi`` window (batched emitters: a scheduler
+    dispatch mixing heights) covers it."""
+    h = attrs.get("height")
+    if h is not None:
+        return h == height
+    lo, hi = attrs.get("h_lo"), attrs.get("h_hi")
+    if lo is not None and hi is not None:
+        return lo <= height <= hi
+    return False
+
+
+def snapshot() -> list[tuple]:
+    """The raw ring as a list (newest last) — the zero-copy input for
+    ``libs/timeline``; each element is the record tuple documented at
+    the top of this module."""
+    return list(_RING)
+
+
+def dump(limit: int = 1000, sub: str | None = None,
+         height: int | None = None) -> list[dict]:
     """The newest ``limit`` COMPLETED records (``limit <= 0``: the whole
     ring) as JSON-able dicts, in completion order — sort by ``start_ns``
-    to reconstruct the timeline, since spans append at finish."""
+    to reconstruct the timeline, since spans append at finish.  ``sub``
+    keeps one subsystem's records; ``height`` keeps records stamped with
+    that height (exactly, or inside their ``h_lo``..``h_hi`` window).
+    Filters apply BEFORE the limit, so ``limit=100&height=H`` is the
+    newest 100 records OF that height."""
     recs = list(_RING)               # snapshot: writers keep appending
+    if sub is not None:
+        recs = [r for r in recs if r[3] == sub]
+    if height is not None:
+        h = int(height)
+        recs = [r for r in recs if _rec_matches_height(r[8], h)]
     if limit and int(limit) > 0:
         recs = recs[-int(limit):]
     return [_to_dict(r) for r in recs]

@@ -215,7 +215,7 @@ class Node:
             raise ValueError("no application: pass app or configure "
                              "base.abci='socket'|'grpc' with "
                              "base.proxy_app addr")
-        self.app_conns = AppConns(creator)
+        self.app_conns = AppConns(creator, node=self.name)
         await self.app_conns.start()
         self.event_bus = EventBus()
         self.mempool = CListMempool(

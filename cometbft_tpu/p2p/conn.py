@@ -14,18 +14,30 @@ from __future__ import annotations
 import asyncio
 import struct
 
-import msgpack
-
 from ..libs import clock, failures
 from ..libs.flowrate import Monitor
 from .reactor import ChannelDescriptor
 from .secret_connection import SecretConnection
 
-# a packet's msgpack envelope fits a single AEAD frame (DATA_LEN=1024)
+# a packet (3-byte header + payload) fits a single AEAD frame
+# (DATA_LEN=1024) with headroom
 PACKET_PAYLOAD = 1000
 SEND_BATCH_PACKETS = 10             # connection.go:30 numBatchPacketMsgs
 DEFAULT_PING_INTERVAL = 10.0
 DEFAULT_PONG_TIMEOUT = 5.0
+
+# Wire frames: a `<I` length prefix, then a 1-byte packet type; message
+# packets add a 1-byte channel id, a 1-byte eof flag, and the payload
+# chunk.  Struct-packed in ONE call on the hot path — the per-packet
+# msgpack dict envelope this replaced was the profile harness's top
+# allocator and a top-3 CPU sink across a fleet run
+# (docs/bench/r21-profile-*.json); the chaos fault sites still pass
+# packets around in dict form and encode late (_write_packet).
+_T_PING, _T_PONG, _T_MSG = 0x69, 0x6F, 0x6D        # 'i', 'o', 'm'
+_MSG_HDR = struct.Struct("<IBBB")                  # len | type chan eof
+_LEN = struct.Struct("<I")
+_PING_FRAME = _LEN.pack(1) + bytes((_T_PING,))
+_PONG_FRAME = _LEN.pack(1) + bytes((_T_PONG,))
 
 
 class MConnectionError(Exception):
@@ -213,7 +225,7 @@ class MConnection:
                 self._send_wakeup.clear()
                 if self._pong_to_send:
                     self._pong_to_send = False
-                    await self._write_packet({"t": "o"})
+                    await self._write_frame(_PONG_FRAME)
                 batch = 0
                 while batch < SEND_BATCH_PACKETS:
                     ch = self._select_channel()
@@ -223,8 +235,6 @@ class MConnection:
                         ch.sending = ch.queue.get_nowait()
                         ch.sent_off = 0
                     chunk, eof = ch.next_packet()
-                    pkt = {"t": "m", "c": ch.desc.channel_id,
-                           "e": eof, "d": chunk}
                     if failures.armed_prefix("p2p.send.") or \
                             self._chaos_held is not None:
                         # the held-packet check keeps the release-after-
@@ -232,9 +242,13 @@ class MConnection:
                         # rule is disarmed while a reordered packet is
                         # parked — it must ride out with the next send,
                         # not wait for a fully idle wire
-                        await self._chaos_send_packet(ch, pkt)
+                        await self._chaos_send_packet(
+                            ch, {"t": "m", "c": ch.desc.channel_id,
+                                 "e": eof, "d": chunk})
                     else:
-                        await self._write_packet(pkt)
+                        await self._write_frame(
+                            _MSG_HDR.pack(len(chunk) + 3, _T_MSG,
+                                          ch.desc.channel_id, eof) + chunk)
                     ch.recent += len(chunk)
                     ch.sent_bytes += len(chunk)
                     if eof:
@@ -306,8 +320,20 @@ class MConnection:
             await self._write_packet(held)
 
     async def _write_packet(self, packet: dict) -> None:
-        raw = msgpack.packb(packet, use_bin_type=True)
-        data = struct.pack("<I", len(raw)) + raw
+        """Late encoder for the chaos path: fault sites hold, corrupt
+        and duplicate packets in dict form; the wire sees the same
+        struct-packed frames the hot path emits."""
+        t = packet["t"]
+        if t == "m":
+            d = packet.get("d", b"")
+            await self._write_frame(
+                _MSG_HDR.pack(len(d) + 3, _T_MSG, packet["c"],
+                              1 if packet.get("e") else 0) + d)
+        else:
+            await self._write_frame(
+                _PING_FRAME if t == "i" else _PONG_FRAME)
+
+    async def _write_frame(self, data: bytes) -> None:
         if self.send_rate:
             while self.send_monitor.limit(len(data), self.send_rate) \
                     < len(data):
@@ -320,21 +346,24 @@ class MConnection:
     async def _recv_routine(self) -> None:
         try:
             while True:
-                (n,) = struct.unpack("<I", await self.conn.read(4))
-                if n > PACKET_PAYLOAD + 256:
-                    raise MConnectionError(f"oversized packet: {n}")
+                (n,) = _LEN.unpack(await self.conn.read(4))
+                if n < 1 or n > PACKET_PAYLOAD + 256:
+                    raise MConnectionError(f"bad packet length: {n}")
                 raw = await self.conn.read(n)
                 self.recv_monitor.update(n + 4)
                 self.last_recv_mono = clock.monotonic()
                 if self.recv_rate:
                     while self.recv_monitor.limit(1, self.recv_rate) < 1:
                         await clock.sleep(0.01)
-                packet = msgpack.unpackb(raw, raw=False)
-                t = packet.get("t")
-                if t == "i":                      # ping
+                t = raw[0]
+                if t == _T_MSG:
+                    if n < 3:
+                        raise MConnectionError("truncated message packet")
+                    self._on_packet_msg(raw[1], raw[2], raw[3:])
+                elif t == _T_PING:
                     self._pong_to_send = True
                     self._send_wakeup.set()
-                elif t == "o":                    # pong
+                elif t == _T_PONG:
                     self._pong_due = None
                     if self._ping_sent_mono is not None:
                         rtt = clock.monotonic() - self._ping_sent_mono
@@ -345,10 +374,8 @@ class MConnection:
                                 self.on_rtt(rtt)
                             except Exception:
                                 pass
-                elif t == "m":
-                    self._on_packet_msg(packet)
                 else:
-                    raise MConnectionError(f"unknown packet type {t!r}")
+                    raise MConnectionError(f"unknown packet type {t:#x}")
         except asyncio.CancelledError:
             raise
         except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
@@ -356,17 +383,16 @@ class MConnection:
         except Exception as e:
             self._fail(e)
 
-    def _on_packet_msg(self, packet: dict) -> None:
-        ch = self.channels.get(packet.get("c"))
+    def _on_packet_msg(self, chan_id: int, eof: int, data: bytes) -> None:
+        ch = self.channels.get(chan_id)
         if ch is None:
-            raise MConnectionError(f"unknown channel {packet.get('c')}")
-        data = packet.get("d", b"")
+            raise MConnectionError(f"unknown channel {chan_id}")
         ch.recv_buf.extend(data)
         ch.recv_bytes += len(data)
         if len(ch.recv_buf) > ch.desc.max_msg_size:
             raise MConnectionError(
                 f"message exceeds max size on channel {ch.desc.channel_id}")
-        if packet.get("e"):
+        if eof:
             msg = bytes(ch.recv_buf)
             ch.recv_buf.clear()
             ch.recv_msgs += 1
@@ -413,7 +439,7 @@ class MConnection:
         try:
             while True:
                 await clock.sleep(self.ping_interval)
-                await self._write_packet({"t": "i"})
+                await self._write_frame(_PING_FRAME)
                 self._ping_sent_mono = clock.monotonic()
                 self._pong_due = clock.monotonic() + self.pong_timeout
                 await clock.sleep(self.pong_timeout)

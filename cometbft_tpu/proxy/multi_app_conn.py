@@ -38,14 +38,24 @@ class TracedAppConn(ABCIClient):
     a flight-recorder span — so a height timeline shows exactly how long
     the app held the consensus connection inside the commit step."""
 
-    def __init__(self, inner: ABCIClient, conn: str):
+    def __init__(self, inner: ABCIClient, conn: str, node: str = ""):
         self._inner = inner
         self._conn = conn
+        self._node = node
         self._hist = _abci_metrics()
 
     async def call(self, method: str, **params):
         t0 = time.perf_counter()
-        sp = tracing.begin("abci", "call", conn=self._conn, method=method)
+        sp = None
+        if tracing.is_enabled():
+            # height attribution for the timeline: request-object calls
+            # (FinalizeBlock, PrepareProposal, ...) carry it on ``req``,
+            # flat calls (query, extend_vote) pass it directly
+            h = params.get("height")
+            if h is None:
+                h = getattr(params.get("req"), "height", None)
+            sp = tracing.begin("abci", "call", conn=self._conn,
+                               method=method, height=h, node=self._node)
         try:
             return await self._inner.call(method, **params)
         finally:
@@ -88,18 +98,23 @@ def grpc_client_creator(host: str = "127.0.0.1",
 
 
 class AppConns:
-    def __init__(self, creator: ClientCreator):
+    def __init__(self, creator: ClientCreator, node: str = ""):
         self._creator = creator
+        self._node = node
         self.consensus: ABCIClient | None = None
         self.mempool: ABCIClient | None = None
         self.query: ABCIClient | None = None
         self.snapshot: ABCIClient | None = None
 
     async def start(self) -> None:
-        self.consensus = TracedAppConn(await self._creator(), "consensus")
-        self.mempool = TracedAppConn(await self._creator(), "mempool")
-        self.query = TracedAppConn(await self._creator(), "query")
-        self.snapshot = TracedAppConn(await self._creator(), "snapshot")
+        self.consensus = TracedAppConn(await self._creator(), "consensus",
+                                       self._node)
+        self.mempool = TracedAppConn(await self._creator(), "mempool",
+                                     self._node)
+        self.query = TracedAppConn(await self._creator(), "query",
+                                   self._node)
+        self.snapshot = TracedAppConn(await self._creator(), "snapshot",
+                                      self._node)
 
     async def stop(self) -> None:
         for c in (self.consensus, self.mempool, self.query, self.snapshot):

@@ -726,25 +726,62 @@ async def block_search(env: Environment, query="", page=1,
 
 # --------------------------------------------------- flight recorder
 
-async def dump_trace(env: Environment, limit=1000) -> dict:
+async def dump_trace(env: Environment, limit=1000, sub=None,
+                     height=None) -> dict:
     """Dump the node-wide flight recorder (``libs/tracing`` ring buffer)
     as JSON: the newest ``limit`` completed spans/events, in completion
     order.  Sort records by ``start_ns`` to reconstruct a timeline; a
     committed height shows its consensus step spans with the ABCI calls,
-    WAL fsyncs and verify micro-batches that ran inside them.  Empty
-    (with ``enabled: false``) unless ``[instrumentation] tracing`` is
-    on."""
+    WAL fsyncs and verify micro-batches that ran inside them.
+    ``sub=consensus`` keeps one subsystem; ``height=H`` keeps records
+    stamped with that height.  Empty (with ``enabled: false``) unless
+    ``[instrumentation] tracing`` is on."""
     from ..libs import tracing
 
     lim = int(limit)
     if lim < 0:
         raise RPCError(-32602, "limit must be >= 0")
     st = tracing.stats()
+    # a full 8192-record ring projects to megabytes of dicts: build
+    # them OFF the event loop (the JSON encode is already off-loop via
+    # _THREAD_ENCODE_METHODS — this moves the projection there too)
+    records = await asyncio.to_thread(
+        tracing.dump, lim, str(sub) if sub is not None else None,
+        int(height) if height is not None else None)
     return {
         "enabled": st["enabled"],
         "ring_size": st["ring_size"],
         "buffered": st["buffered"],
-        "records": tracing.dump(lim),
+        "records": records,
+    }
+
+
+async def consensus_timeline(env: Environment, height=0, n=8,
+                             node=None) -> dict:
+    """Per-height commit-latency waterfalls folded from the flight
+    recorder (``libs/timeline``): ordered phases (propose -> gossip ->
+    prevote -> precommit -> commit), emitter marks, and residual
+    buckets (gossip_wait/verify/app/wal/idle) that sum exactly to the
+    measured commit latency.  ``height=H`` selects one height,
+    otherwise the newest ``n`` per node; ``node=`` filters an in-proc
+    ensemble's shared ring.  Requires ``[instrumentation] tracing``."""
+    from ..libs import timeline, tracing
+
+    h = int(height)
+    k = int(n)
+    if h < 0 or k < 0:
+        raise RPCError(-32602, "height and n must be >= 0")
+    st = tracing.stats()
+    waterfalls = await asyncio.to_thread(
+        timeline.fold, tracing.snapshot(),
+        node=str(node) if node is not None else None,
+        height=h or None, limit=k)
+    return {
+        "enabled": st["enabled"],
+        "buffered": st["buffered"],
+        "phases": list(timeline.PHASES),
+        "buckets": list(timeline.BUCKETS),
+        "waterfalls": waterfalls,
     }
 
 
@@ -849,6 +886,7 @@ ROUTES = {
     "check_tx": check_tx,
     "dump_trace": dump_trace,
     "dump_incidents": dump_incidents,
+    "consensus_timeline": consensus_timeline,
     "light_block": light_block,
     "light_blocks": light_blocks,
     "light_proofs": light_proofs,

@@ -42,7 +42,8 @@ MAX_BODY = 10 << 20
 # in-memory reads, except dump_incidents' bundle fetch which runs its
 # disk read in a worker thread.
 UNGATED_METHODS = frozenset(
-    {"health", "status", "net_info", "dump_trace", "dump_incidents"})
+    {"health", "status", "net_info", "dump_trace", "dump_incidents",
+     "consensus_timeline"})
 # POST bodies up to this size are parsed BEFORE the gate to check the
 # exemption; anything larger is gated unconditionally so a flood of fat
 # bodies can't buy a 10MB json.loads per shed request
@@ -52,7 +53,7 @@ _GATE_PROBE_MAX_BODY = 4096
 # even a single light_block embeds the full valset JSON — ~1 MB at 10k
 # validators on the provider's preferred single-round-trip path)
 _THREAD_ENCODE_METHODS = frozenset(
-    {"dump_incidents", "dump_trace",
+    {"dump_incidents", "dump_trace", "consensus_timeline",
      "light_block", "light_blocks", "light_proofs", "light_verify",
      # block-/valset-scaled payloads (a 10k-validator commit alone is
      # ~MB of JSON): encoding them inline froze every other connection

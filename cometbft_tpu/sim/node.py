@@ -176,7 +176,13 @@ async def make_sim_node(index: int, doc: GenesisDoc, pv: MockPV,
     name = name or f"sim{index:03d}"
     node_key = NodeKey.from_secret(b"sim-key-%d" % index)
     app = KVStoreApplication()
-    client = LocalClient(app)
+    # the consensus connection rides the tracing shim so lab runs get
+    # per-node ``abci`` spans (the timeline's ``app`` bucket); the
+    # mempool connection stays bare — a CheckTx storm would flood the
+    # shared ring
+    from ..proxy.multi_app_conn import TracedAppConn
+
+    client = TracedAppConn(LocalClient(app), "consensus", node=name)
     bus = EventBus()
     bstore = BlockStore(MemDB())
     sstore = StateStore(MemDB())

@@ -132,6 +132,22 @@ class _Run:
         failures.reset()
         failures.configure(enabled=True, seed=scn.seed,
                            faults=list(scn.faults))
+        # Flight recorder ON for the run: every node's spans land in the
+        # shared process-wide ring with virtual-time stamps, and the
+        # verdict folds them into per-phase latency attribution
+        # (libs/timeline).  Ring sized to hold the whole fleet's
+        # timeline; record COUNT is deterministic, so any eviction is
+        # replay-identical too.  Restored (and cleared) after the run.
+        from ..libs import tracing as _tracing
+
+        st = _tracing.stats()
+        self._prev_tracing = (st["enabled"], st["ring_size"])
+        self._tracing_installed = True
+        _tracing.clear()
+        _tracing.configure(
+            enabled=True,
+            ring_size=max(8192,
+                          scn.n_nodes * max(scn.target_height, 1) * 128))
         # One process-wide verified-signature cache shared by every sim
         # node (PR 4's positive-only VerifiedSigCache, never started as
         # a service — verify_sync is purely synchronous).  Ed25519
@@ -225,6 +241,13 @@ class _Run:
 
             self._sched_installed = False
             _vsched.set_scheduler(self._prev_sched)
+        if getattr(self, "_tracing_installed", False):
+            from ..libs import tracing as _tracing
+
+            self._tracing_installed = False
+            enabled, ring = self._prev_tracing
+            _tracing.clear()        # sim records must not leak out
+            _tracing.configure(enabled=enabled, ring_size=ring)
 
     # ------------------------------------------------------------- steps
 
@@ -392,6 +415,13 @@ class _Run:
                 continue
             for k in mp_tally:
                 mp_tally[k] += r.tallies.get(k, 0)
+        # fold the fleet's shared flight-recorder ring into per-phase
+        # commit-latency attribution: one sample per (node, height),
+        # virtual-time stamps => byte-identical on replay
+        from ..libs import timeline, tracing
+
+        waterfalls = timeline.fold(tracing.snapshot(), limit=0)
+        tl = timeline.phase_stats(waterfalls)
         ttr = None
         if self.last_disruption_at is not None and \
                 self.recovered_at is not None:
@@ -432,6 +462,7 @@ class _Run:
             "chaos": {"signature_len": len(failures.signature()),
                       "sites": {s: v["fired"] for s, v in sorted(
                           failures.stats().get("sites", {}).items())}},
+            "timeline": tl,
             "virtual_duration_s": virt,
         }
 

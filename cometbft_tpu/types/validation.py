@@ -162,9 +162,15 @@ def _verify_aggregate(chain_id: str, vals: ValidatorSet, commit: Commit,
             if vi < 0 or val.pub_key.type() != "bls12_381":
                 return frozenset(), 0       # unattributable: contributes 0
             signers.append(vi)
-    if not _blsagg.verify_commit_aggregate(
-            vals, signers, commit.aggregate_sign_bytes(chain_id),
-            commit.agg_signature):
+    from ..libs import tracing
+
+    sp = tracing.begin("crypto.agg", "verify", height=commit.height,
+                       lanes=len(lanes)) if tracing.is_enabled() else None
+    ok = _blsagg.verify_commit_aggregate(
+        vals, signers, commit.aggregate_sign_bytes(chain_id),
+        commit.agg_signature)
+    tracing.finish(sp, ok=ok)
+    if not ok:
         raise ErrInvalidSignature(
             lanes[0], f"wrong aggregate signature (lanes {lanes})")
     return frozenset(lanes), power
